@@ -1,0 +1,21 @@
+"""lasp_tpu — a TPU-native framework for distributed, deterministic dataflow
+programming with CRDTs, with the capabilities of the reference Erlang
+framework (Lasp, see SURVEY.md) rebuilt idiomatically on JAX/XLA/Pallas.
+
+Layer map (mirrors SURVEY.md §1, redesigned per §7):
+
+- ``lasp_tpu.lattice`` — CRDT tensor codecs + join kernels (reference L0/L2.2)
+- ``lasp_tpu.store``   — variable store, inflation-gated bind, thresholds (L1)
+- ``lasp_tpu.dataflow``— monotone combinator graph as jitted round sweeps (L1)
+- ``lasp_tpu.mesh``    — replication/gossip/quorum over device meshes (L2/L3)
+- ``lasp_tpu.api``     — the public Lasp verb set (L4)
+- ``lasp_tpu.programs``— distributed incremental programs (L5)
+- ``lasp_tpu.ops``     — Pallas/packed kernels for the hot merge path
+- ``lasp_tpu.utils``   — config, metrics, interning
+"""
+
+__version__ = "0.1.0"
+
+from . import lattice
+
+__all__ = ["lattice", "__version__"]

@@ -1,0 +1,50 @@
+"""Lattice layer: dense CRDT codecs + vmapped join kernels.
+
+TPU-native rebuild of the reference data layer (SURVEY.md §2.1/§2.2):
+``lasp_ivar`` / ``lasp_gset`` / ``lasp_orset`` (+ ``lasp_orset_gbtree``,
+which on TPU is the *same* codec — the gbtree variant only changes the
+Erlang-side data structure, ``src/lasp_orset_gbtree.erl``) and the
+``riak_dt`` types accepted at ``include/lasp.hrl:76``.
+"""
+
+from .base import CrdtType, Threshold, TypeRegistry, replicate, tree_all_equal
+from .gcounter import GCounter, GCounterSpec, GCounterState
+from .gset import GSet, GSetSpec, GSetState
+from .ivar import IVar, IVarSpec, IVarState
+from .orset import ORSet, ORSetSpec, ORSetState
+
+#: ``lasp_orset_gbtree`` is semantically identical to ``lasp_orset`` (same
+#: merge :134-140 / value :67-103 contract); it exists in the reference only
+#: for O(log n) host data structures, which dense tensors subsume.
+ORSetGbtree = type("ORSetGbtree", (ORSet,), {"name": "lasp_orset_gbtree"})
+
+REGISTRY = TypeRegistry(types=(IVar, GSet, ORSet, ORSetGbtree, GCounter))
+
+
+def get_type(name: str):
+    """Resolve a reference type name (e.g. ``"lasp_orset"``) to its codec."""
+    return REGISTRY.get(name)
+
+
+__all__ = [
+    "CrdtType",
+    "Threshold",
+    "TypeRegistry",
+    "replicate",
+    "tree_all_equal",
+    "IVar",
+    "IVarSpec",
+    "IVarState",
+    "GSet",
+    "GSetSpec",
+    "GSetState",
+    "ORSet",
+    "ORSetGbtree",
+    "ORSetSpec",
+    "ORSetState",
+    "GCounter",
+    "GCounterSpec",
+    "GCounterState",
+    "REGISTRY",
+    "get_type",
+]

@@ -1,0 +1,149 @@
+"""Lattice type contract: the TPU-native analogue of the ``riak_dt`` behaviour.
+
+The reference framework (Lasp) represents CRDT state as Erlang terms and
+requires every type to export ``new/0, update/3, merge/2, equal/2, value/1``
+(reference: ``src/lasp_orset.erl:32-36``) plus the order-theoretic predicates
+in ``src/lasp_lattice.erl`` (``threshold_met/3``, ``is_lattice_inflation/3``,
+``is_lattice_strict_inflation/3``).
+
+Here every CRDT type is a *dense tensor codec*:
+
+- a static, hashable ``Spec`` (capacities: element universe size, number of
+  writer actors, token budget) that fixes array shapes so every operation is
+  jit-compilable;
+- a ``State`` pytree of ``jax.Array`` leaves carrying the lattice value;
+- pure functions ``new / update ops / merge / value / equal / is_inflation /
+  is_strict_inflation / threshold_met`` that are jittable and ``vmap``-able
+  over a leading replica axis.
+
+Because join is associative, commutative, and idempotent, ``merge`` is safe
+to use as a collective reduction operator (``all_reduce``) and under any
+gossip schedule — the property that makes the bulk-synchronous TPU execution
+equivalent to Lasp's asynchronous per-process execution (the same argument
+that makes read-repair sound, reference ``src/lasp_update_fsm.erl:189-216``).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, ClassVar, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Threshold(NamedTuple):
+    """A monotone read threshold: a lattice state plus a strictness flag.
+
+    Mirrors the reference's ``threshold() :: value() | {strict, value()}``
+    (``include/lasp.hrl``); ``{strict, V}`` demands a *strict* inflation past
+    ``V`` (``src/lasp_lattice.erl:51-90``).
+    """
+
+    state: Any
+    strict: bool = False
+
+
+class CrdtType(abc.ABC):
+    """Namespace-style contract every lattice type implements.
+
+    Subclasses are stateless; all methods are pure functions over ``State``
+    pytrees and are usable under ``jax.jit`` / ``jax.vmap`` unless marked
+    host-only. ``name`` matches the reference module name for parity tracing.
+    """
+
+    #: reference module this type is equivalent to (e.g. "lasp_orset")
+    name: ClassVar[str] = ""
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    @abc.abstractmethod
+    def new(spec) -> Any:
+        """Bottom element of the lattice for this spec (``Type:new/0``)."""
+
+    # -- lattice operations (jittable) -------------------------------------
+    @staticmethod
+    @abc.abstractmethod
+    def merge(spec, a, b) -> Any:
+        """Join (least upper bound) of two states (``Type:merge/2``)."""
+
+    @staticmethod
+    @abc.abstractmethod
+    def value(spec, state) -> Any:
+        """Observable value of the state (``Type:value/1``) as arrays."""
+
+    @staticmethod
+    @abc.abstractmethod
+    def equal(spec, a, b) -> jax.Array:
+        """Scalar bool array: state equality (``Type:equal/2``)."""
+
+    @staticmethod
+    @abc.abstractmethod
+    def is_inflation(spec, prev, cur) -> jax.Array:
+        """``cur`` >= ``prev`` in the lattice order
+        (``src/lasp_lattice.erl:126-179``)."""
+
+    @staticmethod
+    @abc.abstractmethod
+    def is_strict_inflation(spec, prev, cur) -> jax.Array:
+        """``cur`` > ``prev`` strictly (``src/lasp_lattice.erl:204-275``)."""
+
+    @classmethod
+    def threshold_met(cls, spec, state, threshold: Threshold) -> jax.Array:
+        """Default threshold semantics: (strict) inflation beyond the
+        threshold state — the rule shared by gset/orset/orswot/map
+        (``src/lasp_lattice.erl:62-85``). Counter- and ivar-like types
+        override."""
+        if threshold.strict:
+            return cls.is_strict_inflation(spec, threshold.state, state)
+        return cls.is_inflation(spec, threshold.state, state)
+
+    # -- host-side helpers --------------------------------------------------
+    @staticmethod
+    def stats(spec, state) -> dict:
+        """Introspection counters (``Type:stats/1``); optional."""
+        return {}
+
+
+def tree_all_equal(a, b) -> jax.Array:
+    """Scalar bool: every leaf of two same-structure pytrees is elementwise
+    equal. Used as the default ``equal`` for tensor-encoded states."""
+    struct_a = jax.tree_util.tree_structure(a)
+    struct_b = jax.tree_util.tree_structure(b)
+    if struct_a != struct_b:
+        raise ValueError(
+            f"tree_all_equal: mismatched pytree structures {struct_a} vs {struct_b}"
+        )
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    acc = jnp.asarray(True)
+    for la, lb in zip(leaves_a, leaves_b):
+        acc = jnp.logical_and(acc, jnp.all(la == lb))
+    return acc
+
+
+def replicate(state, n_replicas: int):
+    """Broadcast a single-replica state to a leading replica axis.
+
+    The replica axis is the TPU analogue of Lasp's N-way preflist placement
+    (``src/lasp.erl:345-366``): one slice per simulated replica, merged by
+    vmapped joins instead of quorum FSMs.
+    """
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.broadcast_to(leaf, (n_replicas,) + leaf.shape), state
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class TypeRegistry:
+    """Maps reference type names to codec classes (parity with the accepted
+    ``type()`` union in ``include/lasp.hrl:76``)."""
+
+    types: tuple = ()
+
+    def get(self, name: str) -> type:
+        for t in self.types:
+            if t.name == name or t.__name__ == name:
+                return t
+        raise KeyError(f"unknown lattice type: {name}")

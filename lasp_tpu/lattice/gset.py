@@ -1,0 +1,81 @@
+"""G-Set: grow-only set as a boolean membership mask over a fixed universe.
+
+Reference semantics (``src/lasp_gset.erl``): state is an ``ordsets`` list,
+``update {add|add_all}`` inserts (:84-93), merge is set union (:99-101).
+Order theory: inflation = subset (``src/lasp_lattice.erl:137-140``), strict
+inflation additionally requires a new element (:212-215).
+
+Dense encoding: ``mask: bool[n_elems]`` over a per-variable element universe
+(host-side interning lives in the store layer). Merge is elementwise OR — a
+single VPU op vmapped over replicas, and a valid ``all_reduce`` operator for
+quorum/anti-entropy collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import CrdtType
+
+
+@dataclasses.dataclass(frozen=True)
+class GSetSpec:
+    n_elems: int
+
+
+class GSetState(NamedTuple):
+    mask: jax.Array  # bool[n_elems]
+
+
+class GSet(CrdtType):
+    name = "lasp_gset"
+
+    @staticmethod
+    def new(spec: GSetSpec) -> GSetState:
+        return GSetState(mask=jnp.zeros((spec.n_elems,), dtype=bool))
+
+    @staticmethod
+    def add(spec: GSetSpec, state: GSetState, elem_idx) -> GSetState:
+        """``update({add, Elem})`` (``src/lasp_gset.erl:84-87``). Jittable;
+        ``elem_idx`` may be a scalar or an index vector (add_all)."""
+        mask = state.mask.at[elem_idx].set(True)
+        return GSetState(mask=mask)
+
+    @staticmethod
+    def add_mask(spec: GSetSpec, state: GSetState, add: jax.Array) -> GSetState:
+        """Batched ``add_all`` from a boolean mask — the device-side update
+        kernel for large simulations."""
+        return GSetState(mask=state.mask | add)
+
+    @staticmethod
+    def merge(spec: GSetSpec, a: GSetState, b: GSetState) -> GSetState:
+        return GSetState(mask=a.mask | b.mask)
+
+    @staticmethod
+    def value(spec: GSetSpec, state: GSetState) -> jax.Array:
+        return state.mask
+
+    @staticmethod
+    def equal(spec: GSetSpec, a: GSetState, b: GSetState) -> jax.Array:
+        return jnp.all(a.mask == b.mask)
+
+    @staticmethod
+    def is_inflation(spec: GSetSpec, prev: GSetState, cur: GSetState) -> jax.Array:
+        return jnp.all(~prev.mask | cur.mask)
+
+    @staticmethod
+    def is_strict_inflation(
+        spec: GSetSpec, prev: GSetState, cur: GSetState
+    ) -> jax.Array:
+        inflation = jnp.all(~prev.mask | cur.mask)
+        grew = jnp.any(cur.mask & ~prev.mask)
+        return inflation & grew
+
+    @staticmethod
+    def stats(spec: GSetSpec, state: GSetState) -> dict:
+        # element_count per src/lasp_gset.erl:130-142
+        return {"element_count": int(jnp.sum(state.mask))}

@@ -1,0 +1,123 @@
+"""I-Var: single-assignment variable as a (defined, payload) tensor pair.
+
+Reference semantics (``src/lasp_ivar.erl``): bottom is ``undefined``
+(``new/0`` :41-43), ``update({set, V})`` binds once (:45-47), merge is
+"defined wins" with idempotent double-bind of the same value (:50-56).
+Order theory (``src/lasp_lattice.erl:126-135, 204-210``): any state inflates
+``undefined``; two defined states are ordered only if equal; strict inflation
+is exactly the undefined→defined transition.
+
+Dense encoding: ``defined: bool[]`` plus ``value: int32[]`` holding an
+interned payload id (the store layer maps arbitrary Python payloads to dense
+ids, replacing druuid/crypto-generated identity in the reference — see
+SURVEY.md §2.4 native-code census). Conflicting concurrent binds (undefined
+behaviour in the reference — ``merge(A, A)`` has no clause for ``A =/= B``,
+``src/lasp_ivar.erl:50-56``) deterministically resolve to the max payload id
+so that merge stays total, commutative, and associative on TPU.
+
+Note on the conflict case: the order predicates keep the *reference* partial
+order (two defined values are comparable only when equal), so after a
+conflicting merge the result does not inflate the losing side. This mirrors
+the reference exactly: there a conflicting merge raises and the write is
+swallowed by the bind path (``src/lasp_core.erl:308-311``), leaving the
+replica on its old value — here the inflation gate rejects the same write.
+Un-gated gossip merges instead converge deterministically to the max payload
+(where the reference would crash the gossip process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import CrdtType, Threshold
+
+
+@dataclasses.dataclass(frozen=True)
+class IVarSpec:
+    """I-Vars need no capacities; kept for interface uniformity."""
+
+    dtype: str = "int32"
+
+
+class IVarState(NamedTuple):
+    defined: jax.Array  # bool[]
+    value: jax.Array  # dtype[] — interned payload id
+
+
+class IVar(CrdtType):
+    name = "lasp_ivar"
+
+    @staticmethod
+    def new(spec: IVarSpec) -> IVarState:
+        return IVarState(
+            defined=jnp.zeros((), dtype=bool),
+            value=jnp.zeros((), dtype=spec.dtype),
+        )
+
+    @staticmethod
+    def set(spec: IVarSpec, state: IVarState, payload_id) -> IVarState:
+        """``update({set, V})`` — bind the variable (``src/lasp_ivar.erl:45-47``).
+
+        Jittable; binding an already-defined ivar keeps the existing value
+        (single assignment), matching the reference where re-bind of a
+        different value is rejected upstream by the inflation gate
+        (``src/lasp_core.erl:301-306``).
+        """
+        payload_id = jnp.asarray(payload_id, dtype=spec.dtype)
+        return IVarState(
+            defined=jnp.ones((), dtype=bool) | state.defined,
+            value=jnp.where(state.defined, state.value, payload_id),
+        )
+
+    @staticmethod
+    def merge(spec: IVarSpec, a: IVarState, b: IVarState) -> IVarState:
+        both = a.defined & b.defined
+        value = jnp.where(
+            both,
+            jnp.maximum(a.value, b.value),
+            jnp.where(a.defined, a.value, b.value),
+        )
+        return IVarState(defined=a.defined | b.defined, value=value)
+
+    @staticmethod
+    def value(spec: IVarSpec, state: IVarState):
+        return state
+
+    @staticmethod
+    def equal(spec: IVarSpec, a: IVarState, b: IVarState) -> jax.Array:
+        values_match = jnp.logical_or(
+            ~(a.defined & b.defined), a.value == b.value
+        )
+        return (a.defined == b.defined) & values_match
+
+    @staticmethod
+    def is_inflation(spec: IVarSpec, prev: IVarState, cur: IVarState) -> jax.Array:
+        # undefined <= anything; defined states comparable only when equal
+        # (src/lasp_lattice.erl:126-135).
+        return ~prev.defined | (cur.defined & (prev.value == cur.value))
+
+    @staticmethod
+    def is_strict_inflation(
+        spec: IVarSpec, prev: IVarState, cur: IVarState
+    ) -> jax.Array:
+        # exactly undefined -> defined (src/lasp_lattice.erl:204-210)
+        return ~prev.defined & cur.defined
+
+    @classmethod
+    def threshold_met(
+        cls, spec: IVarSpec, state: IVarState, threshold: Threshold
+    ) -> jax.Array:
+        """Equality-style threshold per ``src/lasp_lattice.erl:51-60``:
+        ``{strict, undefined}`` means "became defined"; otherwise the value
+        must equal the threshold exactly (undefined == undefined included)."""
+        thr: IVarState = threshold.state
+        if threshold.strict:
+            # met iff threshold is undefined and the value is defined
+            return ~thr.defined & state.defined
+        same_definedness = thr.defined == state.defined
+        values_match = jnp.logical_or(~thr.defined, thr.value == state.value)
+        return same_definedness & values_match

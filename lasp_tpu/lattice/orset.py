@@ -1,0 +1,208 @@
+"""OR-Set (observe-remove set) as dense (exists, removed) token tensors.
+
+Reference semantics (``src/lasp_orset.erl``): state is an orddict
+``elem -> orddict(token -> removed?)``; ``add`` creates a fresh unique token
+with flag ``false`` (:101-105, :222-230), ``remove`` flips every currently
+held token of the element to ``true`` (tombstones, :232-241), ``merge`` is a
+per-(elem, token) OR of the removed flags plus union of tokens (:128-134),
+and an element is in the ``value`` iff it holds at least one live token
+(:67-73). Order theory (``src/lasp_lattice.erl:153-161, 235-253``): inflation
+= every (elem, token) of the previous state is still present (flags
+irrelevant — ``ids_inflated`` :277-285); strict inflation additionally needs
+a flag flip on a shared element, a new token on a shared element, or a new
+element.
+
+Dense encoding. The reference mints 20 random bytes per add via crypto NIFs
+(``src/lasp_orset.erl:261-262``); unbounded random identity cannot live in a
+fixed-shape tensor. Instead token identity is *counter-based and
+deterministic*: writer actor ``a``'s ``k``-th add of a given element owns
+token slot ``a * tokens_per_actor + k``. Collision-freedom holds by
+construction (single-writer actor counters), so merge alignment is exact and
+no randomness (and no host round-trip) is needed — this replaces the
+crypto/druuid native dependency (SURVEY.md §2.4).
+
+State is ``exists: bool[E, T]``, ``removed: bool[E, T]`` with
+``T = n_actors * tokens_per_actor``. Merge = two elementwise ORs — the hot
+kernel of the whole framework (reference hot path
+``src/lasp_core.erl:300-301``), vmapped over replicas and usable directly as
+an ``all_reduce`` operator over mesh axes. (A bit-packed ``uint32`` variant
+for HBM-bound scale is planned for ``lasp_tpu.ops``.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import CrdtType
+
+
+@dataclasses.dataclass(frozen=True)
+class ORSetSpec:
+    n_elems: int
+    n_actors: int
+    tokens_per_actor: int = 4
+
+    @property
+    def n_tokens(self) -> int:
+        return self.n_actors * self.tokens_per_actor
+
+
+class ORSetState(NamedTuple):
+    exists: jax.Array  # bool[E, T] — token minted
+    removed: jax.Array  # bool[E, T] — tombstone flag (valid where exists)
+
+
+class ORSet(CrdtType):
+    name = "lasp_orset"
+
+    @staticmethod
+    def new(spec: ORSetSpec) -> ORSetState:
+        shape = (spec.n_elems, spec.n_tokens)
+        return ORSetState(
+            exists=jnp.zeros(shape, dtype=bool),
+            removed=jnp.zeros(shape, dtype=bool),
+        )
+
+    # -- updates ------------------------------------------------------------
+    @staticmethod
+    def add(spec: ORSetSpec, state: ORSetState, elem_idx, actor_idx) -> ORSetState:
+        """``update({add, Elem}, Actor)`` — mint the actor's next token for
+        the element (``src/lasp_orset.erl:103-105``). Jittable with traced
+        indices. The first *free* slot in the actor's pool is used (robust to
+        interleaved ``add_by_token`` writes); if the pool is exhausted the
+        add is dropped (the fixed-shape analogue of unbounded token growth;
+        size pools via ``tokens_per_actor``)."""
+        k = spec.tokens_per_actor
+        base = actor_idx * k
+        row = state.exists[elem_idx]
+        pool = jax.lax.dynamic_slice(row, (base,), (k,))
+        free = jnp.argmax(~pool)  # first free slot, 0 if pool is full
+        in_range = ~pool[free]
+        slot = base + free
+        exists = state.exists.at[elem_idx, slot].set(
+            state.exists[elem_idx, slot] | in_range
+        )
+        # a freshly minted token is live even if that lane once carried a
+        # tombstone (cannot happen via our own ops, but keep add total)
+        removed = state.removed.at[elem_idx, slot].set(
+            state.removed[elem_idx, slot] & ~in_range
+        )
+        return ORSetState(exists=exists, removed=removed)
+
+    @staticmethod
+    def add_by_token(
+        spec: ORSetSpec, state: ORSetState, elem_idx, token_idx
+    ) -> ORSetState:
+        """``update({add_by_token, Token, Elem})`` (``src/lasp_orset.erl:101-102``):
+        insert a caller-supplied token with a fresh (live) flag."""
+        return ORSetState(
+            exists=state.exists.at[elem_idx, token_idx].set(True),
+            removed=state.removed.at[elem_idx, token_idx].set(False),
+        )
+
+    @staticmethod
+    def remove(spec: ORSetSpec, state: ORSetState, elem_idx) -> ORSetState:
+        """``update({remove, Elem})`` — tombstone every *observed* token of the
+        element (``src/lasp_orset.erl:232-241``). The precondition check
+        (element present) is the caller's job (the store layer does it), matching
+        the reference's ``{error, {precondition, {not_present, E}}}``."""
+        row_removed = state.removed[elem_idx] | state.exists[elem_idx]
+        return ORSetState(
+            exists=state.exists,
+            removed=state.removed.at[elem_idx].set(row_removed),
+        )
+
+    @staticmethod
+    def apply_masks(
+        spec: ORSetSpec, state: ORSetState, add_tokens: jax.Array, remove_elems: jax.Array
+    ) -> ORSetState:
+        """Batched device-side update kernel: OR-in freshly minted tokens
+        (``add_tokens: bool[E, T]``) and tombstone all observed tokens of the
+        elements flagged in ``remove_elems: bool[E]``. This is the form the
+        large-scale simulations drive (one fused call per round per replica
+        population)."""
+        exists = state.exists | add_tokens
+        removed = state.removed | (exists & remove_elems[..., None])
+        return ORSetState(exists=exists, removed=removed)
+
+    # -- lattice ------------------------------------------------------------
+    @staticmethod
+    def merge(spec: ORSetSpec, a: ORSetState, b: ORSetState) -> ORSetState:
+        # union of tokens; OR of tombstone flags (src/lasp_orset.erl:128-134)
+        return ORSetState(exists=a.exists | b.exists, removed=a.removed | b.removed)
+
+    @staticmethod
+    def value(spec: ORSetSpec, state: ORSetState) -> jax.Array:
+        """bool[E]: element holds >=1 live token (``src/lasp_orset.erl:67-73``)."""
+        return jnp.any(state.exists & ~state.removed, axis=-1)
+
+    @staticmethod
+    def removed_value(spec: ORSetSpec, state: ORSetState) -> jax.Array:
+        """bool[E]: elements with >=1 tombstoned token
+        (``value(removed, _)``, ``src/lasp_orset.erl:90-95``)."""
+        return jnp.any(state.exists & state.removed, axis=-1)
+
+    @staticmethod
+    def member_mask(spec: ORSetSpec, state: ORSetState) -> jax.Array:
+        """bool[E]: element appears in the state at all (live or tombstoned) —
+        the orddict key set, which combinators iterate
+        (``src/lasp_core.erl:647-655`` folds raw state, not value)."""
+        return jnp.any(state.exists, axis=-1)
+
+    @staticmethod
+    def equal(spec: ORSetSpec, a: ORSetState, b: ORSetState) -> jax.Array:
+        return jnp.all(a.exists == b.exists) & jnp.all(
+            (a.removed & a.exists) == (b.removed & b.exists)
+        )
+
+    @staticmethod
+    def is_inflation(spec: ORSetSpec, prev: ORSetState, cur: ORSetState) -> jax.Array:
+        # token ids preserved; flags not consulted (ids_inflated,
+        # src/lasp_lattice.erl:277-285) — but tombstones only ever grow, so
+        # flag regressions cannot occur under merge/update anyway.
+        return jnp.all(~prev.exists | cur.exists)
+
+    @staticmethod
+    def is_strict_inflation(
+        spec: ORSetSpec, prev: ORSetState, cur: ORSetState
+    ) -> jax.Array:
+        """``src/lasp_lattice.erl:235-253``: inflation AND (a shared element's
+        token dict changed — new token or flag flip — OR the element count
+        grew)."""
+        inflation = jnp.all(~prev.exists | cur.exists)
+        elem_prev = jnp.any(prev.exists, axis=-1)
+        elem_cur = jnp.any(cur.exists, axis=-1)
+        shared = elem_prev & elem_cur
+        row_changed = jnp.any(
+            (prev.exists != cur.exists)
+            | ((prev.removed & prev.exists) != (cur.removed & cur.exists)),
+            axis=-1,
+        )
+        deleted_or_grown = jnp.any(shared & row_changed)
+        new_elements = jnp.sum(elem_cur) > jnp.sum(elem_prev)
+        return inflation & (deleted_or_grown | new_elements)
+
+    # -- introspection ------------------------------------------------------
+    @staticmethod
+    def precondition_context(spec: ORSetSpec, state: ORSetState) -> ORSetState:
+        """Fragment of observed *live* adds (``src/lasp_orset.erl:147-154``)."""
+        live = state.exists & ~state.removed
+        return ORSetState(exists=live, removed=jnp.zeros_like(live))
+
+    @staticmethod
+    def stats(spec: ORSetSpec, state: ORSetState) -> dict:
+        """element/adds/removes/waste_pct per ``src/lasp_orset.erl:156-192``."""
+        exists = state.exists
+        live = int(jnp.sum(exists & ~state.removed))
+        dead = int(jnp.sum(exists & state.removed))
+        total = live + dead
+        return {
+            "element_count": int(jnp.sum(jnp.any(exists, axis=-1))),
+            "adds_count": live,
+            "removes_count": dead,
+            "waste_pct": 0 if live == 0 else round(dead / total * 100),
+        }

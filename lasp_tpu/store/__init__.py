@@ -1,0 +1,5 @@
+"""Store layer: the single-replica runtime core (reference L1 + L0 storage)."""
+
+from .store import PreconditionError, Store, Variable, Watch
+
+__all__ = ["Store", "Variable", "Watch", "PreconditionError"]

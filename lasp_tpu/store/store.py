@@ -1,0 +1,368 @@
+"""Variable store: the single-replica runtime core (reference L1, SURVEY §2.3).
+
+TPU-native rebuild of ``lasp_core.erl`` against one local store:
+
+- ``declare`` — idempotent variable creation (``src/lasp_core.erl:209-218``);
+- ``update`` — apply a CRDT op then bind (``:283-287``);
+- ``bind`` — merge + inflation-gate + write (``:291-312``; non-inflations are
+  silently ignored :305-306, merge failures leave the old value :308-311);
+- ``read`` — monotonic threshold read (``:329-364``): met thresholds return
+  immediately, unmet ones park a *watch* (the declarative analogue of
+  ``#dv.waiting_threads``) that ``write`` re-evaluates exactly the way
+  ``reply_to_all`` re-checks thresholds (``:763-825``);
+- ``read_any`` — first-match-wins over several reads (``:369-420``);
+- ``wait_needed`` — laziness: fires when a reader shows interest
+  (``:728-758``): met threshold or already-waiting readers fire immediately,
+  otherwise the watch parks in the lazy list and every subsequent ``read``
+  offers its threshold to it (``:348-349``).
+
+Instead of parking Erlang processes, watches are host objects resolved by
+``write``/``read`` notifications; blocking behaviour (run rounds until a
+watch fires) lives in the dataflow engine's fixed-point driver. The storage
+backend behaviour (``src/lasp_backend.erl:26-28``: ``start/put/get``) is the
+in-memory ``_vars`` dict here; durable backends (the eleveldb role) are the
+checkpoint module + native host store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Callable, Optional
+
+from ..lattice import (
+    GCounter,
+    GCounterSpec,
+    GSet,
+    GSetSpec,
+    IVar,
+    IVarSpec,
+    ORSet,
+    ORSetSpec,
+    Threshold,
+    get_type,
+)
+from ..utils.interning import Interner
+
+DEFAULT_SPECS = {
+    "lasp_ivar": lambda **kw: IVarSpec(),
+    "lasp_gset": lambda n_elems=64, **kw: GSetSpec(n_elems=n_elems),
+    "lasp_orset": lambda n_elems=64, n_actors=16, tokens_per_actor=4, **kw: ORSetSpec(
+        n_elems=n_elems, n_actors=n_actors, tokens_per_actor=tokens_per_actor
+    ),
+    "lasp_orset_gbtree": lambda n_elems=64, n_actors=16, tokens_per_actor=4, **kw: ORSetSpec(
+        n_elems=n_elems, n_actors=n_actors, tokens_per_actor=tokens_per_actor
+    ),
+    "riak_dt_gcounter": lambda n_actors=16, **kw: GCounterSpec(n_actors=n_actors),
+}
+
+
+class PreconditionError(RuntimeError):
+    """Mirror of ``{error, {precondition, {not_present, Elem}}}``
+    (``src/lasp_orset.erl:240``)."""
+
+
+class Watch:
+    """A parked monotonic read / wait_needed, the declarative replacement for
+    the reference's parked threads (``pending_threshold()`` in lasp.hrl)."""
+
+    __slots__ = ("kind", "var_id", "threshold", "done", "result", "callback")
+
+    def __init__(self, kind: str, var_id: str, threshold: Threshold, callback=None):
+        self.kind = kind  # "read" | "wait"
+        self.var_id = var_id
+        self.threshold = threshold
+        self.done = False
+        self.result: Any = None
+        self.callback: Optional[Callable] = callback
+
+    def fire(self, result):
+        self.done = True
+        self.result = result
+        if self.callback is not None:
+            self.callback(result)
+
+    def __repr__(self):
+        state = "done" if self.done else "pending"
+        return f"<Watch {self.kind} {self.var_id} {state}>"
+
+
+@dataclasses.dataclass
+class Variable:
+    """The ``#dv{}`` record (``include/lasp.hrl:60-63``) as a host object:
+    value + type + waiting/lazy watches, plus the interners that bridge
+    arbitrary payload terms to dense indices."""
+
+    id: str
+    type_name: str
+    codec: type
+    spec: Any
+    state: Any
+    waiting: list = dataclasses.field(default_factory=list)
+    lazy: list = dataclasses.field(default_factory=list)
+    elems: Optional[Interner] = None
+    ivar_payloads: Optional[Interner] = None
+
+
+class Store:
+    """One local store of named lattice variables (the ``store()`` that every
+    ``lasp_core`` function threads through)."""
+
+    def __init__(self, n_actors: int = 16):
+        self._vars: dict[str, Variable] = {}
+        self.actors = Interner(n_actors, kind="actor")
+        self.n_actors = n_actors
+        self._id_counter = itertools.count()
+        self.metrics = {"binds": 0, "inflations": 0, "ignored_binds": 0, "reads": 0}
+
+    # -- declare ------------------------------------------------------------
+    def declare(self, id: Optional[str] = None, type: str = "lasp_ivar", **caps) -> str:
+        """Idempotent declare (``src/lasp_core.erl:209-218``). ``caps`` sizes
+        the dense universes (n_elems / n_actors / tokens_per_actor)."""
+        if id is None:
+            id = f"v{next(self._id_counter)}"  # deterministic, replaces druuid:v4
+        if id in self._vars:
+            return id
+        codec = get_type(type)
+        caps.setdefault("n_actors", self.n_actors)
+        spec = DEFAULT_SPECS[type](**caps)
+        var = Variable(
+            id=id, type_name=type, codec=codec, spec=spec, state=codec.new(spec)
+        )
+        if hasattr(spec, "n_elems"):
+            var.elems = Interner(spec.n_elems, kind="element")
+        if type == "lasp_ivar":
+            var.ivar_payloads = Interner(2**31 - 1, kind="ivar payload")
+        self._vars[id] = var
+        return id
+
+    def variable(self, id: str) -> Variable:
+        return self._vars[id]
+
+    def ids(self) -> list:
+        return list(self._vars)
+
+    # -- update / bind ------------------------------------------------------
+    def update(self, id: str, op: tuple, actor) -> Any:
+        """``Type:update(Op, Actor, V0)`` then bind (``src/lasp_core.erl:283-287``).
+
+        Ops mirror the reference op tuples: ``("add", E)``, ``("add_all",
+        [E...])``, ``("add_by_token", Token, E)``, ``("remove", E)``,
+        ``("remove_all", [E...])``, ``("increment",)``, ``("increment", N)``,
+        ``("set", V)``."""
+        var = self._vars[id]
+        state = self._apply_op(var, var.state, op, actor)
+        return self.bind(id, state)
+
+    def _apply_op(self, var: Variable, state, op: tuple, actor):
+        codec, spec = var.codec, var.spec
+        verb = op[0]
+        if var.type_name in ("lasp_orset", "lasp_orset_gbtree"):
+            a = self.actors.intern(actor)
+            if verb == "add":
+                return codec.add(spec, state, var.elems.intern(op[1]), a)
+            if verb == "add_all":
+                for e in op[1]:
+                    state = codec.add(spec, state, var.elems.intern(e), a)
+                return state
+            if verb == "add_by_token":
+                return codec.add_by_token(
+                    spec, state, var.elems.intern(op[2]), int(op[1])
+                )
+            if verb in ("remove", "remove_all"):
+                elems = op[1] if verb == "remove_all" else [op[1]]
+                member = codec.member_mask(spec, state)
+                for e in elems:
+                    if e not in var.elems or not bool(member[var.elems.index_of(e)]):
+                        raise PreconditionError(f"not_present: {e!r}")
+                    state = codec.remove(spec, state, var.elems.index_of(e))
+                return state
+        elif var.type_name == "lasp_gset":
+            if verb == "add":
+                return codec.add(spec, state, var.elems.intern(op[1]))
+            if verb == "add_all":
+                for e in op[1]:
+                    state = codec.add(spec, state, var.elems.intern(e))
+                return state
+        elif var.type_name == "riak_dt_gcounter":
+            if verb == "increment":
+                by = op[1] if len(op) > 1 else 1
+                return codec.increment(spec, state, self.actors.intern(actor), by)
+        elif var.type_name == "lasp_ivar":
+            if verb == "set":
+                return codec.set(spec, state, var.ivar_payloads.intern(op[1]))
+        raise ValueError(f"unsupported op {op!r} for type {var.type_name}")
+
+    def bind(self, id: str, state) -> Any:
+        """Merge + inflation gate + write (``src/lasp_core.erl:291-312``)."""
+        var = self._vars[id]
+        self.metrics["binds"] += 1
+        if bool(var.codec.equal(var.spec, var.state, state)):
+            return var.state
+        merged = var.codec.merge(var.spec, var.state, state)
+        if bool(var.codec.is_inflation(var.spec, var.state, merged)):
+            self.metrics["inflations"] += 1
+            self._write(var, merged)
+        else:
+            # non-inflation silently ignored (src/lasp_core.erl:305-311)
+            self.metrics["ignored_binds"] += 1
+        return var.state
+
+    def bind_raw(self, id: str, state) -> Any:
+        """Write bypassing the inflation gate — used by read-repair where the
+        incoming state is already a join of replicas (``lasp_vnode:repair``
+        -> ``lasp_core:write``, ``src/lasp_vnode.erl:241-244``)."""
+        self._write(self._vars[id], state)
+        return state
+
+    def _write(self, var: Variable, state):
+        """``write/4``: store then wake satisfied waiting readers
+        (``src/lasp_core.erl:838-844`` + ``reply_to_all`` :774-794)."""
+        var.state = state
+        still = []
+        for watch in var.waiting:
+            if bool(var.codec.threshold_met(var.spec, state, watch.threshold)):
+                watch.fire((var.id, var.type_name, state))
+            else:
+                still.append(watch)
+        var.waiting = still
+
+    # -- read ---------------------------------------------------------------
+    def _resolve_threshold(self, var: Variable, threshold) -> Threshold:
+        """Default thresholds per ``src/lasp_core.erl:339-346``: bottom /
+        strict-bottom when unspecified. Counter thresholds are *numeric*
+        (``src/lasp_lattice.erl:87-90``), so their bottom is 0."""
+        numeric = var.type_name == "riak_dt_gcounter"
+        if threshold is None:
+            return Threshold(0 if numeric else var.codec.new(var.spec), strict=False)
+        if isinstance(threshold, Threshold):
+            if threshold.state is None:
+                bottom = 0 if numeric else var.codec.new(var.spec)
+                return Threshold(bottom, strict=threshold.strict)
+            return threshold
+        return Threshold(threshold, strict=False)
+
+    def read(self, id: str, threshold=None) -> Watch:
+        """Monotonic threshold read (``src/lasp_core.erl:329-364``). Returns a
+        ``Watch``: already-done when the threshold is met, parked otherwise.
+        Every read also offers its threshold to lazy wait_needed watches
+        (:348-349, fire rule per reply_to_all :795-813)."""
+        var = self._vars[id]
+        self.metrics["reads"] += 1
+        thr = self._resolve_threshold(var, threshold)
+        self._offer_to_lazy(var, thr)
+        watch = Watch("read", id, thr)
+        if bool(var.codec.threshold_met(var.spec, var.state, thr)):
+            watch.fire((id, var.type_name, var.state))
+        else:
+            var.waiting.append(watch)
+        return watch
+
+    def read_any(self, reads: list) -> Watch:
+        """First-match-wins read over ``[(id, threshold), ...]``
+        (``src/lasp_core.erl:369-420``): one shared watch parked on every
+        unmet variable; the first write meeting any threshold fires it."""
+        shared = Watch("read", None, None)
+        for id, threshold in reads:
+            var = self._vars[id]
+            thr = self._resolve_threshold(var, threshold)
+            self._offer_to_lazy(var, thr)
+            if bool(var.codec.threshold_met(var.spec, var.state, thr)):
+                shared.fire((id, var.type_name, var.state))
+                return shared
+        proxies = []
+
+        def _fire_shared(result):
+            if shared.done:
+                return
+            shared.fire(result)
+            # retire sibling proxies so they stop being re-evaluated on
+            # every later write (and can be GC'd)
+            for other_id, proxy in proxies:
+                other_var = self._vars[other_id]
+                if proxy in other_var.waiting:
+                    other_var.waiting.remove(proxy)
+
+        for id, threshold in reads:
+            var = self._vars[id]
+            thr = self._resolve_threshold(var, threshold)
+            proxy = Watch("read", id, thr, callback=_fire_shared)
+            proxies.append((id, proxy))
+            var.waiting.append(proxy)
+        return shared
+
+    def _offer_to_lazy(self, var: Variable, read_thr: Threshold):
+        """Wake lazy (wait_needed) watches whose threshold the incoming read
+        covers (``reply_to_all`` wait clause, ``src/lasp_core.erl:795-813``:
+        fires iff ``threshold_met(Type, WaitThreshold, ReadThreshold)`` with
+        the wait threshold in value position)."""
+        still = []
+        for watch in var.lazy:
+            fire = self._wait_covered(var, watch.threshold, read_thr)
+            if fire:
+                watch.fire(read_thr)
+            else:
+                still.append(watch)
+        var.lazy = still
+
+    @staticmethod
+    def _wait_covered(var: Variable, wait_thr: Threshold, read_thr: Threshold) -> bool:
+        # The default wait threshold {strict, bottom} is covered by any read
+        # (the common case: "unblock when anyone shows interest").
+        if var.type_name == "riak_dt_gcounter":
+            # numeric thresholds: default strict-0 fires on any read; else
+            # mirror the reply_to_all wait rule with the wait threshold in
+            # value position (src/lasp_core.erl:798)
+            if wait_thr.strict and wait_thr.state == 0:
+                return True
+            r = read_thr.state
+            w = wait_thr.state
+            return r < w if read_thr.strict else r <= w
+        bottom = var.codec.new(var.spec)
+        if wait_thr.strict and bool(var.codec.equal(var.spec, wait_thr.state, bottom)):
+            return True
+        return bool(var.codec.threshold_met(var.spec, wait_thr.state, read_thr))
+
+    def wait_needed(self, id: str, threshold=None) -> Watch:
+        """Laziness (``src/lasp_core.erl:728-758``): fire if the threshold is
+        already met by the value, or a reader is already waiting; otherwise
+        park in the lazy list."""
+        var = self._vars[id]
+        if threshold is None:
+            thr = self._resolve_threshold(var, Threshold(None, strict=True))
+        else:
+            thr = self._resolve_threshold(var, threshold)
+        watch = Watch("wait", id, thr)
+        if bool(var.codec.threshold_met(var.spec, var.state, thr)):
+            watch.fire(thr)
+        elif var.waiting:
+            watch.fire(thr)
+        else:
+            var.lazy.append(watch)
+        return watch
+
+    # -- values -------------------------------------------------------------
+    def value(self, id: str):
+        """Decoded observable value (``Type:value/1``) as host Python data."""
+        var = self._vars[id]
+        state = var.state
+        if var.type_name in ("lasp_orset", "lasp_orset_gbtree"):
+            import numpy as np
+
+            mask = np.asarray(var.codec.value(var.spec, state))
+            return var.elems.decode_mask(mask)
+        if var.type_name == "lasp_gset":
+            import numpy as np
+
+            mask = np.asarray(var.codec.value(var.spec, state))
+            return var.elems.decode_mask(mask)
+        if var.type_name == "riak_dt_gcounter":
+            return int(var.codec.value(var.spec, state))
+        if var.type_name == "lasp_ivar":
+            if not bool(state.defined):
+                return None
+            return var.ivar_payloads.term_of(int(state.value))
+        raise ValueError(var.type_name)
+
+    def state(self, id: str):
+        return self._vars[id].state

@@ -1,0 +1,5 @@
+"""Utilities: interning, config, metrics."""
+
+from .interning import CapacityError, Interner
+
+__all__ = ["Interner", "CapacityError"]

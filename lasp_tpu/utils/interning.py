@@ -1,0 +1,64 @@
+"""Host-side interning of arbitrary hashable terms to dense tensor indices.
+
+The reference identifies set elements by arbitrary Erlang terms and actors /
+variable ids by crypto UUIDs (druuid, ``src/lasp.erl:159``) — unbounded,
+random identity. Dense tensor encodings need small integer indices with
+*deterministic* allocation, so each variable owns an ``Interner`` mapping
+payload terms to slots in its element universe, and the store owns one for
+actors. This (plus counter-based OR-set tokens) replaces the crypto/druuid
+native dependencies identified in SURVEY.md §2.4.
+"""
+
+from __future__ import annotations
+
+
+class Interner:
+    """Bidirectional term <-> dense index map with a fixed capacity."""
+
+    def __init__(self, capacity: int, kind: str = "term"):
+        self.capacity = capacity
+        self.kind = kind
+        self._to_idx: dict = {}
+        self._from_idx: list = []
+
+    def __len__(self) -> int:
+        return len(self._from_idx)
+
+    def __contains__(self, term) -> bool:
+        return term in self._to_idx
+
+    def intern(self, term) -> int:
+        """Index for ``term``, allocating the next free slot on first use."""
+        idx = self._to_idx.get(term)
+        if idx is not None:
+            return idx
+        if len(self._from_idx) >= self.capacity:
+            raise CapacityError(
+                f"{self.kind} universe full ({self.capacity}); "
+                f"cannot intern {term!r} — declare the variable with a larger "
+                f"capacity"
+            )
+        idx = len(self._from_idx)
+        self._to_idx[term] = idx
+        self._from_idx.append(term)
+        return idx
+
+    def index_of(self, term) -> int:
+        """Index for an already-interned term; KeyError if unknown."""
+        return self._to_idx[term]
+
+    def term_of(self, idx: int):
+        return self._from_idx[idx]
+
+    def terms(self) -> list:
+        return list(self._from_idx)
+
+    def decode_mask(self, mask) -> frozenset:
+        """Boolean membership mask -> set of interned terms."""
+        return frozenset(
+            self._from_idx[i] for i, hit in enumerate(mask) if hit and i < len(self)
+        )
+
+
+class CapacityError(RuntimeError):
+    """A fixed-shape universe (elements/actors/tokens) ran out of slots."""

@@ -1,0 +1,15 @@
+"""Test env: force CPU with 8 virtual devices so mesh/sharding tests run
+without TPU hardware (the driver separately dry-runs the multi-chip path).
+Must run before jax is imported anywhere."""
+
+import os
+
+# Force, don't setdefault: the machine environment pins JAX_PLATFORMS=axon
+# (the real TPU tunnel), which must never be used by unit tests — it is a
+# single-client device and concurrent test runs deadlock on it.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

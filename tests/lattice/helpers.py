@@ -1,0 +1,68 @@
+"""Encode/decode bridges between dense codec states and the Python oracle."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from lasp_tpu.lattice import (
+    GCounter,
+    GCounterSpec,
+    GSet,
+    GSetSpec,
+    IVar,
+    IVarSpec,
+    ORSet,
+    ORSetSpec,
+)
+
+
+def decode_gset(spec: GSetSpec, state, elems):
+    mask = np.asarray(state.mask)
+    return frozenset(elems[i] for i in range(spec.n_elems) if mask[i])
+
+
+def encode_gset(spec: GSetSpec, model, elems):
+    state = GSet.new(spec)
+    for e in model:
+        state = GSet.add(spec, state, elems.index(e))
+    return state
+
+
+def decode_gcounter(spec: GCounterSpec, state):
+    counts = np.asarray(state.counts)
+    return {a: int(counts[a]) for a in range(spec.n_actors) if counts[a] != 0}
+
+
+def decode_ivar(state):
+    return int(np.asarray(state.value)) if bool(np.asarray(state.defined)) else None
+
+
+def decode_orset(spec: ORSetSpec, state, elems):
+    """Dense (exists, removed) -> dict elem -> dict((actor, k) -> removed)."""
+    exists = np.asarray(state.exists)
+    removed = np.asarray(state.removed)
+    k = spec.tokens_per_actor
+    out = {}
+    for e in range(spec.n_elems):
+        toks = {}
+        for t in range(spec.n_tokens):
+            if exists[e, t]:
+                toks[(t // k, t % k)] = bool(removed[e, t])
+        if toks:
+            out[elems[e]] = toks
+    return out
+
+
+def encode_orset(spec: ORSetSpec, model, elems):
+    state = ORSet.new(spec)
+    k = spec.tokens_per_actor
+    for elem, tokens in model.items():
+        e = elems.index(elem)
+        for (actor, kk), rem in sorted(tokens.items()):
+            assert kk < k, "model token out of dense pool range"
+            state = ORSet.add_by_token(spec, state, e, actor * k + kk)
+            if rem:
+                state = state._replace(
+                    removed=state.removed.at[e, actor * k + kk].set(True)
+                )
+    return state

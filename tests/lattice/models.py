@@ -1,0 +1,178 @@
+"""Pure-Python oracle models of the reference CRDT semantics.
+
+These mirror the Erlang implementations exactly (cited per class) and serve
+the role the EQC statem model plays in the reference test suite
+(``test/crdt_statem_eqc.erl``): random op sequences run against both the
+dense tensor codec and this model, and the decoded codec state must match.
+
+Tokens are ``(actor, k)`` tuples — the deterministic counterpart of the
+reference's 20 random bytes (``src/lasp_orset.erl:261-262``).
+"""
+
+from __future__ import annotations
+
+
+class PyIVar:
+    """Oracle for ``src/lasp_ivar.erl``: None = undefined; merge is
+    defined-wins; conflicting defined merge resolves to max (documented
+    lasp_tpu deviation — the reference has no clause for it)."""
+
+    @staticmethod
+    def new():
+        return None
+
+    @staticmethod
+    def set(state, value):
+        return value if state is None else state
+
+    @staticmethod
+    def merge(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return max(a, b)
+
+    @staticmethod
+    def value(state):
+        return state
+
+    @staticmethod
+    def is_inflation(prev, cur):
+        # src/lasp_lattice.erl:126-135
+        if prev is None:
+            return True
+        return prev == cur
+
+    @staticmethod
+    def is_strict_inflation(prev, cur):
+        # src/lasp_lattice.erl:204-210
+        return prev is None and cur is not None
+
+
+class PyGSet:
+    """Oracle for ``src/lasp_gset.erl``: frozenset semantics."""
+
+    @staticmethod
+    def new():
+        return frozenset()
+
+    @staticmethod
+    def add(state, elem):
+        return state | {elem}
+
+    @staticmethod
+    def merge(a, b):
+        return a | b
+
+    @staticmethod
+    def value(state):
+        return state
+
+    @staticmethod
+    def is_inflation(prev, cur):
+        return prev <= cur
+
+    @staticmethod
+    def is_strict_inflation(prev, cur):
+        return prev <= cur and prev != cur
+
+
+class PyGCounter:
+    """Oracle for ``riak_dt_gcounter`` semantics as consumed by the
+    reference (``src/lasp_lattice.erl:169-179, 273-275``): dict actor->count."""
+
+    @staticmethod
+    def new():
+        return {}
+
+    @staticmethod
+    def increment(state, actor, by=1):
+        out = dict(state)
+        out[actor] = out.get(actor, 0) + by
+        return out
+
+    @staticmethod
+    def merge(a, b):
+        out = dict(a)
+        for actor, count in b.items():
+            out[actor] = max(out.get(actor, 0), count)
+        return out
+
+    @staticmethod
+    def value(state):
+        return sum(state.values())
+
+    @staticmethod
+    def is_inflation(prev, cur):
+        return all(cur.get(a, -1) >= c for a, c in prev.items())
+
+    @staticmethod
+    def is_strict_inflation(prev, cur):
+        # total-value shortcut per src/lasp_lattice.erl:273-275
+        return PyGCounter.value(prev) < PyGCounter.value(cur)
+
+
+class PyORSet:
+    """Oracle for ``src/lasp_orset.erl``: dict elem -> dict(token -> removed?).
+
+    ``add`` mints the actor's next counter token (deterministic identity);
+    ``remove`` tombstones all observed tokens (:232-241); ``merge`` unions
+    tokens and ORs flags (:128-134); ``value`` keeps elements with a live
+    token (:67-73)."""
+
+    @staticmethod
+    def new():
+        return {}
+
+    @staticmethod
+    def add(state, elem, actor):
+        out = {e: dict(t) for e, t in state.items()}
+        tokens = out.setdefault(elem, {})
+        k = sum(1 for (a, _k) in tokens if a == actor)
+        tokens[(actor, k)] = False
+        return out
+
+    @staticmethod
+    def remove(state, elem):
+        if elem not in state:
+            raise KeyError(f"precondition: not_present {elem!r}")
+        out = {e: dict(t) for e, t in state.items()}
+        out[elem] = {tok: True for tok in out[elem]}
+        return out
+
+    @staticmethod
+    def merge(a, b):
+        out = {e: dict(t) for e, t in a.items()}
+        for elem, tokens in b.items():
+            dst = out.setdefault(elem, {})
+            for tok, removed in tokens.items():
+                dst[tok] = dst.get(tok, False) or removed
+        return out
+
+    @staticmethod
+    def value(state):
+        return frozenset(
+            e for e, toks in state.items() if any(not r for r in toks.values())
+        )
+
+    @staticmethod
+    def is_inflation(prev, cur):
+        # src/lasp_lattice.erl:153-161 + ids_inflated :277-285 (flags ignored)
+        return all(
+            elem in cur and all(tok in cur[elem] for tok in tokens)
+            for elem, tokens in prev.items()
+        )
+
+    @staticmethod
+    def is_strict_inflation(prev, cur):
+        # src/lasp_lattice.erl:235-253
+        if not prev and cur:
+            return True
+        if not PyORSet.is_inflation(prev, cur):
+            return False
+        deleted = any(
+            elem in cur and tokens != cur[elem] for elem, tokens in prev.items()
+        )
+        new_elems = len(prev) < len(cur)
+        return deleted or new_elems

@@ -1,0 +1,192 @@
+"""EQC-statem analogue (``test/crdt_statem_eqc.erl``): random op sequences
+across virtual replicas; the fold-merge of all replicas must equal the
+Python-oracle model (convergence, ``prop_converge`` :91-106), merges must be
+commutative/associative/idempotent, and the fixed point must be independent
+of merge schedule (the determinism property that replaces race detection —
+SURVEY.md §5)."""
+
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from lasp_tpu.lattice import (
+    GCounter,
+    GCounterSpec,
+    GSet,
+    GSetSpec,
+    ORSet,
+    ORSetSpec,
+)
+
+from .helpers import decode_gcounter, decode_gset, decode_orset
+from .models import PyGCounter, PyGSet, PyORSet
+
+N_REPLICAS = 5
+N_OPS = 40
+ELEMS = ["apple", "pear", "plum", "fig", "kiwi", "lime"]
+
+
+def run_gset(seed):
+    rng = random.Random(seed)
+    spec = GSetSpec(n_elems=len(ELEMS))
+    dense = [GSet.new(spec) for _ in range(N_REPLICAS)]
+    model = [PyGSet.new() for _ in range(N_REPLICAS)]
+    for _ in range(N_OPS):
+        r = rng.randrange(N_REPLICAS)
+        if rng.random() < 0.7:
+            e = rng.randrange(len(ELEMS))
+            dense[r] = GSet.add(spec, dense[r], e)
+            model[r] = PyGSet.add(model[r], ELEMS[e])
+        else:
+            r2 = rng.randrange(N_REPLICAS)
+            dense[r] = GSet.merge(spec, dense[r], dense[r2])
+            model[r] = PyGSet.merge(model[r], model[r2])
+    return spec, dense, model
+
+
+def run_gcounter(seed):
+    rng = random.Random(seed)
+    spec = GCounterSpec(n_actors=N_REPLICAS)
+    dense = [GCounter.new(spec) for _ in range(N_REPLICAS)]
+    model = [PyGCounter.new() for _ in range(N_REPLICAS)]
+    for _ in range(N_OPS):
+        r = rng.randrange(N_REPLICAS)
+        if rng.random() < 0.7:
+            dense[r] = GCounter.increment(spec, dense[r], r)
+            model[r] = PyGCounter.increment(model[r], r)
+        else:
+            r2 = rng.randrange(N_REPLICAS)
+            dense[r] = GCounter.merge(spec, dense[r], dense[r2])
+            model[r] = PyGCounter.merge(model[r], model[r2])
+    return spec, dense, model
+
+
+def run_orset(seed):
+    rng = random.Random(seed)
+    spec = ORSetSpec(n_elems=len(ELEMS), n_actors=N_REPLICAS, tokens_per_actor=16)
+    dense = [ORSet.new(spec) for _ in range(N_REPLICAS)]
+    model = [PyORSet.new() for _ in range(N_REPLICAS)]
+    for _ in range(N_OPS):
+        r = rng.randrange(N_REPLICAS)
+        roll = rng.random()
+        if roll < 0.5:
+            e = rng.randrange(len(ELEMS))
+            # actor = replica id, like the EQC model's per-replica actor.
+            # Skip adds past the dense pool capacity: the codec drops them
+            # (documented fixed-shape behaviour) while the oracle is
+            # unbounded, so the driver keeps both in the common domain.
+            k_used = sum(
+                1 for (a, _k) in model[r].get(ELEMS[e], {}) if a == r
+            )
+            if k_used < spec.tokens_per_actor:
+                dense[r] = ORSet.add(spec, dense[r], e, r)
+                model[r] = PyORSet.add(model[r], ELEMS[e], r)
+        elif roll < 0.7 and model[r]:
+            elem = rng.choice(sorted(model[r]))
+            e = ELEMS.index(elem)
+            dense[r] = ORSet.remove(spec, dense[r], e)
+            model[r] = PyORSet.remove(model[r], elem)
+        else:
+            r2 = rng.randrange(N_REPLICAS)
+            dense[r] = ORSet.merge(spec, dense[r], dense[r2])
+            model[r] = PyORSet.merge(model[r], model[r2])
+    return spec, dense, model
+
+
+CASES = {
+    "gset": (run_gset, GSet, decode_gset, PyGSet, True),
+    "gcounter": (run_gcounter, GCounter, decode_gcounter, PyGCounter, False),
+    "orset": (run_orset, ORSet, decode_orset, PyORSet, True),
+}
+
+
+@pytest.mark.parametrize("name", CASES)
+@pytest.mark.parametrize("seed", range(8))
+def test_converge(name, seed):
+    """prop_converge: merged dense state decodes to the merged model state."""
+    runner, codec, decode, pymodel, with_elems = CASES[name]
+    spec, dense, model = runner(seed)
+    merged_d = dense[0]
+    merged_m = model[0]
+    for d, m in zip(dense[1:], model[1:]):
+        merged_d = codec.merge(spec, merged_d, d)
+        merged_m = pymodel.merge(merged_m, m)
+    decoded = decode(spec, merged_d, ELEMS) if with_elems else decode(spec, merged_d)
+    assert decoded == merged_m
+    if with_elems:
+        value_decoded = {
+            ELEMS[i]
+            for i, v in enumerate(np.asarray(codec.value(spec, merged_d)))
+            if v
+        }
+        assert value_decoded == set(pymodel.value(merged_m))
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_merge_schedule_independence(name):
+    """Determinism: any permutation / tree shape of merges reaches the same
+    state — the property that makes BSP rounds equivalent to async gossip."""
+    runner, codec, _, _, _ = CASES[name]
+    spec, dense, _ = runner(123)
+
+    def fold(order):
+        acc = dense[order[0]]
+        for i in order[1:]:
+            acc = codec.merge(spec, acc, dense[i])
+        return acc
+
+    base = fold(list(range(N_REPLICAS)))
+    for perm in itertools.islice(itertools.permutations(range(N_REPLICAS)), 12):
+        other = fold(list(perm))
+        assert bool(codec.equal(spec, base, other))
+    # idempotence: merging the fixed point with any input is a no-op
+    for i in range(N_REPLICAS):
+        assert bool(codec.equal(spec, base, codec.merge(spec, base, dense[i])))
+
+
+@pytest.mark.parametrize("name", CASES)
+def test_vmapped_merge_matches_loop(name):
+    """The replica-axis vmap of merge (the TPU kernel form) agrees with the
+    per-replica loop."""
+    runner, codec, _, _, _ = CASES[name]
+    spec, dense, _ = runner(7)
+    stack = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *dense)
+    rolled = jax.tree_util.tree_map(lambda x: np.roll(x, 1, axis=0), stack)
+    vmerged = jax.vmap(lambda a, b: codec.merge(spec, a, b))(stack, rolled)
+    for i in range(N_REPLICAS):
+        expect = codec.merge(spec, dense[i], dense[(i - 1) % N_REPLICAS])
+        got = jax.tree_util.tree_map(lambda x: x[i], vmerged)
+        assert bool(codec.equal(spec, expect, got))
+
+
+def test_orset_inflation_matches_model():
+    spec, dense, model = run_orset(99)
+    for i in range(N_REPLICAS):
+        for j in range(N_REPLICAS):
+            assert bool(ORSet.is_inflation(spec, dense[i], dense[j])) == (
+                PyORSet.is_inflation(model[i], model[j])
+            ), (i, j)
+            assert bool(ORSet.is_strict_inflation(spec, dense[i], dense[j])) == (
+                PyORSet.is_strict_inflation(model[i], model[j])
+            ), (i, j)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_orset_encode_decode_roundtrip(seed):
+    """The encode/decode bridges invert each other, pinning the dense token
+    layout (actor-major slots) against drift."""
+    from .helpers import encode_gset, encode_orset, decode_gset, decode_orset
+
+    spec, dense, model = run_orset(seed)
+    gspec, gdense, gmodel = run_gset(seed)
+    for d, m in zip(dense, model):
+        re_encoded = encode_orset(spec, decode_orset(spec, d, ELEMS), ELEMS)
+        assert bool(ORSet.equal(spec, d, re_encoded))
+        assert decode_orset(spec, re_encoded, ELEMS) == m
+    for d, m in zip(gdense, gmodel):
+        re_encoded = encode_gset(gspec, decode_gset(gspec, d, ELEMS), ELEMS)
+        assert bool(GSet.equal(gspec, d, re_encoded))

@@ -1,0 +1,181 @@
+"""Inflation / strict-inflation truth tables for every type, mirroring the
+reference EUnit suite embedded in ``src/lasp_lattice.erl:314-613``."""
+
+import jax.numpy as jnp
+
+from lasp_tpu.lattice import (
+    GCounter,
+    GCounterSpec,
+    GSet,
+    GSetSpec,
+    IVar,
+    IVarSpec,
+    ORSet,
+    ORSetSpec,
+    Threshold,
+)
+
+
+def b(x):
+    return bool(jnp.asarray(x))
+
+
+class TestIVar:
+    spec = IVarSpec()
+
+    def states(self):
+        # A1/B1 fresh; A2 = set 1; B2 = set 2 (lasp_ivar_inflation_test)
+        a1 = IVar.new(self.spec)
+        b1 = IVar.new(self.spec)
+        a2 = IVar.set(self.spec, a1, 1)
+        b2 = IVar.set(self.spec, b1, 2)
+        return a1, b1, a2, b2
+
+    def test_inflation(self):
+        a1, b1, a2, b2 = self.states()
+        assert b(IVar.is_inflation(self.spec, a1, b1))
+        assert b(IVar.is_inflation(self.spec, a1, a2))
+        assert not b(IVar.is_inflation(self.spec, a2, b2))
+
+    def test_strict_inflation(self):
+        a1, b1, a2, b2 = self.states()
+        assert not b(IVar.is_strict_inflation(self.spec, a1, b1))
+        assert b(IVar.is_strict_inflation(self.spec, a1, a2))
+        assert not b(IVar.is_strict_inflation(self.spec, a2, b2))
+
+    def test_threshold(self):
+        # src/lasp_lattice.erl:51-60
+        spec = self.spec
+        undef = IVar.new(spec)
+        bound = IVar.set(spec, undef, 7)
+        strict_undef = Threshold(undef, strict=True)
+        assert not b(IVar.threshold_met(spec, undef, strict_undef))
+        assert b(IVar.threshold_met(spec, undef, Threshold(undef)))
+        assert b(IVar.threshold_met(spec, bound, strict_undef))
+        assert b(IVar.threshold_met(spec, bound, Threshold(IVar.set(spec, undef, 7))))
+        assert not b(
+            IVar.threshold_met(spec, bound, Threshold(IVar.set(spec, undef, 8)))
+        )
+
+
+class TestGSet:
+    spec = GSetSpec(n_elems=4)
+
+    def states(self):
+        a1 = GSet.new(self.spec)
+        b1 = GSet.new(self.spec)
+        a2 = GSet.add(self.spec, a1, 1)
+        b2 = GSet.add(self.spec, b1, 2)
+        return a1, b1, a2, b2
+
+    def test_inflation(self):
+        a1, b1, a2, b2 = self.states()
+        assert b(GSet.is_inflation(self.spec, a1, b1))
+        assert b(GSet.is_inflation(self.spec, a1, a2))
+        assert not b(GSet.is_inflation(self.spec, a2, b2))
+
+    def test_strict_inflation(self):
+        a1, b1, a2, b2 = self.states()
+        assert not b(GSet.is_strict_inflation(self.spec, a1, b1))
+        assert b(GSet.is_strict_inflation(self.spec, a1, a2))
+        assert not b(GSet.is_strict_inflation(self.spec, a2, b2))
+
+    def test_threshold_is_inflation_of_threshold(self):
+        # src/lasp_lattice.erl:62-65
+        a1, _, a2, _ = self.states()
+        assert b(GSet.threshold_met(self.spec, a2, Threshold(a1)))
+        assert b(GSet.threshold_met(self.spec, a2, Threshold(a1, strict=True)))
+        assert b(GSet.threshold_met(self.spec, a2, Threshold(a2)))
+        assert not b(GSet.threshold_met(self.spec, a2, Threshold(a2, strict=True)))
+
+
+class TestGCounter:
+    spec = GCounterSpec(n_actors=2)
+
+    def states(self):
+        # actors: a=0, b=1 (riak_dt_gcounter_inflation_test)
+        a1 = GCounter.new(self.spec)
+        b1 = GCounter.new(self.spec)
+        a2 = GCounter.increment(self.spec, a1, 0)
+        a3 = GCounter.increment(self.spec, a2, 0)
+        b2 = GCounter.increment(self.spec, b1, 1)
+        return a1, b1, a2, a3, b2
+
+    def test_inflation(self):
+        a1, b1, a2, a3, b2 = self.states()
+        assert b(GCounter.is_inflation(self.spec, a1, b1))
+        assert not b(GCounter.is_inflation(self.spec, a2, b1))
+        assert b(GCounter.is_inflation(self.spec, a1, a2))
+        assert b(GCounter.is_inflation(self.spec, b1, a2))
+        assert not b(GCounter.is_inflation(self.spec, a2, b2))
+
+    def test_strict_inflation(self):
+        a1, b1, a2, a3, b2 = self.states()
+        assert not b(GCounter.is_strict_inflation(self.spec, a1, b1))
+        assert not b(GCounter.is_strict_inflation(self.spec, a2, b1))
+        assert b(GCounter.is_strict_inflation(self.spec, a1, a2))
+        assert b(GCounter.is_strict_inflation(self.spec, b1, a2))
+        # concurrent: value shortcut says not strict (equal totals)
+        assert not b(GCounter.is_strict_inflation(self.spec, a2, b2))
+        assert not b(GCounter.is_strict_inflation(self.spec, a2, a2))
+        assert b(GCounter.is_strict_inflation(self.spec, a2, a3))
+
+    def test_threshold_numeric(self):
+        # src/lasp_lattice.erl:87-90
+        _, _, a2, a3, _ = self.states()
+        assert b(GCounter.threshold_met(self.spec, a2, Threshold(1)))
+        assert not b(GCounter.threshold_met(self.spec, a2, Threshold(1, strict=True)))
+        assert b(GCounter.threshold_met(self.spec, a3, Threshold(1, strict=True)))
+        assert not b(GCounter.threshold_met(self.spec, a2, Threshold(5)))
+
+
+class TestORSet:
+    spec = ORSetSpec(n_elems=4, n_actors=2, tokens_per_actor=2)
+
+    def states(self):
+        # actors a=0, b=1 (lasp_orset_inflation_test)
+        a1 = ORSet.new(self.spec)
+        b1 = ORSet.new(self.spec)
+        a2 = ORSet.add(self.spec, a1, 1, 0)
+        b2 = ORSet.add(self.spec, b1, 2, 1)
+        a3 = ORSet.remove(self.spec, a2, 1)
+        return a1, b1, a2, b2, a3
+
+    def test_inflation(self):
+        a1, b1, a2, b2, a3 = self.states()
+        assert b(ORSet.is_inflation(self.spec, a1, b1))
+        assert b(ORSet.is_inflation(self.spec, a1, a2))
+        assert not b(ORSet.is_inflation(self.spec, a2, b2))
+        assert b(ORSet.is_inflation(self.spec, a2, a3))
+
+    def test_strict_inflation(self):
+        a1, b1, a2, b2, a3 = self.states()
+        assert not b(ORSet.is_strict_inflation(self.spec, a1, b1))
+        assert b(ORSet.is_strict_inflation(self.spec, a1, a2))
+        assert not b(ORSet.is_strict_inflation(self.spec, a2, b2))
+        # tombstone flip is a strict inflation (src/lasp_lattice.erl:244-251)
+        assert b(ORSet.is_strict_inflation(self.spec, a2, a3))
+
+    def test_value_and_removed(self):
+        _, _, a2, _, a3 = self.states()
+        assert list(map(bool, ORSet.value(self.spec, a2))) == [False, True, False, False]
+        assert list(map(bool, ORSet.value(self.spec, a3))) == [False] * 4
+        assert list(map(bool, ORSet.removed_value(self.spec, a3))) == [
+            False,
+            True,
+            False,
+            False,
+        ]
+
+    def test_stats(self):
+        _, _, a2, _, a3 = self.states()
+        assert ORSet.stats(self.spec, a2) == {
+            "element_count": 1,
+            "adds_count": 1,
+            "removes_count": 0,
+            "waste_pct": 0,
+        }
+        s3 = ORSet.stats(self.spec, a3)
+        assert s3["element_count"] == 1
+        assert s3["adds_count"] == 0
+        assert s3["removes_count"] == 1

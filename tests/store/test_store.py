@@ -1,0 +1,209 @@
+"""Store-semantics tests: the analogue of the reference's store EQC model
+(``test/lasp_eqc.erl``) and the bind / monotonic-read riak_tests
+(``riak_test/lasp_bind_test.erl``, ``riak_test/lasp_monotonic_read_test.erl``)
+— but with convergence predicates instead of sleeps (SURVEY.md §4 caveat)."""
+
+import pytest
+
+from lasp_tpu.lattice import GCounter, Threshold
+from lasp_tpu.store import PreconditionError, Store
+
+
+def test_declare_idempotent():
+    s = Store()
+    id1 = s.declare("x", type="lasp_ivar")
+    id2 = s.declare("x", type="lasp_ivar")
+    assert id1 == id2 == "x"
+    assert s.declare(type="lasp_gset") != s.declare(type="lasp_gset")
+
+
+def test_ivar_bind_and_read():
+    # lasp_bind_test: declare ivar, bind, read returns; double-bind same value
+    # idempotent; conflicting bind ignored (src/lasp_core.erl:291-312)
+    s = Store()
+    s.declare("i", type="lasp_ivar")
+    assert s.value("i") is None
+    s.update("i", ("set", "hello"), actor="a")
+    assert s.value("i") == "hello"
+    s.update("i", ("set", "hello"), actor="b")  # idempotent re-bind
+    assert s.value("i") == "hello"
+    # conflicting local set is a no-op (reference: update({set,V}) has a
+    # clause only for undefined, src/lasp_ivar.erl:46-47)
+    s.update("i", ("set", "world"), actor="c")
+    assert s.value("i") == "hello"
+    # conflicting bind of a *different replica's* state: merge totalizes to
+    # max payload id, which does not inflate the loser -> silently ignored
+    # (src/lasp_core.erl:305-311)
+    from lasp_tpu.lattice import IVar
+    var = s.variable("i")
+    foreign = IVar.set(var.spec, IVar.new(var.spec), var.ivar_payloads.intern("zzz"))
+    s.bind("i", foreign)
+    assert s.value("i") == "hello"
+    assert s.metrics["ignored_binds"] >= 1
+
+
+def test_read_blocks_until_bound_then_fires():
+    s = Store()
+    s.declare("i", type="lasp_ivar")
+    w = s.read("i", Threshold(None, strict=True))  # {strict, undefined}
+    assert not w.done
+    s.update("i", ("set", 42), actor="a")
+    assert w.done
+    var_id, type_name, state = w.result
+    assert var_id == "i" and type_name == "lasp_ivar"
+    assert s.value("i") == 42
+
+
+def test_monotonic_threshold_read_gcounter():
+    # lasp_monotonic_read_test: read at threshold 5 fires only at value>=5
+    s = Store()
+    s.declare("c", type="riak_dt_gcounter")
+    w = s.read("c", Threshold(5))
+    for i in range(4):
+        s.update("c", ("increment",), actor=f"client{i % 2}")
+        assert not w.done
+    s.update("c", ("increment",), actor="client0")
+    assert w.done
+    assert s.value("c") == 5
+
+
+def test_strict_threshold_read():
+    s = Store()
+    s.declare("g", type="lasp_gset", n_elems=8)
+    s.update("g", ("add", "a"), actor="x")
+    snapshot = s.state("g")
+    w = s.read("g", Threshold(snapshot, strict=True))
+    assert not w.done  # same state: not a strict inflation
+    s.update("g", ("add", "a"), actor="y")  # no-op add
+    assert not w.done
+    s.update("g", ("add", "b"), actor="x")
+    assert w.done
+
+
+def test_orset_add_remove_precondition():
+    s = Store()
+    s.declare("o", type="lasp_orset", n_elems=8)
+    s.update("o", ("add_all", ["p", "q"]), actor="a")
+    assert s.value("o") == {"p", "q"}
+    s.update("o", ("remove", "p"), actor="a")
+    assert s.value("o") == {"q"}
+    with pytest.raises(PreconditionError):
+        s.update("o", ("remove", "zz"), actor="a")
+    # removed element may be re-added: new token wins for visibility
+    s.update("o", ("add", "p"), actor="a")
+    assert s.value("o") == {"p", "q"}
+
+
+def test_bind_is_inflation_gated_merge():
+    # binds merge: two stores' orset states joined via bind converge
+    s = Store()
+    s.declare("o", type="lasp_orset", n_elems=8)
+    s.update("o", ("add", "x"), actor="a")
+    other = Store()
+    other.declare("o", type="lasp_orset", n_elems=8)
+    other.update("o", ("add", "y"), actor="b")
+    # carry other's state across (same spec; same interner order matters:
+    # each interned its own first element at index 0, so this simulates two
+    # replicas with a shared universe only when universes agree)
+    s2 = Store()
+    s2.declare("o", type="lasp_orset", n_elems=8)
+    s2.update("o", ("add", "x"), actor="a")
+    s2.update("o", ("add", "y"), actor="b")
+    s.variable("o").elems.intern("y")
+    s.bind("o", s2.state("o"))
+    assert s.value("o") == {"x", "y"}
+
+
+def test_read_any_first_match():
+    s = Store()
+    s.declare("a", type="lasp_ivar")
+    s.declare("b", type="lasp_ivar")
+    w = s.read_any([("a", Threshold(None, strict=True)), ("b", Threshold(None, strict=True))])
+    assert not w.done
+    s.update("b", ("set", 9), actor="x")
+    assert w.done
+    assert w.result[0] == "b"
+    # later writes don't double-fire
+    s.update("a", ("set", 1), actor="x")
+    assert w.result[0] == "b"
+
+
+def test_wait_needed_laziness():
+    # src/lasp_core.erl:728-758: wait_needed fires when a reader arrives
+    s = Store()
+    s.declare("i", type="lasp_ivar")
+    lazy = s.wait_needed("i")
+    assert not lazy.done
+    s.read("i", Threshold(None, strict=True))  # a reader shows interest
+    assert lazy.done
+    # wait_needed on a variable with waiting readers fires immediately
+    lazy2 = s.wait_needed("i")
+    assert lazy2.done
+
+
+def test_wait_needed_met_threshold_fires_immediately():
+    s = Store()
+    s.declare("c", type="riak_dt_gcounter")
+    s.update("c", ("increment", 7), actor="a")
+    lazy = s.wait_needed("c", Threshold(3))
+    assert lazy.done
+
+
+def test_metrics_count_inflations():
+    s = Store()
+    s.declare("c", type="riak_dt_gcounter")
+    s.update("c", ("increment",), actor="a")
+    s.update("c", ("increment",), actor="a")
+    assert s.metrics["inflations"] == 2
+    assert s.metrics["binds"] == 2
+
+
+def test_gcounter_default_threshold_read():
+    # numeric bottom (0) must be substituted for None thresholds
+    # (src/lasp_lattice.erl:87-90: counter thresholds are numbers)
+    s = Store()
+    s.declare("c", type="riak_dt_gcounter")
+    w = s.read("c")  # default threshold: 0 <= value -> met immediately
+    assert w.done
+    w2 = s.read("c", Threshold(None, strict=True))  # strict 0: needs value>0
+    assert not w2.done
+    s.update("c", ("increment",), actor="a")
+    assert w2.done
+
+
+def test_gcounter_wait_needed_numeric():
+    s = Store()
+    s.declare("c", type="riak_dt_gcounter")
+    lazy = s.wait_needed("c")  # default strict-0 parks (value 0, no readers)
+    assert not lazy.done
+    s.read("c", Threshold(3))  # a reader shows interest
+    assert lazy.done
+    # a parked reader means later wait_neededs fire immediately
+    # (src/lasp_core.erl:739-741)
+    assert s.wait_needed("c", Threshold(10)).done
+
+
+def test_gcounter_wait_needed_numeric_coverage_rule():
+    # numeric wait threshold fires only when a read's demand covers it
+    s = Store()
+    s.declare("c", type="riak_dt_gcounter")
+    lazy10 = s.wait_needed("c", Threshold(10))
+    assert not lazy10.done
+    s.variable("c").waiting.clear()  # isolate the lazy coverage rule
+    s.read("c", Threshold(12))  # 12 > 10: does not cover the wait
+    assert not lazy10.done
+    s.variable("c").waiting.clear()
+    s.read("c", Threshold(4))  # 4 <= 10: covers it (reply_to_all wait rule)
+    assert lazy10.done
+
+
+def test_read_any_retires_sibling_proxies():
+    s = Store()
+    s.declare("a", type="lasp_ivar")
+    s.declare("b", type="lasp_ivar")
+    w = s.read_any(
+        [("a", Threshold(None, strict=True)), ("b", Threshold(None, strict=True))]
+    )
+    s.update("b", ("set", 1), actor="x")
+    assert w.done
+    assert s.variable("a").waiting == []  # sibling proxy retired
