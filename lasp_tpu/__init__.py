@@ -16,6 +16,16 @@ Layer map (mirrors SURVEY.md §1, redesigned per §7):
 
 __version__ = "0.1.0"
 
-from . import lattice
+from . import api, dataflow, lattice, mesh, programs, store
+from .api import Session
 
-__all__ = ["lattice", "__version__"]
+__all__ = [
+    "Session",
+    "api",
+    "dataflow",
+    "lattice",
+    "mesh",
+    "programs",
+    "store",
+    "__version__",
+]

@@ -1,0 +1,5 @@
+"""Public API (L4): the Lasp verb set (``src/lasp.erl``) — SURVEY.md §2.7."""
+
+from .session import Session
+
+__all__ = ["Session"]

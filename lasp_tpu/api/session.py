@@ -1,0 +1,165 @@
+"""Public API facade: the full Lasp verb set against one session.
+
+TPU rebuild of ``src/lasp.erl`` (exports :26-51). The reference's verbs are
+synchronous wrappers that spawn a coordination FSM and block in
+``wait_for_reqid`` (``src/lasp.erl:384-392``); here the store is local and
+dataflow is bulk-synchronous, so each mutating verb optionally runs the
+graph to its fixed point (``auto_propagate``) — which is *stronger* than
+the reference's guarantee (its tests need ``timer:sleep`` for dataflow to
+catch up; ours are deterministic after ``propagate``).
+
+Per-verb parity (reference ``src/lasp.erl``):
+
+- ``declare/1,2`` :157-170 → :meth:`Session.declare`
+- ``update/3`` :180-184 → :meth:`Session.update`
+- ``bind/2`` :194-198, ``bind_to/2`` :201-207 → :meth:`bind` / :meth:`bind_to`
+- ``read/1,2`` :222-235 (default threshold ``{strict, undefined}``),
+  ``read_any/1`` :241-245 → :meth:`read` / :meth:`read_any`
+- ``filter/map/fold/union/intersection/product`` :252-321 → same names
+- ``wait_needed/1,2`` :331-337 → :meth:`wait_needed`
+- ``thread/3`` :327-329 → :meth:`thread` (runs the function once against
+  the local store; the reference spawns it on each of N replicas, which the
+  mesh layer's replica axis subsumes)
+- ``register/4`` :84-86, ``execute/2`` :99-111, ``process/4`` :129-150 →
+  program registry (the L5 layer, ``src/lasp_program.erl``)
+
+Replication-facing verbs (``preflist/3``, ``mk_reqid/0``) have no meaning
+without the FSM machinery; their role (replica placement) lives in
+``lasp_tpu.mesh``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..dataflow import Graph
+from ..lattice import Threshold
+from ..store import Store, Watch
+
+
+class Session:
+    """One Lasp session: a store + a dataflow graph + a program registry."""
+
+    def __init__(self, n_actors: int = 16, auto_propagate: bool = True):
+        self.store = Store(n_actors=n_actors)
+        self.graph = Graph(self.store)
+        self.auto_propagate = auto_propagate
+        self.programs: dict[str, Any] = {}
+
+    # -- variables -----------------------------------------------------------
+    def declare(self, type: str = "lasp_ivar", id: Optional[str] = None, **caps) -> str:
+        """``lasp:declare/1,2`` (``src/lasp.erl:157-170``)."""
+        return self.store.declare(id=id, type=type, **caps)
+
+    def update(self, id: str, op: tuple, actor) -> None:
+        """``lasp:update/3`` (``src/lasp.erl:180-184``)."""
+        self.store.update(id, op, actor)
+        self._maybe_propagate()
+
+    def bind(self, id: str, state) -> None:
+        """``lasp:bind/2`` (``src/lasp.erl:194-198``)."""
+        self.store.bind(id, state)
+        self._maybe_propagate()
+
+    def bind_to(self, dst: str, src: str) -> str:
+        """``lasp:bind_to/2`` (``src/lasp.erl:201-207``)."""
+        out = self.graph.bind_to(dst, src)
+        self._maybe_propagate()
+        return out
+
+    # -- reads ---------------------------------------------------------------
+    def read(self, id: str, threshold=None) -> Watch:
+        """``lasp:read/1,2`` (``src/lasp.erl:222-235``). With no threshold
+        the default is "whatever is there" (bottom, non-strict) — note the
+        reference's ``read/1`` uses ``{strict, undefined}`` for ivars (wait
+        for a bind); pass ``Threshold(None, strict=True)`` for that."""
+        self._maybe_propagate()
+        return self.store.read(id, threshold)
+
+    def read_any(self, reads: list) -> Watch:
+        """``lasp:read_any/1`` (``src/lasp.erl:241-245``)."""
+        self._maybe_propagate()
+        return self.store.read_any(reads)
+
+    def wait_needed(self, id: str, threshold=None) -> Watch:
+        """``lasp:wait_needed/1,2`` (``src/lasp.erl:331-337``)."""
+        return self.store.wait_needed(id, threshold)
+
+    def value(self, id: str):
+        """Decoded observable value (``Type:value/1`` on a quorum read)."""
+        self._maybe_propagate()
+        return self.store.value(id)
+
+    # -- combinators ---------------------------------------------------------
+    def map(self, src: str, fn, dst: Optional[str] = None) -> str:
+        out = self.graph.map(src, fn, dst)
+        self._maybe_propagate()
+        return out
+
+    def filter(self, src: str, fn, dst: Optional[str] = None) -> str:
+        out = self.graph.filter(src, fn, dst)
+        self._maybe_propagate()
+        return out
+
+    def fold(self, src: str, fn, dst: Optional[str] = None) -> str:
+        out = self.graph.fold(src, fn, dst)
+        self._maybe_propagate()
+        return out
+
+    def union(self, left: str, right: str, dst: Optional[str] = None) -> str:
+        out = self.graph.union(left, right, dst)
+        self._maybe_propagate()
+        return out
+
+    def intersection(self, left: str, right: str, dst: Optional[str] = None) -> str:
+        out = self.graph.intersection(left, right, dst)
+        self._maybe_propagate()
+        return out
+
+    def product(self, left: str, right: str, dst: Optional[str] = None) -> str:
+        out = self.graph.product(left, right, dst)
+        self._maybe_propagate()
+        return out
+
+    def thread(self, fn, *args) -> None:
+        """``lasp:thread/3`` (``src/lasp.erl:327-329``): run a function
+        against the store (the reference spawns it on all N replicas of a
+        preflist, ``src/lasp_core.erl:231-235``; the replica axis of the
+        mesh layer plays that role here)."""
+        fn(*args)
+
+    def propagate(self) -> int:
+        """Run the dataflow graph to its fixed point now."""
+        return self.graph.propagate()
+
+    def _maybe_propagate(self):
+        if self.auto_propagate and self.graph.edges:
+            self.graph.propagate()
+
+    # -- programs (L5, src/lasp_program.erl) ---------------------------------
+    def register(self, name: str, program_cls, *args, **kwargs) -> str:
+        """``lasp:register/4`` (``src/lasp.erl:84-86``): instantiate a
+        program and run its ``init``. The reference ships source code to
+        every partition and compiles it there (``src/lasp_vnode.erl:
+        276-366``) because BEAM hot-loads code at runtime; a traced Python
+        class needs no deployment step."""
+        if name in self.programs:
+            return name  # idempotent, like the vnode's dets check
+        program = program_cls(*args, **kwargs)
+        program.init(self)
+        self.programs[name] = program
+        return name
+
+    def execute(self, name: str):
+        """``lasp:execute/2`` (``src/lasp.erl:99-111``): the program's
+        current result, decoded, after its ``value`` filter."""
+        program = self.programs[name]
+        return program.value(program.execute(self))
+
+    def process(self, object, reason, actor) -> None:
+        """``lasp:process/4`` (``src/lasp.erl:129-150``): notify every
+        registered program of an object event (the riak_kv put/delete/
+        handoff hook path)."""
+        for program in self.programs.values():
+            program.process(self, object, reason, actor)
+        self._maybe_propagate()

@@ -1,0 +1,19 @@
+"""Dataflow layer: monotone combinator graph as jitted round sweeps.
+
+TPU-native rebuild of the reference's per-edge process model
+(``src/lasp_process.erl``, combinators ``src/lasp_core.erl:434-712``) —
+see SURVEY.md §2.3/§7.3.
+"""
+
+from .edges import BindToEdge, Edge, PairwiseEdge, ProductEdge, ProjectEdge
+from .engine import Graph, PairUniverse
+
+__all__ = [
+    "BindToEdge",
+    "Edge",
+    "Graph",
+    "PairUniverse",
+    "PairwiseEdge",
+    "ProductEdge",
+    "ProjectEdge",
+]

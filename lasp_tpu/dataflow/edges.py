@@ -1,0 +1,291 @@
+"""Dataflow edges: the six monotone combinators + bind_to as dense kernels.
+
+Reference semantics (``src/lasp_core.erl:434-712``): each combinator spawns a
+long-lived process per input replica that re-reads its inputs past the last
+seen value and re-binds a recomputed output (``src/lasp_process.erl:61-95``).
+Here an edge is a *pure contribution function* ``contribution(tables,
+*src_states) -> dst_state`` evaluated for every edge in one jitted round
+sweep; the per-process recursion dissolves (SURVEY.md §2.3 note).
+
+Combinator parity map (all against ``src/lasp_core.erl``):
+
+- ``map``   (:639-667): OR-set elements map ``{X, C} -> {F(X), C}`` — token
+  causality preserved. Dense: output tokens are indexed by *(source element,
+  source token)* so that two source elements mapping to the same image never
+  conflate their tokens (the reference keeps them apart by global token
+  uniqueness); ``dst[d, s*T+t] = P[s, d] & src[s, t]`` with a host-built
+  projection matrix ``P``.
+- ``fold``  (:458-486): flat-map — ``F(X)`` returns a *list*, each image
+  carries X's causality. Same projection kernel with multi-target rows.
+- ``filter``(:679-712): keeps whole elements (tombstones included — the
+  process iterates raw state, not live value); same token space as the
+  source, host-evaluated predicate mask.
+- ``union`` (:600-627): ``orddict:merge(fun(_K, L, _R) -> L end, L, R)`` —
+  **left-biased**: a shared element's per-round contribution carries only the
+  left token dict. Output token space = concat(L tokens, R tokens).
+- ``intersection`` (:544-589): element present in both dicts (membership, not
+  liveness); causality = ``orset_causal_union`` = both token dicts
+  (``src/lasp_lattice.erl:311-312``).
+- ``product`` (:497-533): pair elements; causality = ``orset_causal_product``
+  — token pairs with ``deleted = XDel orelse YDel``
+  (``src/lasp_lattice.erl:303-309``).
+- ``bind_to`` (:434-446): identity link.
+
+G-Set variants drop the token dimension (plain membership-mask algebra).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..lattice.gset import GSetState
+from ..lattice.orset import ORSetState
+
+SET_FAMILIES = {
+    "lasp_gset": "gset",
+    "lasp_orset": "orset",
+    "lasp_orset_gbtree": "orset",
+}
+
+
+def _family(type_name: str) -> str:
+    try:
+        return SET_FAMILIES[type_name]
+    except KeyError:
+        raise TypeError(
+            f"set combinators require a set type, got {type_name!r} "
+            "(the reference's combinators likewise only handle "
+            "lasp_gset/lasp_orset, src/lasp_core.erl:497-712)"
+        ) from None
+
+
+class Edge:
+    """Base: host-side incremental table maintenance + jittable kernel."""
+
+    #: variable ids read / written
+    srcs: tuple = ()
+    dst: str = ""
+
+    def refresh(self, store) -> bool:
+        """Fold newly interned source terms into host tables; returns True if
+        anything changed (drives the refresh-to-fixpoint loop for chained
+        edges whose universes feed each other)."""
+        return False
+
+    def device_tables(self):
+        """Host tables as device arrays, passed as traced args to the round
+        function (contents change with interner growth; shapes never do)."""
+        return ()
+
+    def contribution(self, tables, *src_states):
+        raise NotImplementedError
+
+
+class ProjectEdge(Edge):
+    """map / fold / filter — one source, host function, projection tables."""
+
+    def __init__(self, kind: str, src: str, dst: str, fn, store):
+        assert kind in ("map", "fold", "filter")
+        self.kind = kind
+        self.srcs = (src,)
+        self.dst = dst
+        self.fn = fn
+        src_var = store.variable(src)
+        dst_var = store.variable(dst)
+        self.family = _family(src_var.type_name)
+        self.src_spec = src_var.spec
+        self.dst_spec = dst_var.spec
+        s_cap = src_var.spec.n_elems
+        # seen-by-*index* mask, not a position counter: product universes
+        # (PairUniverse) enumerate terms in an order that changes as their
+        # input interners grow, so positions are not stable — indices are
+        self._seen = np.zeros((s_cap,), dtype=bool)
+        if kind == "filter":
+            self._keep = np.zeros((s_cap,), dtype=bool)
+        else:
+            self._proj = np.zeros((s_cap, dst_var.spec.n_elems), dtype=bool)
+
+    def refresh(self, store) -> bool:
+        src_var = store.variable(self.srcs[0])
+        dst_var = store.variable(self.dst)
+        if len(src_var.elems) == self._seen.sum():
+            return False  # nothing interned since last refresh; skip the
+            # (possibly cross-product) term enumeration entirely
+        changed = False
+        for term in src_var.elems.terms():
+            s = src_var.elems.index_of(term)
+            if self._seen[s]:
+                continue
+            if self.kind == "filter":
+                self._keep[s] = bool(self.fn(term))
+            elif self.kind == "map":
+                self._proj[s, dst_var.elems.intern(self.fn(term))] = True
+            else:  # fold: flat-map, each image with the source causality
+                for image in self.fn(term):
+                    self._proj[s, dst_var.elems.intern(image)] = True
+            self._seen[s] = True
+            changed = True
+        return changed
+
+    def device_tables(self):
+        if self.kind == "filter":
+            return (jnp.asarray(self._keep),)
+        return (jnp.asarray(self._proj),)
+
+    def contribution(self, tables, src):
+        (table,) = tables
+        if self.family == "gset":
+            if self.kind == "filter":
+                return GSetState(mask=src.mask & table)
+            return GSetState(mask=jnp.any(table & src.mask[:, None], axis=0))
+        if self.kind == "filter":
+            return ORSetState(
+                exists=src.exists & table[:, None],
+                removed=src.removed & src.exists & table[:, None],
+            )
+        # map/fold: dst[d, s*T + t] = P[s, d] & src[s, t]
+        d_elems = self.dst_spec.n_elems
+        pt = table.T[:, :, None]  # [D, S, 1]
+        exists = (pt & src.exists[None, :, :]).reshape(d_elems, -1)
+        removed = (pt & (src.removed & src.exists)[None, :, :]).reshape(d_elems, -1)
+        return ORSetState(exists=exists, removed=removed)
+
+
+class PairwiseEdge(Edge):
+    """union / intersection — two sources aligned into the output universe
+    by host-built inverse-index tables (injective term-identity mappings, so
+    gathers instead of projection matrices)."""
+
+    def __init__(self, kind: str, left: str, right: str, dst: str, store):
+        assert kind in ("union", "intersection")
+        self.kind = kind
+        self.srcs = (left, right)
+        self.dst = dst
+        l_var, r_var = store.variable(left), store.variable(right)
+        fam_l, fam_r = _family(l_var.type_name), _family(r_var.type_name)
+        if fam_l != fam_r:
+            raise TypeError(f"{kind}: mixed set families {fam_l}/{fam_r}")
+        self.family = fam_l
+        self.l_spec, self.r_spec = l_var.spec, r_var.spec
+        self.dst_spec = store.variable(dst).spec
+        d_cap = self.dst_spec.n_elems
+        self._inv = [np.zeros((d_cap,), dtype=np.int32) for _ in range(2)]
+        self._valid = [np.zeros((d_cap,), dtype=bool) for _ in range(2)]
+        l_cap, r_cap = l_var.spec.n_elems, r_var.spec.n_elems
+        # seen-by-index masks (positions are unstable for PairUniverse srcs)
+        self._seen = [np.zeros((l_cap,), dtype=bool), np.zeros((r_cap,), dtype=bool)]
+
+    def refresh(self, store) -> bool:
+        dst_var = store.variable(self.dst)
+        changed = False
+        for side, src_id in enumerate(self.srcs):
+            elems = store.variable(src_id).elems
+            if len(elems) == self._seen[side].sum():
+                continue  # no new terms on this side
+            for term in elems.terms():
+                s = elems.index_of(term)
+                if self._seen[side][s]:
+                    continue
+                if self.kind == "union" or side == 0:
+                    d = dst_var.elems.intern(term)
+                    self._inv[side][d] = s
+                    self._valid[side][d] = True
+                else:
+                    # intersection output universe = left terms; a right term
+                    # only matters if the left ever interned it
+                    if term in dst_var.elems:
+                        d = dst_var.elems.index_of(term)
+                        self._inv[side][d] = s
+                        self._valid[side][d] = True
+                self._seen[side][s] = True
+                changed = True
+        if self.kind == "intersection" and changed:
+            # a left term interned after its right twin: re-link right side
+            r_elems = store.variable(self.srcs[1]).elems
+            for d, term in enumerate(dst_var.elems.terms()):
+                if not self._valid[1][d] and term in r_elems:
+                    self._inv[1][d] = r_elems.index_of(term)
+                    self._valid[1][d] = True
+        return changed
+
+    def device_tables(self):
+        return (
+            jnp.asarray(self._inv[0]),
+            jnp.asarray(self._valid[0]),
+            jnp.asarray(self._inv[1]),
+            jnp.asarray(self._valid[1]),
+        )
+
+    def contribution(self, tables, left, right):
+        inv_l, valid_l, inv_r, valid_r = tables
+        if self.family == "gset":
+            lrow = left.mask[inv_l] & valid_l
+            rrow = right.mask[inv_r] & valid_r
+            if self.kind == "union":
+                return GSetState(mask=lrow | rrow)
+            return GSetState(mask=lrow & rrow)
+        le = left.exists[inv_l] & valid_l[:, None]
+        lr = (left.removed & left.exists)[inv_l] & valid_l[:, None]
+        re_ = right.exists[inv_r] & valid_r[:, None]
+        rr = (right.removed & right.exists)[inv_r] & valid_r[:, None]
+        if self.kind == "union":
+            # left-biased orddict:merge: a shared element's contribution
+            # carries only the left tokens (src/lasp_core.erl:616-621)
+            lmember = jnp.any(le, axis=-1, keepdims=True)
+            exists = jnp.concatenate([le, re_ & ~lmember], axis=-1)
+            removed = jnp.concatenate([lr, rr & ~lmember], axis=-1)
+        else:
+            # membership in *both* dicts gates; causality = union of both
+            # token dicts (src/lasp_core.erl:565 + lasp_lattice.erl:311-312)
+            both = (jnp.any(le, axis=-1) & jnp.any(re_, axis=-1))[:, None]
+            exists = jnp.concatenate([le, re_], axis=-1) & both
+            removed = jnp.concatenate([lr, rr], axis=-1) & both
+        return ORSetState(exists=exists, removed=removed)
+
+
+class ProductEdge(Edge):
+    """Cartesian product; output element (x, y) at index lx*ER + ry, output
+    token (tl, tr) at tl*TR + tr — pure index arithmetic, no host tables."""
+
+    def __init__(self, left: str, right: str, dst: str, store):
+        self.srcs = (left, right)
+        self.dst = dst
+        l_var, r_var = store.variable(left), store.variable(right)
+        fam_l, fam_r = _family(l_var.type_name), _family(r_var.type_name)
+        if fam_l != fam_r:
+            raise TypeError(f"product: mixed set families {fam_l}/{fam_r}")
+        self.family = fam_l
+        self.l_spec, self.r_spec = l_var.spec, r_var.spec
+        self.dst_spec = store.variable(dst).spec
+
+    def contribution(self, tables, left, right):
+        del tables
+        if self.family == "gset":
+            return GSetState(
+                mask=(left.mask[:, None] & right.mask[None, :]).reshape(-1)
+            )
+        d = self.l_spec.n_elems * self.r_spec.n_elems
+        le = left.exists[:, None, :, None]
+        re_ = right.exists[None, :, None, :]
+        lr = left.removed[:, None, :, None]
+        rr = right.removed[None, :, None, :]
+        exists = (le & re_).reshape(d, -1)
+        # deleted = XDel orelse YDel (src/lasp_lattice.erl:303-309)
+        removed = ((le & re_) & (lr | rr)).reshape(d, -1)
+        return ORSetState(exists=exists, removed=removed)
+
+
+class BindToEdge(Edge):
+    """Identity link (``src/lasp_core.erl:434-446``): dst follows src."""
+
+    def __init__(self, src: str, dst: str, store):
+        self.srcs = (src,)
+        self.dst = dst
+        src_var, dst_var = store.variable(src), store.variable(dst)
+        if src_var.spec != dst_var.spec:
+            raise TypeError("bind_to requires identically-specced variables")
+
+    def contribution(self, tables, src):
+        del tables
+        return src

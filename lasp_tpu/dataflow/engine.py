@@ -1,0 +1,316 @@
+"""Dataflow graph engine: combinators compiled into one jitted round sweep.
+
+The reference runs one Erlang process per dataflow edge per replica, each
+blocking on a strict-threshold read and re-binding its output through the
+full quorum path (``src/lasp_process.erl:61-95``, ``src/lasp_core.erl:
+639-667``). Here the whole graph is swept synchronously: one jit-compiled
+``round(states, tables) -> (states, residual)`` evaluates every edge's
+contribution against the *current* states (Jacobi iteration), merges
+contributions into each output through the inflation gate (the ``bind``
+rule, ``src/lasp_core.erl:291-312``), and reports the number of outputs
+that strictly inflated. Because joins are associative/commutative/idempotent
+this reaches the same fixed point as the reference's asynchronous schedule;
+a depth-k pipeline converges in k rounds, detected by residual == 0 —
+replacing the reference tests' ``timer:sleep`` waits (SURVEY.md §4 caveat).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..lattice.orset import ORSetSpec
+from ..lattice.gset import GSetSpec
+from .edges import BindToEdge, Edge, PairwiseEdge, ProductEdge, ProjectEdge
+
+
+class PairUniverse:
+    """Element universe of a product output: term (x, y) <-> index
+    lx * ER + ry over the input interners — no separate allocation."""
+
+    def __init__(self, l_elems, r_elems, er_cap: int):
+        self.l_elems = l_elems
+        self.r_elems = r_elems
+        self.er_cap = er_cap
+
+    def __len__(self) -> int:
+        return len(self.l_elems) * len(self.r_elems)
+
+    def __contains__(self, term) -> bool:
+        x, y = term
+        return x in self.l_elems and y in self.r_elems
+
+    def index_of(self, term) -> int:
+        x, y = term
+        return self.l_elems.index_of(x) * self.er_cap + self.r_elems.index_of(y)
+
+    def terms(self) -> list:
+        return [(x, y) for x in self.l_elems.terms() for y in self.r_elems.terms()]
+
+    def decode_mask(self, mask) -> frozenset:
+        out = []
+        nl, nr = len(self.l_elems.terms()), len(self.r_elems.terms())
+        for i, hit in enumerate(mask):
+            if not hit:
+                continue
+            lx, ry = divmod(i, self.er_cap)
+            if lx < nl and ry < nr:
+                out.append((self.l_elems.term_of(lx), self.r_elems.term_of(ry)))
+        return frozenset(out)
+
+
+def _select(pred, a, b):
+    """Per-leaf ``where`` over same-structure pytrees (the inflation gate)."""
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+class Graph:
+    """Static combinator graph over a :class:`~lasp_tpu.store.Store`.
+
+    Mirrors the reference verb set ``map/filter/fold/union/intersection/
+    product/bind_to`` (``src/lasp.erl:252-337``); ``propagate`` replaces the
+    background process soup with explicit rounds-to-fixpoint.
+    """
+
+    def __init__(self, store):
+        self.store = store
+        self.edges: list[Edge] = []
+        self._jitted = None
+        self._round_fn_pure = None  # un-jitted round, vmapped by the mesh layer
+        self._var_ids: tuple = ()
+        self._clean_mark: tuple | None = None  # (store.mutations, n_edges)
+
+    # -- derived-output declaration -----------------------------------------
+    def _derived_orset_spec(self, n_elems: int, token_space: int) -> ORSetSpec:
+        return ORSetSpec(
+            n_elems=n_elems, n_actors=1, tokens_per_actor=1, token_space=token_space
+        )
+
+    def _ensure_output(self, dst, type_name, spec, elems):
+        """Declare (or re-layout) the output variable with the derived spec
+        dictated by the edge's input spaces."""
+        store = self.store
+        if dst is None:
+            return store.declare(type=type_name, spec=spec, elems=elems)
+        if dst in store.ids():
+            var = store.variable(dst)
+            if var.spec != spec or var.elems is not elems:
+                # an edge already wired to the old layout would keep stale
+                # projection tables / reshape against the old spec
+                for e in self.edges:
+                    if dst in e.srcs or dst == e.dst:
+                        raise RuntimeError(
+                            f"cannot re-layout {dst}: already wired into a "
+                            f"dataflow edge; declare a fresh output instead"
+                        )
+                store.redeclare_derived(dst, type_name, spec, elems)
+            return dst
+        return store.declare(id=dst, type=type_name, spec=spec, elems=elems)
+
+    def _add(self, edge: Edge) -> str:
+        self.edges.append(edge)
+        self._jitted = None
+        return edge.dst
+
+    # -- combinator verbs ---------------------------------------------------
+    def map(self, src: str, fn, dst: str | None = None, dst_elems: int | None = None):
+        """``lasp:map/3`` (``src/lasp.erl:282-285``)."""
+        return self._project("map", src, fn, dst, dst_elems)
+
+    def fold(self, src: str, fn, dst: str | None = None, dst_elems: int | None = None):
+        """``lasp:fold/3`` — flat-map (``src/lasp.erl:270-273``)."""
+        return self._project("fold", src, fn, dst, dst_elems)
+
+    def filter(self, src: str, fn, dst: str | None = None):
+        """``lasp:filter/3`` (``src/lasp.erl:258-261``)."""
+        return self._project("filter", src, fn, dst, None)
+
+    def _project(self, kind, src, fn, dst, dst_elems):
+        store = self.store
+        src_var = store.variable(src)
+        spec = src_var.spec
+        if isinstance(spec, ORSetSpec):
+            if kind == "filter":
+                out_spec = dataclasses.replace(spec, token_space=spec.n_tokens)
+            else:
+                d_elems = dst_elems or spec.n_elems * (4 if kind == "fold" else 1)
+                out_spec = self._derived_orset_spec(
+                    d_elems, spec.n_elems * spec.n_tokens
+                )
+        elif isinstance(spec, GSetSpec):
+            d_elems = (
+                spec.n_elems
+                if kind == "filter"
+                else dst_elems or spec.n_elems * (4 if kind == "fold" else 1)
+            )
+            out_spec = GSetSpec(n_elems=d_elems)
+        else:
+            raise TypeError(f"{kind}: unsupported spec {spec!r}")
+        if kind == "filter":
+            elems = src_var.elems  # same universe, shared interner
+        else:
+            from ..utils.interning import Interner
+
+            elems = Interner(out_spec.n_elems, kind="element")
+        dst = self._ensure_output(dst, src_var.type_name, out_spec, elems)
+        return self._add(ProjectEdge(kind, src, dst, fn, store))
+
+    def union(self, left: str, right: str, dst: str | None = None):
+        """``lasp:union/3`` (``src/lasp.erl:306-309``)."""
+        return self._pairwise("union", left, right, dst)
+
+    def intersection(self, left: str, right: str, dst: str | None = None):
+        """``lasp:intersection/3`` (``src/lasp.erl:294-297``)."""
+        return self._pairwise("intersection", left, right, dst)
+
+    def _pairwise(self, kind, left, right, dst):
+        store = self.store
+        l_var, r_var = store.variable(left), store.variable(right)
+        ls, rs = l_var.spec, r_var.spec
+        from ..utils.interning import Interner
+
+        if isinstance(ls, ORSetSpec):
+            if kind == "union":
+                out_spec = self._derived_orset_spec(
+                    ls.n_elems + rs.n_elems, ls.n_tokens + rs.n_tokens
+                )
+            else:
+                out_spec = self._derived_orset_spec(
+                    ls.n_elems, ls.n_tokens + rs.n_tokens
+                )
+        else:
+            n = ls.n_elems + rs.n_elems if kind == "union" else ls.n_elems
+            out_spec = GSetSpec(n_elems=n)
+        elems = Interner(out_spec.n_elems, kind="element")
+        dst = self._ensure_output(dst, l_var.type_name, out_spec, elems)
+        return self._add(PairwiseEdge(kind, left, right, dst, store))
+
+    def product(self, left: str, right: str, dst: str | None = None):
+        """``lasp:product/3`` (``src/lasp.erl:318-321``)."""
+        store = self.store
+        l_var, r_var = store.variable(left), store.variable(right)
+        ls, rs = l_var.spec, r_var.spec
+        if isinstance(ls, ORSetSpec):
+            out_spec = self._derived_orset_spec(
+                ls.n_elems * rs.n_elems, ls.n_tokens * rs.n_tokens
+            )
+        else:
+            out_spec = GSetSpec(n_elems=ls.n_elems * rs.n_elems)
+        elems = PairUniverse(l_var.elems, r_var.elems, rs.n_elems)
+        dst = self._ensure_output(dst, l_var.type_name, out_spec, elems)
+        return self._add(ProductEdge(left, right, dst, store))
+
+    def bind_to(self, dst: str, src: str):
+        """``lasp:bind_to/2`` — dst follows src (``src/lasp.erl:201-207``).
+        Argument order mirrors the reference (target first)."""
+        store = self.store
+        src_var = store.variable(src)
+        dst = self._ensure_output(
+            dst, src_var.type_name, src_var.spec, src_var.elems
+        )
+        dst_var = store.variable(dst)
+        if dst_var.ivar_payloads is not None and (
+            dst_var.ivar_payloads is not src_var.ivar_payloads
+        ):
+            # dst must adopt src's payload interner so interned ids agree;
+            # only sound while dst is still bottom (a written dst already
+            # holds ids minted against its own interner)
+            is_bottom = bool(
+                dst_var.codec.equal(
+                    dst_var.spec, dst_var.state, dst_var.codec.new(dst_var.spec)
+                )
+            )
+            if not is_bottom:
+                raise RuntimeError(
+                    f"bind_to: {dst} already holds a value minted against its "
+                    "own payload universe; bind_to requires a bottom target"
+                )
+            dst_var.ivar_payloads = src_var.ivar_payloads
+        return self._add(BindToEdge(src, dst, store))
+
+    # -- round compilation ---------------------------------------------------
+    def refresh(self) -> None:
+        """Host pass: fold newly interned terms into edge tables, looping
+        until universes stop growing (chained edges feed each other)."""
+        for _ in range(len(self.edges) + 2):
+            changed = [e.refresh(self.store) for e in self.edges]  # no short-circuit
+            if not any(changed):
+                return
+        raise RuntimeError("edge table refresh did not reach a fixed point")
+
+    def _meta(self, var_id):
+        var = self.store.variable(var_id)
+        return var.codec, var.spec
+
+    def _build(self):
+        edges = tuple(self.edges)
+        ids = []
+        for e in edges:
+            for v in (*e.srcs, e.dst):
+                if v not in ids:
+                    ids.append(v)
+        self._var_ids = tuple(ids)
+        meta = {v: self._meta(v) for v in ids}
+
+        def round_fn(states, tables):
+            contribs: dict[str, list] = {}
+            for e, tab in zip(edges, tables):
+                c = e.contribution(tab, *[states[s] for s in e.srcs])
+                contribs.setdefault(e.dst, []).append(c)
+            new_states = dict(states)
+            residual = jnp.zeros((), dtype=jnp.int32)
+            for dst, cs in contribs.items():
+                codec, spec = meta[dst]
+                cur = states[dst]
+                new = cur
+                for c in cs:
+                    merged = codec.merge(spec, new, c)
+                    # inflation gate = bind rule (src/lasp_core.erl:301-311)
+                    new = _select(codec.is_inflation(spec, new, merged), merged, new)
+                residual += codec.is_strict_inflation(spec, cur, new).astype(
+                    jnp.int32
+                )
+                new_states[dst] = new
+            return new_states, residual
+
+        self._round_fn_pure = round_fn
+        self._jitted = jax.jit(round_fn)
+
+    def propagate(self, max_rounds: int | None = None) -> int:
+        """Run jitted rounds to the fixed point; ingest results back into the
+        store (waking threshold watches). Returns the number of rounds that
+        performed work. Replaces every ``timer:sleep`` in the reference test
+        suite with a convergence predicate (SURVEY.md §4)."""
+        if not self.edges:
+            return 0
+        if self._clean_mark == (self.store.mutations, len(self.edges)):
+            return 0  # nothing written since the last fixed point
+        self.refresh()
+        if self._jitted is None:
+            self._build()
+        tables = tuple(e.device_tables() for e in self.edges)
+        states = {v: self.store.state(v) for v in self._var_ids}
+        limit = max_rounds if max_rounds is not None else len(self.edges) + 1
+        rounds = 0
+        for _ in range(limit):
+            states, residual = self._jitted(states, tables)
+            if int(residual) == 0:
+                break
+            rounds += 1
+        else:
+            raise RuntimeError(
+                f"dataflow did not converge within {limit} rounds "
+                "(cyclic graph? raise max_rounds)"
+            )
+        pre_ingest = self.store.mutations
+        writes = self.store.ingest(states)
+        if self.store.mutations == pre_ingest + writes:
+            # ingest's own writes ARE the fixed point — mark clean
+            self._clean_mark = (self.store.mutations, len(self.edges))
+        else:
+            # a watch callback wrote during ingest; stay dirty so the next
+            # propagate folds that write in
+            self._clean_mark = None
+        return rounds

@@ -45,9 +45,15 @@ class ORSetSpec:
     n_elems: int
     n_actors: int
     tokens_per_actor: int = 4
+    #: explicit token-space size for *derived* variables (combinator outputs),
+    #: whose tokens are projections/products of their inputs' token spaces
+    #: rather than actor-minted slots; None = n_actors * tokens_per_actor.
+    token_space: int | None = None
 
     @property
     def n_tokens(self) -> int:
+        if self.token_space is not None:
+            return self.token_space
         return self.n_actors * self.tokens_per_actor
 
 

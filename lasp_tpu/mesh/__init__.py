@@ -1,0 +1,28 @@
+"""Mesh layer: replication, gossip/anti-entropy, quorum reads, coverage
+queries, and failure injection over a leading replica axis sharded across
+device meshes — the TPU rebuild of the reference's riak_core distribution
+layer and request-coordination FSMs (SURVEY.md §2.5/§2.6/§7.4)."""
+
+from .gossip import converged, divergence, gossip_round, join_all, quorum_read
+from .runtime import ReplicatedRuntime
+from .topology import (
+    edge_failure_mask,
+    partition_mask,
+    random_regular,
+    ring,
+    scale_free,
+)
+
+__all__ = [
+    "ReplicatedRuntime",
+    "converged",
+    "divergence",
+    "edge_failure_mask",
+    "gossip_round",
+    "join_all",
+    "partition_mask",
+    "quorum_read",
+    "random_regular",
+    "ring",
+    "scale_free",
+]

@@ -1,0 +1,95 @@
+"""Gossip / anti-entropy kernels over a leading replica axis.
+
+The reference's anti-entropy is read-repair inside every update/bind FSM:
+finalize merges the N replica replies and rewrites divergent replicas
+(``src/lasp_update_fsm.erl:189-216``). Because the join is associative,
+commutative, and idempotent, *any* schedule of pairwise joins converges to
+the same fixed point — so the TPU build runs bulk-synchronous gossip rounds:
+every replica gathers its neighbors' states and joins them in, all replicas
+at once, one fused XLA computation.
+
+Sharding: these functions are shape-polymorphic over the leading replica
+axis and contain only gathers + elementwise joins, so under ``jit`` with a
+``NamedSharding`` that splits the replica axis over the mesh, XLA inserts
+the ICI collectives (all-to-all for the gather on random topologies; for
+ring topologies the gather is a constant shift and lowers to ``ppermute``
+— the ``mesh_comm`` design of SURVEY.md §5)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_where(pred, a, b):
+    """Leaf-wise select with ``pred`` broadcast from the left (pred has the
+    replica axis; leaves have trailing state dims)."""
+
+    def sel(x, y):
+        p = pred.reshape(pred.shape + (1,) * (x.ndim - pred.ndim))
+        return jnp.where(p, x, y)
+
+    return jax.tree_util.tree_map(sel, a, b)
+
+
+def gossip_round(codec, spec, states, neighbors, edge_mask=None):
+    """One pull-gossip round: ``new[r] = join(state[r], state[n])`` for each
+    ``n`` in ``neighbors[r, :]``. ``edge_mask: bool[R, K]`` (True = alive)
+    injects failures; a dead edge contributes the replica's own state (a
+    no-op, thanks to idempotence)."""
+    vmerge = jax.vmap(lambda a, b: codec.merge(spec, a, b))
+    acc = states
+    for k in range(neighbors.shape[1]):
+        nbr = jax.tree_util.tree_map(lambda x: x[neighbors[:, k]], states)
+        if edge_mask is not None:
+            nbr = _tree_where(edge_mask[:, k], nbr, states)
+        acc = vmerge(acc, nbr)
+    return acc
+
+
+def join_all(codec, spec, states):
+    """Full join over the replica axis — the coverage-query merge
+    (``src/lasp_execute_coverage_fsm.erl:57-71``) and the quorum-merge
+    operator. Log-depth halving; odd lengths pad by duplicating the last
+    replica, which idempotence makes a no-op."""
+    n = jax.tree_util.tree_leaves(states)[0].shape[0]
+    vmerge = jax.vmap(lambda a, b: codec.merge(spec, a, b))
+    while n > 1:
+        if n % 2:
+            states = jax.tree_util.tree_map(
+                lambda x: jnp.concatenate([x, x[-1:]], axis=0), states
+            )
+            n += 1
+        half = n // 2
+        lo = jax.tree_util.tree_map(lambda x: x[:half], states)
+        hi = jax.tree_util.tree_map(lambda x: x[half:], states)
+        states = vmerge(lo, hi)
+        n = half
+    return jax.tree_util.tree_map(lambda x: x[0], states)
+
+
+def quorum_read(codec, spec, states, replica_indices):
+    """Join the states of a replica subset — the R-of-N quorum read
+    (``src/lasp_read_fsm.erl:125-146`` merges first-R replies)."""
+    sub = jax.tree_util.tree_map(lambda x: x[jnp.asarray(replica_indices)], states)
+    return join_all(codec, spec, sub)
+
+
+def converged(codec, spec, states) -> jax.Array:
+    """Scalar bool: every replica equals the global join (the fixed point).
+    This is the convergence predicate that replaces the reference tests'
+    ``timer:sleep`` (SURVEY.md §4 timing caveat)."""
+    top = join_all(codec, spec, states)
+    n = jax.tree_util.tree_leaves(states)[0].shape[0]
+    eq = jax.vmap(
+        lambda s: codec.equal(spec, s, top)
+    )(states)
+    return jnp.all(eq)
+
+
+def divergence(codec, spec, states) -> jax.Array:
+    """Number of replicas not yet at the global join — the convergence
+    residual reported by the benchmarks (rounds-to-convergence metric)."""
+    top = join_all(codec, spec, states)
+    eq = jax.vmap(lambda s: codec.equal(spec, s, top))(states)
+    return jnp.sum(~eq)

@@ -1,0 +1,192 @@
+"""Replicated runtime: the whole store × a replica population × a topology.
+
+This is the TPU rebuild of the reference's L2/L3 (vnode shards + quorum FSMs,
+SURVEY.md §2.5/§2.6): instead of one Erlang vnode per ring partition with
+FSM-coordinated quorum ops, every variable's state carries a leading replica
+axis ``[R, ...]``, client operations apply at chosen replica rows, and one
+jitted ``step`` runs (a) the local dataflow sweep vmapped over replicas —
+the per-replica combinator processes — and (b) a gossip round over the
+topology — subsuming read-repair anti-entropy (``src/lasp_update_fsm.erl:
+189-216``), replication (N-way preflists), and ring gossip in one collective.
+
+Sharding: ``shard(mesh)`` places every state on a ``jax.sharding.Mesh`` with
+the replica axis split over the ``"replicas"`` mesh axis (data parallelism
+over simulated replicas — strategy (i)/(ii) of the SURVEY census). Gossip
+gathers then ride the ICI; for ring topologies they lower to ``ppermute``.
+Element/token axes of very large variables can additionally be split over a
+``"state"`` mesh axis (the tensor-parallel analogue for this framework).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..lattice.base import replicate
+from .gossip import divergence, gossip_round, join_all
+
+
+class ReplicatedRuntime:
+    """Simulates ``n_replicas`` copies of a store + dataflow graph under a
+    gossip topology, bulk-synchronously."""
+
+    def __init__(self, store, graph, n_replicas: int, neighbors: np.ndarray):
+        self.store = store
+        self.graph = graph
+        self.n_replicas = n_replicas
+        self.neighbors = jnp.asarray(neighbors)
+        self.states: dict = {}
+        self._step = None
+        self._n_edges = -1
+        self._sync_graph()
+
+    def _sync_graph(self) -> None:
+        """Fold in edges/variables added to the graph or store after
+        construction: rebuild the round closure and replicate any
+        newly-declared variable's bottom state."""
+        graph = self.graph
+        graph.refresh()
+        if graph.edges:
+            graph._build()
+        for v in self.store.ids():
+            if v not in self.states:
+                self.states[v] = replicate(self.store.state(v), self.n_replicas)
+        self.var_ids = tuple(self.states)
+        self._n_edges = len(graph.edges)
+        self._step = None
+
+    # -- client operations ---------------------------------------------------
+    def update_at(self, replica: int, var_id: str, op: tuple, actor) -> None:
+        """Apply a store op at one replica row — the client write of the
+        reference's update path (``src/lasp_core.erl:283-287``), landing on a
+        single replica and reaching the rest via gossip.
+
+        Runs the codec op + merge + inflation gate directly on the row
+        (``lasp_core:update`` then ``bind``, :283-312) WITHOUT going through
+        ``store.update``: store-level watches must not observe (and consume
+        their one firing on) a transient single-replica view the store never
+        holds."""
+        if var_id not in self.states:
+            self._sync_graph()
+        var = self.store.variable(var_id)
+        row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
+        candidate = self.store._apply_op(var, row, op, actor)
+        merged = var.codec.merge(var.spec, row, candidate)
+        if bool(var.codec.is_inflation(var.spec, row, merged)):
+            new_row = merged
+        else:
+            new_row = row  # non-inflation silently ignored (bind rule)
+        self.states[var_id] = jax.tree_util.tree_map(
+            lambda x, r: x.at[replica].set(r), self.states[var_id], new_row
+        )
+        self.graph.refresh()
+        self._step = None  # tables may have grown
+
+    def apply_batch(self, var_id: str, fn) -> None:
+        """Device-side batched update: ``fn(states[R, ...]) -> states`` —
+        the bulk client-op kernel for large simulations (e.g.
+        ``ORSet.apply_masks`` with per-replica add/remove masks)."""
+        self.states[var_id] = fn(self.states[var_id])
+
+    # -- the step ------------------------------------------------------------
+    def _build_step(self):
+        graph = self.graph
+        edges = bool(graph.edges)
+        tables = tuple(e.device_tables() for e in graph.edges)
+        meta = {v: (self.store.variable(v).codec, self.store.variable(v).spec)
+                for v in self.var_ids}
+        flow_ids = graph._var_ids
+
+        def step(states, neighbors, edge_mask):
+            prev = states
+            if edges:
+                flow_states = {v: states[v] for v in flow_ids}
+
+                def local_round(s):
+                    new, _ = graph._round_fn_pure(s, tables)
+                    return new
+
+                swept = jax.vmap(local_round)(flow_states)
+                states = dict(states, **swept)
+            out = {}
+            residual = jnp.zeros((), dtype=jnp.int32)
+            for v in self.var_ids:
+                codec, spec = meta[v]
+                new = gossip_round(codec, spec, states[v], neighbors, edge_mask)
+                # residual measures the WHOLE step (pre-sweep -> post-gossip):
+                # comparing post-sweep would miss dataflow-only progress when
+                # replicas are already uniform, ending convergence early
+                strict = jax.vmap(
+                    lambda a, b, _codec=codec, _spec=spec: _codec.is_strict_inflation(
+                        _spec, a, b
+                    )
+                )(prev[v], new)
+                residual += jnp.sum(strict.astype(jnp.int32))
+                out[v] = new
+            return out, residual
+
+        self._step_pure = step  # un-jitted; __graft_entry__ re-jits with shardings
+        return jax.jit(step)
+
+    def step(self, edge_mask=None) -> int:
+        """One bulk-synchronous round: local dataflow sweep + gossip.
+        Returns the number of strict inflations the step produced (0 on
+        the final, quiescent round)."""
+        if self._n_edges != len(self.graph.edges):
+            self._sync_graph()
+        if self._step is None:
+            self._step = self._build_step()
+        self.states, residual = self._step(self.states, self.neighbors, edge_mask)
+        return int(residual)
+
+    def run_to_convergence(self, max_rounds: int = 10_000, edge_mask=None) -> int:
+        """Gossip until no replica strictly inflates; returns rounds taken —
+        the rounds-to-convergence benchmark metric (BASELINE.md)."""
+        for i in range(max_rounds):
+            if self.step(edge_mask) == 0:
+                return i + 1
+        raise RuntimeError(f"no convergence within {max_rounds} rounds")
+
+    # -- reads ----------------------------------------------------------------
+    def coverage_value(self, var_id: str):
+        """Global join + decode — the coverage query
+        (``src/lasp_execute_coverage_fsm.erl:78-94``)."""
+        var = self.store.variable(var_id)
+        top = join_all(var.codec, var.spec, self.states[var_id])
+        var.state, saved = top, var.state
+        try:
+            return self.store.value(var_id)
+        finally:
+            var.state = saved
+
+    def replica_value(self, var_id: str, replica: int):
+        var = self.store.variable(var_id)
+        row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
+        var.state, saved = row, var.state
+        try:
+            return self.store.value(var_id)
+        finally:
+            var.state = saved
+
+    def divergence(self, var_id: str) -> int:
+        var = self.store.variable(var_id)
+        return int(divergence(var.codec, var.spec, self.states[var_id]))
+
+    # -- sharding -------------------------------------------------------------
+    def shard(self, mesh: jax.sharding.Mesh, axis: str = "replicas") -> None:
+        """Distribute every variable's replica axis over a mesh axis; states
+        move device-side and the jitted step computes with XLA-inserted
+        collectives over ICI (SURVEY.md §2.5 communication-backend table)."""
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(axis)
+        )
+        self.states = {
+            v: jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), self.states[v]
+            )
+            for v in self.var_ids
+        }
+        self.neighbors = jax.device_put(
+            self.neighbors, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(axis, None))
+        )

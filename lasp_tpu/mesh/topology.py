@@ -1,0 +1,92 @@
+"""Gossip topologies: who merges with whom, as dense neighbor index arrays.
+
+The reference's "topology" is riak_core's consistent-hash ring + preflists
+(``src/lasp.erl:345-366``) carried over disterl; anti-entropy happens via
+read-repair on the N-replica preflist (``src/lasp_update_fsm.erl:189-216``).
+The TPU build generalizes this to explicit gossip graphs over the simulated
+replica population (SURVEY.md §2.5 parallelism census / BASELINE configs:
+random and scale-free gossip): a topology is ``neighbors: int32[R, K]`` —
+replica ``r`` pulls-and-joins the states of ``neighbors[r, :]`` each round.
+
+All builders are deterministic (seeded) and vectorized so 10M-replica
+topologies build in seconds on host. Because the join is idempotent, a
+replica listed twice (or listing itself) is harmless — builders exploit this
+instead of rejection-sampling for distinctness.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def ring(n_replicas: int, k: int = 2) -> np.ndarray:
+    """Ring topology: neighbor ``j`` of replica ``r`` is ``r + offset`` with
+    offsets +1, -1, +2, -2, ... — the ICI-friendliest layout (every edge is a
+    constant shift, so a sharded gossip round lowers to ``ppermute``)."""
+    offsets = []
+    step = 1
+    while len(offsets) < k:
+        offsets.append(step)
+        if len(offsets) < k:
+            offsets.append(-step)
+        step += 1
+    r = np.arange(n_replicas, dtype=np.int64)
+    cols = [(r + off) % n_replicas for off in offsets]
+    return np.stack(cols, axis=1).astype(np.int32)
+
+
+def random_regular(n_replicas: int, k: int = 3, seed: int = 0) -> np.ndarray:
+    """``k`` independent random permutations: every replica pulls from k
+    peers AND is pulled by exactly k peers per round. The BASELINE "random
+    gossip" config.
+
+    Design note: naive iid neighbor sampling leaves ``Θ(R·e^-k)`` replicas
+    that *nobody* pulls — under pull-only gossip on a static digraph their
+    writes would never disseminate (information flows strictly along pull
+    edges). Permutation backbones make the digraph k-in/k-out regular,
+    strongly connected w.h.p., with logarithmic diameter — the property the
+    convergence guarantee (and the rounds-to-convergence benchmark) rests
+    on."""
+    rng = np.random.RandomState(seed)
+    cols = [rng.permutation(n_replicas) for _ in range(k)]
+    return np.stack(cols, axis=1).astype(np.int32)
+
+
+def scale_free(
+    n_replicas: int, k: int = 3, seed: int = 0, alpha: float = 1.0
+) -> np.ndarray:
+    """Hub-heavy topology, the BASELINE "scale-free gossip" config: slot 0
+    is a random-permutation backbone (connectivity — see
+    :func:`random_regular`); the remaining ``k-1`` slots pull from replicas
+    sampled with power-law (Zipf ``alpha``) popularity ∝ ``(i+1)**-alpha``,
+    giving hubs enormous in-degree. Vectorized inverse-CDF sampling scales
+    to 10M replicas."""
+    rng = np.random.RandomState(seed)
+    backbone = rng.permutation(n_replicas).astype(np.int64)
+    weights = (np.arange(1, n_replicas + 1, dtype=np.float64)) ** -alpha
+    cdf = np.cumsum(weights)
+    cdf /= cdf[-1]
+    u = rng.random_sample(size=(n_replicas, max(k - 1, 0)))
+    hubs = np.searchsorted(cdf, u)
+    return np.concatenate([backbone[:, None], hubs], axis=1).astype(np.int32)
+
+
+def edge_failure_mask(
+    n_replicas: int, k: int, drop_rate: float, seed: int = 0
+) -> np.ndarray:
+    """Failure injection (SURVEY.md §5): ``bool[R, K]`` with True = edge
+    alive. Masked edges contribute the replica's own state (a no-op join),
+    simulating message loss / partition; recovery = unmask (the rejoining
+    replica's state joins back in, exactly the reference's read-repair
+    reconstruction story, ``src/lasp_vnode.erl:454-472`` stub + repair)."""
+    rng = np.random.RandomState(seed)
+    return rng.random_sample(size=(n_replicas, k)) >= drop_rate
+
+
+def partition_mask(
+    n_replicas: int, neighbors: np.ndarray, n_groups: int
+) -> np.ndarray:
+    """Network partition: only edges within the same contiguous group stay
+    alive. Heal by swapping the mask out."""
+    group = (np.arange(n_replicas) * n_groups) // n_replicas
+    return group[:, None] == group[neighbors]

@@ -56,6 +56,17 @@ DEFAULT_SPECS = {
     "riak_dt_gcounter": lambda n_actors=16, **kw: GCounterSpec(n_actors=n_actors),
 }
 
+#: capacity kwargs each type's declare() accepts; anything else is a loud
+#: TypeError (a typo'd capacity would otherwise surface much later as a
+#: CapacityError far from the declaration site)
+ALLOWED_CAPS = {
+    "lasp_ivar": set(),
+    "lasp_gset": {"n_elems"},
+    "lasp_orset": {"n_elems", "n_actors", "tokens_per_actor"},
+    "lasp_orset_gbtree": {"n_elems", "n_actors", "tokens_per_actor"},
+    "riak_dt_gcounter": {"n_actors"},
+}
+
 
 class PreconditionError(RuntimeError):
     """Mirror of ``{error, {precondition, {not_present, Elem}}}``
@@ -102,6 +113,9 @@ class Variable:
     lazy: list = dataclasses.field(default_factory=list)
     elems: Optional[Interner] = None
     ivar_payloads: Optional[Interner] = None
+    #: per-variable writer universe, sized to spec.n_actors so overflow is a
+    #: loud CapacityError instead of a silently-dropped out-of-bounds scatter
+    actors: Optional[Interner] = None
 
 
 class Store:
@@ -110,30 +124,84 @@ class Store:
 
     def __init__(self, n_actors: int = 16):
         self._vars: dict[str, Variable] = {}
-        self.actors = Interner(n_actors, kind="actor")
-        self.n_actors = n_actors
+        self.n_actors = n_actors  # default per-variable writer capacity
         self._id_counter = itertools.count()
         self.metrics = {"binds": 0, "inflations": 0, "ignored_binds": 0, "reads": 0}
+        #: bumped on every effective write; lets the dataflow engine skip
+        #: propagation when nothing changed since its last fixed point
+        self.mutations = 0
 
     # -- declare ------------------------------------------------------------
-    def declare(self, id: Optional[str] = None, type: str = "lasp_ivar", **caps) -> str:
+    def declare(
+        self,
+        id: Optional[str] = None,
+        type: str = "lasp_ivar",
+        spec: Any = None,
+        elems: Any = None,
+        **caps,
+    ) -> str:
         """Idempotent declare (``src/lasp_core.erl:209-218``). ``caps`` sizes
-        the dense universes (n_elems / n_actors / tokens_per_actor)."""
+        the dense universes (n_elems / n_actors / tokens_per_actor);
+        alternatively an explicit ``spec`` (and element-universe object) may
+        be supplied — the dataflow layer declares combinator outputs this way
+        with derived token spaces."""
         if id is None:
             id = f"v{next(self._id_counter)}"  # deterministic, replaces druuid:v4
         if id in self._vars:
             return id
         codec = get_type(type)
-        caps.setdefault("n_actors", self.n_actors)
-        spec = DEFAULT_SPECS[type](**caps)
+        if spec is None:
+            allowed = ALLOWED_CAPS[type]
+            unknown = set(caps) - allowed
+            if unknown:
+                raise TypeError(
+                    f"declare({type}): unknown capacity kwargs {sorted(unknown)} "
+                    f"(allowed: {sorted(allowed)})"
+                )
+            if "n_actors" in allowed:
+                caps.setdefault("n_actors", self.n_actors)
+            spec = DEFAULT_SPECS[type](**caps)
         var = Variable(
             id=id, type_name=type, codec=codec, spec=spec, state=codec.new(spec)
         )
-        if hasattr(spec, "n_elems"):
+        if elems is not None:
+            var.elems = elems
+        elif hasattr(spec, "n_elems"):
             var.elems = Interner(spec.n_elems, kind="element")
+        if hasattr(spec, "n_actors"):
+            var.actors = Interner(spec.n_actors, kind="actor")
         if type == "lasp_ivar":
             var.ivar_payloads = Interner(2**31 - 1, kind="ivar payload")
         self._vars[id] = var
+        return id
+
+    def redeclare_derived(self, id: str, type: str, spec: Any, elems: Any) -> str:
+        """Replace a (still-bottom) variable's codec layout with a derived
+        spec/universe. The dataflow layer calls this when an edge is attached
+        to an output the user declared with default capacities — the output's
+        token space is dictated by its inputs' spaces, not by actor pools.
+        Refuses once the variable holds a non-bottom value or has watchers."""
+        var = self._vars[id]
+        if var.waiting or var.lazy:
+            raise RuntimeError(f"cannot redeclare {id}: watchers attached")
+        if not bool(var.codec.equal(var.spec, var.state, var.codec.new(var.spec))):
+            raise RuntimeError(f"cannot redeclare {id}: already written")
+        codec = get_type(type)
+        var.type_name = type
+        var.codec = codec
+        var.spec = spec
+        var.state = codec.new(spec)
+        var.elems = elems
+        # keep auxiliary universes consistent with the new type (declare()
+        # parity): an ivar needs a payload interner, other types none
+        var.ivar_payloads = (
+            Interner(2**31 - 1, kind="ivar payload") if type == "lasp_ivar" else None
+        )
+        var.actors = (
+            Interner(spec.n_actors, kind="actor")
+            if hasattr(spec, "n_actors")
+            else None
+        )
         return id
 
     def variable(self, id: str) -> Variable:
@@ -158,10 +226,14 @@ class Store:
         codec, spec = var.codec, var.spec
         verb = op[0]
         if var.type_name in ("lasp_orset", "lasp_orset_gbtree"):
-            a = self.actors.intern(actor)
+            # only adds mint tokens and need a writer slot; removes (and
+            # add_by_token) must work on derived outputs whose actor pool
+            # is vestigial (n_actors=1, token_space-overridden)
             if verb == "add":
+                a = var.actors.intern(actor)
                 return codec.add(spec, state, var.elems.intern(op[1]), a)
             if verb == "add_all":
+                a = var.actors.intern(actor)
                 for e in op[1]:
                     state = codec.add(spec, state, var.elems.intern(e), a)
                 return state
@@ -187,7 +259,7 @@ class Store:
         elif var.type_name == "riak_dt_gcounter":
             if verb == "increment":
                 by = op[1] if len(op) > 1 else 1
-                return codec.increment(spec, state, self.actors.intern(actor), by)
+                return codec.increment(spec, state, var.actors.intern(actor), by)
         elif var.type_name == "lasp_ivar":
             if verb == "set":
                 return codec.set(spec, state, var.ivar_payloads.intern(op[1]))
@@ -215,17 +287,42 @@ class Store:
         self._write(self._vars[id], state)
         return state
 
+    def ingest(self, new_states: dict) -> int:
+        """Write back a batch of post-round states from the dataflow engine
+        through the watch-waking write path. Each write MERGES into the
+        current state rather than overwriting: a watch callback fired
+        earlier in this very loop may have advanced a later variable past
+        the snapshot the round computed from, and a raw overwrite would
+        roll that back non-monotonically. Returns the number of direct
+        writes performed (watch callbacks may add more)."""
+        writes = 0
+        for id, state in new_states.items():
+            var = self._vars[id]
+            merged = var.codec.merge(var.spec, var.state, state)
+            if not bool(var.codec.equal(var.spec, var.state, merged)):
+                self._write(var, merged)
+                writes += 1
+        return writes
+
     def _write(self, var: Variable, state):
         """``write/4``: store then wake satisfied waiting readers
         (``src/lasp_core.erl:838-844`` + ``reply_to_all`` :774-794)."""
         var.state = state
+        self.mutations += 1
+        # snapshot: watch callbacks may retire siblings (read_any) or park
+        # new watches on this same variable while we iterate
+        pending = var.waiting
+        var.waiting = []
         still = []
-        for watch in var.waiting:
-            if bool(var.codec.threshold_met(var.spec, state, watch.threshold)):
-                watch.fire((var.id, var.type_name, state))
+        for watch in pending:
+            if watch.done:
+                continue  # retired by a sibling's callback mid-loop
+            if bool(var.codec.threshold_met(var.spec, var.state, watch.threshold)):
+                watch.fire((var.id, var.type_name, var.state))
             else:
                 still.append(watch)
-        var.waiting = still
+        # watches parked during callbacks come after the survivors
+        var.waiting = still + var.waiting
 
     # -- read ---------------------------------------------------------------
     def _resolve_threshold(self, var: Variable, threshold) -> Threshold:
@@ -263,10 +360,17 @@ class Store:
         (``src/lasp_core.erl:369-420``): one shared watch parked on every
         unmet variable; the first write meeting any threshold fires it."""
         shared = Watch("read", None, None)
+        # every read signals interest to lazy producers BEFORE any early
+        # return — the reference's read_any performs the wait_needed
+        # notification for every id read (src/lasp_core.erl:348-349)
+        resolved = []
         for id, threshold in reads:
             var = self._vars[id]
             thr = self._resolve_threshold(var, threshold)
             self._offer_to_lazy(var, thr)
+            resolved.append((id, thr))
+        for id, thr in resolved:
+            var = self._vars[id]
             if bool(var.codec.threshold_met(var.spec, var.state, thr)):
                 shared.fire((id, var.type_name, var.state))
                 return shared
@@ -277,15 +381,16 @@ class Store:
                 return
             shared.fire(result)
             # retire sibling proxies so they stop being re-evaluated on
-            # every later write (and can be GC'd)
+            # every later write (and can be GC'd); mark done so an
+            # in-flight _write sweep skips them too
             for other_id, proxy in proxies:
+                proxy.done = True
                 other_var = self._vars[other_id]
                 if proxy in other_var.waiting:
                     other_var.waiting.remove(proxy)
 
-        for id, threshold in reads:
+        for id, thr in resolved:
             var = self._vars[id]
-            thr = self._resolve_threshold(var, threshold)
             proxy = Watch("read", id, thr, callback=_fire_shared)
             proxies.append((id, proxy))
             var.waiting.append(proxy)
@@ -346,12 +451,7 @@ class Store:
         """Decoded observable value (``Type:value/1``) as host Python data."""
         var = self._vars[id]
         state = var.state
-        if var.type_name in ("lasp_orset", "lasp_orset_gbtree"):
-            import numpy as np
-
-            mask = np.asarray(var.codec.value(var.spec, state))
-            return var.elems.decode_mask(mask)
-        if var.type_name == "lasp_gset":
+        if var.type_name in ("lasp_orset", "lasp_orset_gbtree", "lasp_gset"):
             import numpy as np
 
             mask = np.asarray(var.codec.value(var.spec, state))

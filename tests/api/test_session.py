@@ -1,0 +1,108 @@
+"""API-facade tests mirroring ``riak_test/lasp_bind_test.erl`` (ivar bind /
+bind_to / wait_needed / read_any) and ``riak_test/lasp_monotonic_read_test``
+(threshold reads), plus the program registry
+(``riak_test/lasp_programs_test.erl`` shape)."""
+
+import pytest
+
+from lasp_tpu import Session
+from lasp_tpu.lattice import GSet, GSetSpec, Threshold
+from lasp_tpu.programs import ExampleKeylistProgram, ExampleProgram
+
+
+def test_ivar_bind_and_read():
+    # lasp_bind_test: declare, bind, read; re-bind same value idempotent
+    s = Session()
+    v = s.declare("lasp_ivar")
+    w = s.read(v, Threshold(None, strict=True))  # wait-for-defined
+    assert not w.done
+    s.update(v, ("set", 42), "actor")
+    assert w.done
+    assert s.value(v) == 42
+    s.update(v, ("set", 42), "actor")  # same value: fine
+    assert s.value(v) == 42
+    # conflicting bind is silently ignored (src/lasp_core.erl:305-311)
+    s.update(v, ("set", 99), "actor")
+    assert s.value(v) == 42
+
+
+def test_ivar_dataflow_chain():
+    # lasp_bind_test dataflow: i1 -> i2 -> i3 via bind_to
+    s = Session()
+    i1 = s.declare("lasp_ivar")
+    i2 = s.declare("lasp_ivar")
+    i3 = s.declare("lasp_ivar")
+    s.bind_to(i2, i1)
+    s.bind_to(i3, i2)
+    s.update(i1, ("set", "hello"), "a")
+    assert s.value(i3) == "hello"
+
+
+def test_wait_needed_fires_on_reader():
+    # laziness: wait_needed fires when a reader shows interest
+    # (src/lasp_core.erl:728-758)
+    s = Session()
+    v = s.declare("lasp_ivar")
+    wn = s.wait_needed(v)
+    assert not wn.done
+    s.read(v, Threshold(None, strict=True))
+    assert wn.done
+
+
+def test_read_any_first_match():
+    s = Session()
+    a = s.declare("lasp_gset", n_elems=4)
+    b = s.declare("lasp_gset", n_elems=4)
+    spec = GSetSpec(n_elems=4)
+    thr = Threshold(GSet.new(spec), strict=True)  # any growth
+    w = s.read_any([(a, thr), (b, thr)])
+    assert not w.done
+    s.update(b, ("add", "x"), "actor")
+    assert w.done
+    assert w.result[0] == b
+
+
+def test_monotonic_threshold_read():
+    # lasp_monotonic_read_test: counter passes numeric thresholds in order
+    s = Session()
+    c = s.declare("riak_dt_gcounter")
+    w5 = s.read(c, Threshold(5))
+    for i in range(4):
+        s.update(c, ("increment",), f"client{i}")
+    assert not w5.done
+    s.update(c, ("increment", 2), "client4")
+    assert w5.done
+    assert s.value(c) == 6
+
+
+def test_combinator_verbs_roundtrip():
+    s = Session()
+    src = s.declare("lasp_orset", n_elems=8)
+    s.update(src, ("add_all", [1, 2, 3, 4]), "a")
+    doubled = s.map(src, lambda x: x * 2)
+    evens = s.filter(src, lambda x: x % 2 == 0)
+    assert s.value(doubled) == frozenset({2, 4, 6, 8})
+    assert s.value(evens) == frozenset({2, 4})
+    other = s.declare("lasp_orset", n_elems=8)
+    s.update(other, ("add_all", [3, 4, 5]), "a")
+    assert s.value(s.union(src, other)) == frozenset({1, 2, 3, 4, 5})
+    assert s.value(s.intersection(src, other)) == frozenset({3, 4})
+
+
+def test_program_registration_and_execute():
+    # riak_test/lasp_programs_test.erl shape: register, notify, execute
+    s = Session()
+    s.register("example", ExampleProgram, n_elems=16)
+    s.register("keylist", ExampleKeylistProgram, n_elems=16)
+    s.register("example", ExampleProgram)  # idempotent re-register
+    s.process(("k1", "v1"), "put", "actor1")
+    s.process(("k2", "v2"), "put", "actor2")
+    assert s.execute("example") == frozenset({("k1", "v1"), ("k2", "v2")})
+    assert s.execute("keylist") == frozenset({"k1", "k2"})
+
+
+def test_thread_runs_function():
+    s = Session()
+    v = s.declare("lasp_gset", n_elems=4)
+    s.thread(lambda: s.update(v, ("add", "t"), "thread"))
+    assert s.value(v) == frozenset({"t"})

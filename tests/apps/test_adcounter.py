@@ -1,0 +1,114 @@
+"""End-to-end application tests: the advertisement-counter workloads.
+
+Rebuilds of ``riak_test/lasp_adcounter_test.erl`` (G-Counter per ad,
+threshold-read servers disabling ads at 5 impressions, every client ending
+with zero active ads) and ``riak_test/lasp_advertisement_counter_test.erl``
+(the full dataflow pipeline: per-publisher OR-Sets -> union -> product with
+contracts -> filter by join, servers removing exhausted ads *through the
+pipeline*). The reference drives these with processes and sleeps; here
+watches + propagate make them deterministic."""
+
+import random
+
+from lasp_tpu import Session
+from lasp_tpu.lattice import Threshold
+
+
+def test_gcounter_adcounter():
+    # riak_test/lasp_adcounter_test.erl:57-120
+    s = Session()
+    n_ads, n_clients, limit = 5, 5, 5
+    ads = [s.declare("riak_dt_gcounter", id=f"ad{i}") for i in range(n_ads)]
+    # each client tracks its own active-ad list
+    active = {c: set(ads) for c in range(n_clients)}
+
+    # one "server" watch per ad: at `limit` impressions, remove everywhere
+    def disable(ad):
+        def _cb(_result):
+            for client_ads in active.values():
+                client_ads.discard(ad)
+        return _cb
+
+    watches = {}
+    for ad in ads:
+        w = s.store.read(ad, Threshold(limit))
+        w.callback = disable(ad)
+        watches[ad] = w
+
+    rng = random.Random(42)
+    views = 0
+    while any(active.values()) and views < 500:
+        client = rng.randrange(n_clients)
+        if not active[client]:
+            continue
+        ad = rng.choice(sorted(active[client]))
+        s.update(ad, ("increment",), f"client{client}")
+        views += 1
+
+    # all ads exhausted at exactly the threshold; every client drained
+    assert [len(active[c]) for c in range(n_clients)] == [0] * n_clients
+    for ad in ads:
+        assert s.value(ad) == limit
+        assert watches[ad].done
+
+
+def test_advertisement_counter_dataflow():
+    # riak_test/lasp_advertisement_counter_test.erl:64-235, shrunk shapes
+    s = Session(n_actors=16)
+    n_per_pub, n_clients, limit = 3, 3, 3
+
+    rovio_ids = [f"r{i}" for i in range(n_per_pub)]
+    trifork_ids = [f"t{i}" for i in range(n_per_pub)]
+
+    counters = {}
+    rovio = s.declare("lasp_orset", n_elems=4)
+    trifork = s.declare("lasp_orset", n_elems=4)
+    for ad_id in rovio_ids:
+        counters[ad_id] = s.declare("riak_dt_gcounter", id=f"ctr_{ad_id}")
+        s.update(rovio, ("add", ("ad", ad_id)), "rovio")
+    for ad_id in trifork_ids:
+        counters[ad_id] = s.declare("riak_dt_gcounter", id=f"ctr_{ad_id}")
+        s.update(trifork, ("add", ("ad", ad_id)), "trifork")
+
+    contracts = s.declare("lasp_orset", n_elems=8)
+    for ad_id in rovio_ids + trifork_ids:
+        s.update(contracts, ("add", ("contract", ad_id)), "legal")
+
+    ads = s.union(rovio, trifork)
+    ads_contracts = s.product(ads, contracts)
+    ads_with_contracts = s.filter(
+        ads_contracts, lambda pair: pair[0][1] == pair[1][1]
+    )
+
+    # every ad joined with exactly its own contract
+    assert s.value(ads_with_contracts) == frozenset(
+        {(("ad", a), ("contract", a)) for a in rovio_ids + trifork_ids}
+    )
+
+    # servers: when an ad's counter passes `limit`, remove the ad from the
+    # *union output* — the removal must drain through product and filter
+    # (the reference's server does exactly this, :196-204)
+    def disable(ad_id):
+        def _cb(_result):
+            s.store.update(ads, ("remove", ("ad", ad_id)), f"server_{ad_id}")
+        return _cb
+
+    for ad_id, ctr in counters.items():
+        w = s.store.read(ctr, Threshold(limit))
+        w.callback = disable(ad_id)
+
+    rng = random.Random(7)
+    views = 0
+    while views < 500:
+        visible = s.value(ads_with_contracts)
+        if not visible:
+            break
+        (_, ad_id), _ = sorted(visible)[rng.randrange(len(visible))]
+        s.update(counters[ad_id], ("increment",), f"client{rng.randrange(n_clients)}")
+        views += 1
+
+    assert s.value(ads_with_contracts) == frozenset()
+    assert s.value(ads) == frozenset()
+    for ad_id, ctr in counters.items():
+        assert s.value(ctr) == limit  # disabled at exactly the threshold
+    assert views == limit * 2 * n_per_pub

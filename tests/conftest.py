@@ -1,15 +1,24 @@
 """Test env: force CPU with 8 virtual devices so mesh/sharding tests run
 without TPU hardware (the driver separately dry-runs the multi-chip path).
-Must run before jax is imported anywhere."""
+
+The machine's axon sitecustomize imports jax at interpreter startup and
+calls ``jax.config.update("jax_platforms", "axon,cpu")``, which overrides
+the JAX_PLATFORMS env var — so setting the env var here is NOT enough; the
+config itself must be re-updated. Unit tests must never touch the axon
+device: it is a single-client tunnel and concurrent runs deadlock on it.
+"""
 
 import os
 
-# Force, don't setdefault: the machine environment pins JAX_PLATFORMS=axon
-# (the real TPU tunnel), which must never be used by unit tests — it is a
-# single-client device and concurrent test runs deadlock on it.
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402  (must configure before any backend use)
+
+jax.config.update("jax_platforms", "cpu")
+
+assert jax.devices()[0].platform == "cpu", "tests must run on CPU devices"

@@ -1,0 +1,264 @@
+"""Combinator tests mirroring the reference riak_tests (SURVEY.md §4):
+``lasp_map_test`` / ``lasp_filter_test`` / ``lasp_fold_test`` /
+``lasp_union_test`` / ``lasp_intersection_test`` / ``lasp_product_test``,
+with ``timer:sleep`` waits replaced by ``Graph.propagate`` convergence, plus
+causality-propagation cases (removals flowing through edges) that the
+reference leaves to its EQC suite."""
+
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.store import Store
+
+SET_TYPES = ["lasp_gset", "lasp_orset"]
+REMOVABLE = ["lasp_orset"]
+
+
+def make(type_name):
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    return store, graph
+
+
+@pytest.mark.parametrize("type_name", SET_TYPES)
+def test_map_incremental(type_name):
+    # riak_test/lasp_map_test.erl:56-87: {ok, [1..6], [2,4,..,12]}
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [1, 2, 3]), "a")
+    s2 = graph.map(s1, lambda x: x * 2)
+    graph.propagate()
+    assert store.value(s2) == frozenset({2, 4, 6})
+    store.update(s1, ("add_all", [4, 5, 6]), "a")
+    graph.propagate()
+    assert store.value(s1) == frozenset({1, 2, 3, 4, 5, 6})
+    assert store.value(s2) == frozenset({2, 4, 6, 8, 10, 12})
+
+
+@pytest.mark.parametrize("type_name", SET_TYPES)
+def test_fold_flatmap(type_name):
+    # riak_test/lasp_fold_test.erl:58-90 (flat-map; dense sets dedupe the
+    # reference's list-duplication artifact, membership is what converges)
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [1, 2, 3]), "a")
+    s2 = graph.fold(s1, lambda x: [x, x + 10])
+    graph.propagate()
+    assert store.value(s2) == frozenset({1, 2, 3, 11, 12, 13})
+    store.update(s1, ("add", 4), "a")
+    graph.propagate()
+    assert store.value(s2) == frozenset({1, 2, 3, 4, 11, 12, 13, 14})
+
+
+@pytest.mark.parametrize("type_name", SET_TYPES)
+def test_filter(type_name):
+    # riak_test/lasp_filter_test.erl
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [1, 2, 3, 4, 5, 6]), "a")
+    s2 = graph.filter(s1, lambda x: x % 2 == 0)
+    graph.propagate()
+    assert store.value(s2) == frozenset({2, 4, 6})
+
+
+@pytest.mark.parametrize("type_name", SET_TYPES)
+def test_union(type_name):
+    # riak_test/lasp_union_test.erl:59-83: [1,2,3] ∪ [a,b,c]
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    s2 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [1, 2, 3]), "a")
+    store.update(s2, ("add_all", ["a", "b", "c"]), "a")
+    s3 = graph.union(s1, s2)
+    graph.propagate()
+    assert store.value(s3) == frozenset({1, 2, 3, "a", "b", "c"})
+
+
+@pytest.mark.parametrize("type_name", SET_TYPES)
+def test_intersection(type_name):
+    # riak_test/lasp_intersection_test.erl: [1,2,3] ∩ [3,4,5] = [3]
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    s2 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [1, 2, 3]), "a")
+    store.update(s2, ("add_all", [3, 4, 5]), "a")
+    s3 = graph.intersection(s1, s2)
+    graph.propagate()
+    assert store.value(s3) == frozenset({3})
+    # intersection keys off *membership order of arrival* too: element added
+    # to the right side after the edge exists still joins
+    store.update(s2, ("add", 1), "a")
+    graph.propagate()
+    assert store.value(s3) == frozenset({1, 3})
+
+
+@pytest.mark.parametrize("type_name", SET_TYPES)
+def test_product(type_name):
+    # riak_test/lasp_product_test.erl
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=4)
+    s2 = store.declare(type=type_name, n_elems=4)
+    store.update(s1, ("add_all", [1, 2]), "a")
+    store.update(s2, ("add_all", ["x", "y"]), "a")
+    s3 = graph.product(s1, s2)
+    graph.propagate()
+    assert store.value(s3) == frozenset(
+        {(1, "x"), (1, "y"), (2, "x"), (2, "y")}
+    )
+
+
+@pytest.mark.parametrize("type_name", SET_TYPES)
+def test_bind_to(type_name):
+    # bind_to identity link (src/lasp_core.erl:434-446)
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [1, 2]), "a")
+    s2 = graph.bind_to(None, s1)
+    graph.propagate()
+    assert store.value(s2) == frozenset({1, 2})
+    store.update(s1, ("add", 3), "a")
+    graph.propagate()
+    assert store.value(s2) == frozenset({1, 2, 3})
+
+
+# -- causality propagation (OR-set only) -----------------------------------
+
+
+@pytest.mark.parametrize("type_name", REMOVABLE)
+def test_map_remove_propagates(type_name):
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [1, 2, 3]), "a")
+    s2 = graph.map(s1, lambda x: x * 2)
+    graph.propagate()
+    assert store.value(s2) == frozenset({2, 4, 6})
+    store.update(s1, ("remove", 2), "a")
+    graph.propagate()
+    assert store.value(s1) == frozenset({1, 3})
+    assert store.value(s2) == frozenset({2, 6})
+
+
+@pytest.mark.parametrize("type_name", REMOVABLE)
+def test_map_collision_keeps_tokens_separate(type_name):
+    # two sources mapping onto one image: removing one source must not kill
+    # the image while the other survives — requires per-(source, token)
+    # identity exactly like the reference's globally unique tokens
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [2, 3]), "a")
+    s2 = graph.map(s1, lambda x: x // 2)  # both -> 1
+    graph.propagate()
+    assert store.value(s2) == frozenset({1})
+    store.update(s1, ("remove", 2), "a")
+    graph.propagate()
+    assert store.value(s2) == frozenset({1})  # 3 still maps to 1
+    store.update(s1, ("remove", 3), "a")
+    graph.propagate()
+    assert store.value(s2) == frozenset()
+
+
+@pytest.mark.parametrize("type_name", REMOVABLE)
+def test_filter_remove_propagates(type_name):
+    store, graph = make(type_name)
+    s1 = store.declare(type=type_name, n_elems=8)
+    store.update(s1, ("add_all", [1, 2, 3, 4]), "a")
+    s2 = graph.filter(s1, lambda x: x % 2 == 0)
+    graph.propagate()
+    assert store.value(s2) == frozenset({2, 4})
+    store.update(s1, ("remove", 2), "a")
+    graph.propagate()
+    assert store.value(s2) == frozenset({4})
+
+
+def test_union_left_bias():
+    # orddict:merge(fun(_K, L, _R) -> L end, ...) — src/lasp_core.erl:616-621:
+    # for an element present in both inputs, the contribution carries only
+    # the left causality, so "tombstoned left + live right" stays invisible
+    store, graph = make("lasp_orset")
+    s1 = store.declare(type="lasp_orset", n_elems=8)
+    s2 = store.declare(type="lasp_orset", n_elems=8)
+    store.update(s1, ("add", "x"), "a")
+    store.update(s1, ("remove", "x"), "a")  # x member-but-dead in left
+    store.update(s2, ("add", "x"), "b")  # x live in right
+    s3 = graph.union(s1, s2)
+    graph.propagate()
+    assert store.value(s3) == frozenset()
+
+
+def test_intersection_causal_union():
+    # element dead in left but member of both dicts: causal union keeps the
+    # right side's live tokens, so the element IS in the intersection value
+    # (src/lasp_core.erl:565-575 + lasp_lattice.erl:311-312)
+    store, graph = make("lasp_orset")
+    s1 = store.declare(type="lasp_orset", n_elems=8)
+    s2 = store.declare(type="lasp_orset", n_elems=8)
+    store.update(s1, ("add", "x"), "a")
+    store.update(s1, ("remove", "x"), "a")
+    store.update(s2, ("add", "x"), "b")
+    s3 = graph.intersection(s1, s2)
+    graph.propagate()
+    assert store.value(s3) == frozenset({"x"})
+
+
+def test_product_remove_propagates():
+    # deleted = XDel orelse YDel (src/lasp_lattice.erl:303-309)
+    store, graph = make("lasp_orset")
+    s1 = store.declare(type="lasp_orset", n_elems=4)
+    s2 = store.declare(type="lasp_orset", n_elems=4)
+    store.update(s1, ("add_all", [1, 2]), "a")
+    store.update(s2, ("add_all", ["x", "y"]), "a")
+    s3 = graph.product(s1, s2)
+    graph.propagate()
+    store.update(s1, ("remove", 1), "a")
+    graph.propagate()
+    assert store.value(s3) == frozenset({(2, "x"), (2, "y")})
+
+
+def test_pipeline_union_product_filter():
+    # the advertisement-counter shape: union -> product -> filter
+    # (riak_test/lasp_advertisement_counter_test.erl:107-143)
+    store, graph = make("lasp_orset")
+    ads_a = store.declare(type="lasp_orset", n_elems=4)
+    ads_b = store.declare(type="lasp_orset", n_elems=4)
+    clients = store.declare(type="lasp_orset", n_elems=4)
+    store.update(ads_a, ("add_all", ["a1", "a2"]), "pub_a")
+    store.update(ads_b, ("add", "b1"), "pub_b")
+    store.update(clients, ("add_all", ["c1", "c2"]), "srv")
+    ads = graph.union(ads_a, ads_b)
+    pairs = graph.product(ads, clients)
+    only_c1 = graph.filter(pairs, lambda xy: xy[1] == "c1")
+    rounds = graph.propagate()
+    assert rounds <= 4
+    assert store.value(only_c1) == frozenset(
+        {("a1", "c1"), ("a2", "c1"), ("b1", "c1")}
+    )
+    # disable ad a1 (remove from its publisher set) -> drains through all 3
+    store.update(ads_a, ("remove", "a1"), "pub_a")
+    graph.propagate()
+    assert store.value(only_c1) == frozenset({("a2", "c1"), ("b1", "c1")})
+
+
+def test_propagate_wakes_threshold_watch():
+    store, graph = make("lasp_orset")
+    s1 = store.declare(type="lasp_orset", n_elems=8)
+    s2 = graph.map(s1, lambda x: x + 1)
+    from lasp_tpu.lattice import ORSet, Threshold
+
+    store.update(s1, ("add", 1), "a")
+    graph.propagate()
+    # watch for any strict growth of the (already non-empty) output
+    watch = store.read(s2, Threshold(store.state(s2), strict=True))
+    assert not watch.done
+    store.update(s1, ("add", 2), "a")
+    assert not watch.done  # nothing propagated yet
+    graph.propagate()
+    assert watch.done
+
+
+def test_ivar_bind_to():
+    store, graph = make("lasp_ivar")
+    a = store.declare(type="lasp_ivar")
+    b = graph.bind_to(None, a)
+    store.update(a, ("set", "hello"), "actor")
+    graph.propagate()
+    assert store.value(b) == "hello"

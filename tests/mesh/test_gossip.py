@@ -1,0 +1,209 @@
+"""Mesh-layer tests: gossip convergence, quorum/coverage reads, failure
+injection, determinism under merge-schedule permutation (the reference
+proves this by EQC merge-commutativity, ``test/crdt_statem_eqc.erl:158-160``
+— here it is the permutation-invariance suite of SURVEY.md §5), and sharded
+execution over the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.lattice import GCounter, GCounterSpec, ORSet, ORSetSpec, replicate
+from lasp_tpu.mesh import (
+    ReplicatedRuntime,
+    converged,
+    divergence,
+    edge_failure_mask,
+    gossip_round,
+    join_all,
+    quorum_read,
+    random_regular,
+    ring,
+    scale_free,
+)
+
+
+def seeded_counter_states(n_replicas=16, n_actors=16):
+    """Each replica has incremented its own actor slot once."""
+    spec = GCounterSpec(n_actors=n_actors)
+    states = replicate(GCounter.new(spec), n_replicas)
+    eye = jnp.eye(n_actors, dtype=jnp.int32)[:n_replicas]
+    return spec, states._replace(counts=eye)
+
+
+def test_topologies_shapes_and_determinism():
+    for builder in (ring, random_regular, scale_free):
+        a = builder(100, 3)
+        b = builder(100, 3)
+        assert a.shape == (100, 3)
+        assert a.dtype == np.int32
+        np.testing.assert_array_equal(a, b)  # deterministic
+        assert a.min() >= 0 and a.max() < 100
+
+
+def test_gossip_converges_ring():
+    spec, states = seeded_counter_states()
+    nbrs = jnp.asarray(ring(16, 2))
+    rounds = 0
+    while not bool(converged(GCounter, spec, states)):
+        states = gossip_round(GCounter, spec, states, nbrs)
+        rounds += 1
+        assert rounds < 32
+    # every replica holds the full count
+    assert int(GCounter.value(spec, jax.tree_util.tree_map(lambda x: x[0], states))) == 16
+    # ring of degree 2 spreads information at distance ~2/round
+    assert rounds <= 8
+
+
+def test_gossip_converges_random_and_scale_free():
+    for topo in (random_regular(32, 3, seed=1), scale_free(32, 3, seed=1)):
+        spec, states = seeded_counter_states(32, 32)
+        nbrs = jnp.asarray(topo)
+        for _ in range(64):
+            if bool(converged(GCounter, spec, states)):
+                break
+            states = gossip_round(GCounter, spec, states, nbrs)
+        assert bool(converged(GCounter, spec, states))
+
+
+def test_gossip_schedule_permutation_invariance():
+    # the determinism suite: permuting the gossip schedule must reach the
+    # identical fixed point (join confluence)
+    spec, states0 = seeded_counter_states(8, 8)
+    topo_a = random_regular(8, 2, seed=3)
+    topo_b = topo_a[:, ::-1].copy()  # same edges, different merge order
+    sa = states0
+    sb = states0
+    for _ in range(10):
+        sa = gossip_round(GCounter, spec, sa, jnp.asarray(topo_a))
+        sb = gossip_round(GCounter, spec, sb, jnp.asarray(topo_b))
+    np.testing.assert_array_equal(np.asarray(sa.counts), np.asarray(sb.counts))
+
+
+def test_join_all_odd_and_quorum():
+    spec, states = seeded_counter_states(7, 8)
+    top = join_all(GCounter, spec, states)
+    assert int(GCounter.value(spec, top)) == 7
+    # R-of-N quorum read sees the members' writes
+    q = quorum_read(GCounter, spec, states, [0, 3, 5])
+    assert int(GCounter.value(spec, q)) == 3
+
+
+def test_failure_injection_blocks_then_heals():
+    spec, states = seeded_counter_states(8, 8)
+    nbrs = jnp.asarray(ring(8, 2))
+    dead = jnp.zeros((8, 2), dtype=bool)  # all edges down
+    for _ in range(5):
+        states = gossip_round(GCounter, spec, states, nbrs, edge_mask=dead)
+    assert int(divergence(GCounter, spec, states)) == 8  # nothing moved
+    alive = jnp.ones((8, 2), dtype=bool)
+    for _ in range(8):
+        states = gossip_round(GCounter, spec, states, nbrs, edge_mask=alive)
+    assert bool(converged(GCounter, spec, states))  # healed via join
+
+
+def test_orset_gossip_with_removals():
+    spec = ORSetSpec(n_elems=4, n_actors=8, tokens_per_actor=2)
+    n = 8
+    states = replicate(ORSet.new(spec), n)
+    # replica r adds element (r % 4) with its own actor; replica 0 then
+    # removes element 0 after observing its own add
+    def upd(r, s):
+        s1 = ORSet.add(spec, s, r % 4, r)
+        return jax.lax.cond(r == 0, lambda x: ORSet.remove(spec, x, 0), lambda x: x, s1)
+
+    states = jax.vmap(upd)(jnp.arange(n), states)
+    nbrs = jnp.asarray(ring(n, 2))
+    for _ in range(8):
+        states = gossip_round(ORSet, spec, states, nbrs)
+    assert bool(converged(ORSet, spec, states))
+    top = join_all(ORSet, spec, states)
+    live = np.asarray(ORSet.value(spec, top))
+    # element 0: replica 0's token tombstoned, but replica 4's concurrent add
+    # survives (observe-remove semantics: only observed tokens die)
+    assert list(live) == [True, True, True, True]
+
+
+class TestReplicatedRuntime:
+    def _runtime(self, n=8):
+        from lasp_tpu.store import Store
+
+        store = Store(n_actors=8)
+        graph = Graph(store)
+        s1 = store.declare(id="src", type="lasp_orset", n_elems=4)
+        s2 = graph.map(s1, lambda x: x * 10, dst="out")
+        rt = ReplicatedRuntime(store, graph, n, ring(n, 2))
+        return store, graph, rt, s1, s2
+
+    def test_update_gossip_dataflow(self):
+        store, graph, rt, s1, s2 = self._runtime()
+        rt.update_at(0, s1, ("add", 1), "a0")
+        rt.update_at(3, s1, ("add", 2), "a3")
+        rounds = rt.run_to_convergence(max_rounds=32)
+        assert rounds <= 8
+        assert rt.coverage_value(s2) == frozenset({10, 20})
+        # every replica's local dataflow output converged too
+        for r in range(rt.n_replicas):
+            assert rt.replica_value(s2, r) == frozenset({10, 20})
+
+    def test_remove_propagates_through_mesh(self):
+        store, graph, rt, s1, s2 = self._runtime()
+        rt.update_at(0, s1, ("add", 1), "a0")
+        rt.run_to_convergence(max_rounds=32)
+        # remove at a *different* replica (it has observed the add via gossip)
+        rt.update_at(5, s1, ("remove", 1), "a5")
+        rt.run_to_convergence(max_rounds=32)
+        assert rt.coverage_value(s1) == frozenset()
+        assert rt.coverage_value(s2) == frozenset()
+
+    def test_divergence_metric(self):
+        store, graph, rt, s1, s2 = self._runtime()
+        rt.update_at(0, s1, ("add", 1), "a0")
+        assert rt.divergence(s1) == 7  # everyone but replica 0 behind
+        rt.run_to_convergence(max_rounds=32)
+        assert rt.divergence(s1) == 0
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_gossip_over_device_mesh():
+    # the multi-chip path: replica axis split over an 8-device mesh; the
+    # neighbor gather rides XLA collectives (SURVEY.md §2.5 equivalence table)
+    n = 64
+    spec, states = seeded_counter_states(n, n)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("replicas",))
+    sh = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("replicas"))
+    states = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
+    nbrs = jax.device_put(
+        jnp.asarray(ring(n, 2)),
+        jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("replicas", None)),
+    )
+
+    @jax.jit
+    def one_round(s, nb):
+        return gossip_round(GCounter, spec, s, nb)
+
+    for _ in range(n):
+        states = one_round(states, nbrs)
+        if bool(converged(GCounter, spec, states)):
+            break
+    assert bool(converged(GCounter, spec, states))
+    assert int(GCounter.value(spec, jax.tree_util.tree_map(lambda x: x[0], states))) == n
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_sharded_replicated_runtime():
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=8)
+    graph = Graph(store)
+    s1 = store.declare(id="src", type="lasp_orset", n_elems=4)
+    s2 = graph.map(s1, lambda x: x + 100, dst="out")
+    n = 32
+    rt = ReplicatedRuntime(store, graph, n, ring(n, 2))
+    rt.update_at(0, s1, ("add", 7), "a0")
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("replicas",))
+    rt.shard(mesh)
+    rt.run_to_convergence(max_rounds=64)
+    assert rt.coverage_value(s2) == frozenset({107})

@@ -1,0 +1,118 @@
+"""Regression tests for review findings: watch-list mutation during write,
+ingest vs concurrent callback writes, derived-type payload universes, and
+replicated-runtime graph synchronization."""
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.lattice import GSet, GSetSpec, Threshold
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import Store
+
+
+def test_actor_overflow_raises_not_drops():
+    # a variable declared with a small writer universe must reject the
+    # (n_actors+1)-th distinct actor loudly, not silently drop the update
+    # via an out-of-bounds scatter
+    import pytest
+
+    from lasp_tpu.utils.interning import CapacityError
+
+    store = Store(n_actors=16)
+    c = store.declare(type="riak_dt_gcounter", n_actors=2)
+    store.update(c, ("increment",), "a1")
+    store.update(c, ("increment",), "a2")
+    with pytest.raises(CapacityError):
+        store.update(c, ("increment",), "a3")
+    assert store.value(c) == 2
+    o = store.declare(type="lasp_orset", n_elems=4, n_actors=2)
+    store.update(o, ("add", "x"), "w1")
+    store.update(o, ("add", "x"), "w2")
+    with pytest.raises(CapacityError):
+        store.update(o, ("add", "y"), "w3")
+    # removes on derived-style pools need no writer slot
+    store.update(o, ("remove", "x"), "w3_reader")
+    assert store.value(o) == frozenset()
+
+
+def test_declare_rejects_typoed_capacity():
+    import pytest
+
+    store = Store()
+    with pytest.raises(TypeError):
+        store.declare(type="lasp_orset", n_elem=4096)  # typo for n_elems
+
+
+def test_write_survives_sibling_retirement():
+    # a read_any proxy firing first must not make _write's sweep skip an
+    # unrelated parked watch on the same variable
+    store = Store(n_actors=4)
+    x = store.declare(type="lasp_gset", n_elems=4)
+    y = store.declare(type="lasp_gset", n_elems=4)
+    spec = GSetSpec(n_elems=4)
+    grow = Threshold(GSet.new(spec), strict=True)
+    shared = store.read_any([(x, grow), (y, grow)])
+    plain = store.read(x, grow)
+    assert not shared.done and not plain.done
+    store.update(x, ("add", "a"), "actor")
+    assert shared.done
+    assert plain.done  # previously dropped silently
+
+
+def test_ingest_preserves_callback_write():
+    # a watch callback writing to a source DURING ingest must not be rolled
+    # back by ingest's later (stale) state for that source
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    src1 = store.declare(id="src1", type="lasp_gset", n_elems=4)
+    src2 = store.declare(id="src2", type="lasp_gset", n_elems=4)
+    out1 = graph.map(src1, lambda v: v, dst="out1")
+    out2 = graph.map(src2, lambda v: v, dst="out2")
+
+    spec = GSetSpec(n_elems=4)
+    w = store.read(out1, Threshold(GSet.new(spec), strict=True))
+    w.callback = lambda res: store.update(src2, ("add", "late"), "cb")
+
+    store.update(src1, ("add", "x"), "a")
+    graph.propagate()
+    assert store.value(src2) == frozenset({"late"})  # previously clobbered
+    graph.propagate()
+    assert store.value(out2) == frozenset({"late"})
+
+
+def test_bind_to_after_retype_gets_payload_universe():
+    # dst declared as gset (still bottom) then re-laid-out to ivar by
+    # bind_to: value() must decode via a payload interner
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    src = store.declare(type="lasp_ivar")
+    dst = store.declare(id="d", type="lasp_gset", n_elems=4)
+    graph.bind_to(dst, src)
+    store.update(src, ("set", "payload"), "a")
+    graph.propagate()
+    assert store.value(dst) == "payload"
+
+
+def test_runtime_sees_edges_added_after_construction():
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    a = store.declare(id="a", type="lasp_orset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 2))
+    # edge (and output variable) added AFTER the runtime exists
+    graph.map(a, lambda x: x + 1, dst="c")
+    rt.update_at(0, a, ("add", 1), "actor")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.coverage_value("c") == frozenset({2})
+
+
+def test_update_at_does_not_consume_store_watches():
+    # replica-row updates must not fire store-level watches on a transient
+    # single-replica view
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    a = store.declare(id="a", type="lasp_gset", n_elems=4)
+    spec = GSetSpec(n_elems=4)
+    w = store.read(a, Threshold(GSet.new(spec), strict=True))
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 2))
+    rt.update_at(0, a, ("add", "x"), "actor")
+    assert not w.done  # store-level state never changed
+    var = store.variable(a)
+    assert w in var.waiting  # still parked, can fire later
