@@ -1,0 +1,125 @@
+"""Command-line console (reference L6: ``bin/lasp``/``lasp-admin`` +
+``lasp_console``, SURVEY.md §1/§2.7). Cluster-admin verbs map to their
+simulation equivalents: ``status`` (ringready/member-status) reports
+devices and convergence state; ``simulate`` runs a gossip population to
+its fixed point; ``bench`` runs the BASELINE scenarios; ``inspect``
+lists a checkpoint's contents.
+
+Usage: ``python -m lasp_tpu.cli <verb> [options]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def cmd_status(args) -> int:
+    import jax
+
+    import lasp_tpu
+
+    info = {
+        "version": lasp_tpu.__version__,
+        "jax": jax.__version__,
+        "platform": jax.devices()[0].platform,
+        "devices": [str(d) for d in jax.devices()],
+    }
+    print(json.dumps(info, indent=2))
+    return 0
+
+
+def cmd_simulate(args) -> int:
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular, ring, scale_free
+    from lasp_tpu.store import Store
+
+    topo = {"ring": ring, "random": random_regular, "scale_free": scale_free}[
+        args.topology
+    ]
+    store = Store(n_actors=max(16, args.writers))
+    var = store.declare(type=args.type, n_elems=args.elems)
+    rt = ReplicatedRuntime(
+        store, Graph(store), args.replicas, topo(args.replicas, args.fanout)
+    )
+    for w in range(args.writers):
+        replica = (w * args.replicas) // args.writers
+        rt.update_at(replica, var, ("add", f"item{w}"), f"writer{w}")
+    rounds = rt.run_to_convergence(max_rounds=args.max_rounds)
+    out = {
+        "replicas": args.replicas,
+        "topology": args.topology,
+        "rounds_to_convergence": rounds,
+        "seconds": round(rt.trace.total_seconds, 4),
+        "residual_path": [r["residual"] for r in rt.trace.rounds],
+        "value_size": len(rt.coverage_value(var)),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    import os
+    import runpy
+
+    if args.replicas:
+        os.environ["LASP_BENCH_REPLICAS"] = str(args.replicas)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    runpy.run_path(os.path.join(repo_root, "bench.py"), run_name="__main__")
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    import pickle
+
+    from lasp_tpu.store import HostStore
+
+    with HostStore(args.path) as hs:
+        manifest = hs.get("manifest")
+        out = {"stats": hs.stats(), "keys": hs.keys()}
+        if manifest is not None:
+            m = pickle.loads(manifest)
+            out["kind"] = m.get("kind")
+            out["vars"] = {
+                vid: entry["type_name"] for vid, entry in m.get("vars", {}).items()
+            }
+            if "n_replicas" in m:
+                out["n_replicas"] = m["n_replicas"]
+        print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="lasp_tpu", description=__doc__)
+    sub = p.add_subparsers(dest="verb", required=True)
+
+    sub.add_parser("status", help="devices + version (ringready analogue)")
+
+    sim = sub.add_parser("simulate", help="run a gossip population to fixpoint")
+    sim.add_argument("--replicas", type=int, default=1024)
+    sim.add_argument("--topology", choices=["ring", "random", "scale_free"],
+                     default="random")
+    sim.add_argument("--fanout", type=int, default=3)
+    sim.add_argument("--type", default="lasp_orset")
+    sim.add_argument("--elems", type=int, default=64)
+    sim.add_argument("--writers", type=int, default=8)
+    sim.add_argument("--max-rounds", type=int, default=256)
+
+    bench = sub.add_parser("bench", help="run the headline benchmark")
+    bench.add_argument("--replicas", type=int, default=0)
+
+    ins = sub.add_parser("inspect", help="list a checkpoint's contents")
+    ins.add_argument("path")
+
+    args = p.parse_args(argv)
+    return {
+        "status": cmd_status,
+        "simulate": cmd_simulate,
+        "bench": cmd_bench,
+        "inspect": cmd_inspect,
+    }[args.verb](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -66,16 +66,31 @@ class Edge:
     #: variable ids read / written
     srcs: tuple = ()
     dst: str = ""
+    #: device-array cache of the host tables; invalidated when refresh()
+    #: actually changes something, so the steady state (no new terms) pays
+    #: no host->device upload per propagate
+    _tables_cache = None
 
     def refresh(self, store) -> bool:
         """Fold newly interned source terms into host tables; returns True if
         anything changed (drives the refresh-to-fixpoint loop for chained
         edges whose universes feed each other)."""
+        changed = self._refresh(store)
+        if changed:
+            self._tables_cache = None
+        return changed
+
+    def _refresh(self, store) -> bool:
         return False
 
     def device_tables(self):
         """Host tables as device arrays, passed as traced args to the round
         function (contents change with interner growth; shapes never do)."""
+        if self._tables_cache is None:
+            self._tables_cache = self._build_device_tables()
+        return self._tables_cache
+
+    def _build_device_tables(self):
         return ()
 
     def contribution(self, tables, *src_states):
@@ -106,7 +121,7 @@ class ProjectEdge(Edge):
         else:
             self._proj = np.zeros((s_cap, dst_var.spec.n_elems), dtype=bool)
 
-    def refresh(self, store) -> bool:
+    def _refresh(self, store) -> bool:
         src_var = store.variable(self.srcs[0])
         dst_var = store.variable(self.dst)
         if len(src_var.elems) == self._seen.sum():
@@ -128,7 +143,7 @@ class ProjectEdge(Edge):
             changed = True
         return changed
 
-    def device_tables(self):
+    def _build_device_tables(self):
         if self.kind == "filter":
             return (jnp.asarray(self._keep),)
         return (jnp.asarray(self._proj),)
@@ -176,7 +191,7 @@ class PairwiseEdge(Edge):
         # seen-by-index masks (positions are unstable for PairUniverse srcs)
         self._seen = [np.zeros((l_cap,), dtype=bool), np.zeros((r_cap,), dtype=bool)]
 
-    def refresh(self, store) -> bool:
+    def _refresh(self, store) -> bool:
         dst_var = store.variable(self.dst)
         changed = False
         for side, src_id in enumerate(self.srcs):
@@ -209,7 +224,7 @@ class PairwiseEdge(Edge):
                     self._valid[1][d] = True
         return changed
 
-    def device_tables(self):
+    def _build_device_tables(self):
         return (
             jnp.asarray(self._inv[0]),
             jnp.asarray(self._valid[0]),

@@ -8,7 +8,7 @@ full quorum path (``src/lasp_process.erl:61-95``, ``src/lasp_core.erl:
 contribution against the *current* states (Jacobi iteration), merges
 contributions into each output through the inflation gate (the ``bind``
 rule, ``src/lasp_core.erl:291-312``), and reports the number of outputs
-that strictly inflated. Because joins are associative/commutative/idempotent
+whose state changed. Because joins are associative/commutative/idempotent
 this reaches the same fixed point as the reference's asynchronous schedule;
 a depth-k pipeline converges in k rounds, detected by residual == 0 —
 replacing the reference tests' ``timer:sleep`` waits (SURVEY.md §4 caveat).
@@ -96,7 +96,12 @@ class Graph:
             return store.declare(type=type_name, spec=spec, elems=elems)
         if dst in store.ids():
             var = store.variable(dst)
-            if var.spec != spec or var.elems is not elems:
+            if var.spec == spec and var.type_name == type_name:
+                # layout already matches (e.g. a checkpoint-restored output
+                # being re-wired after load): adopt universes, keep state
+                self._adopt_universe(var, elems)
+                return dst
+            if var.elems is not elems or var.spec != spec:
                 # an edge already wired to the old layout would keep stale
                 # projection tables / reshape against the old spec
                 for e in self.edges:
@@ -108,6 +113,30 @@ class Graph:
                 store.redeclare_derived(dst, type_name, spec, elems)
             return dst
         return store.declare(id=dst, type=type_name, spec=spec, elems=elems)
+
+    @staticmethod
+    def _adopt_universe(var, elems) -> None:
+        """Re-wiring an edge onto an existing same-layout output: decide
+        which element universe survives. A fresh empty Interner (map/fold/
+        union outputs mint their own) loses to the variable's existing one
+        (which may hold checkpoint-restored terms the state indexes). A
+        non-empty object (filter/bind_to share their SOURCE's interner;
+        product passes a PairUniverse derived from the sources) must be
+        adopted — after checking index agreement, because the state's bits
+        are meaningful only under aligned indices."""
+        from ..utils.interning import Interner
+
+        if elems is None or var.elems is elems:
+            return
+        if isinstance(elems, Interner) and len(elems) == 0:
+            return  # fresh mint: keep the existing (possibly restored) one
+        for term in var.elems.terms() if hasattr(var.elems, "terms") else ():
+            if term not in elems or elems.index_of(term) != var.elems.index_of(term):
+                raise RuntimeError(
+                    f"cannot adopt universe for {var.id}: existing term "
+                    f"{term!r} is missing or re-indexed in the source universe"
+                )
+        var.elems = elems
 
     def _add(self, edge: Edge) -> str:
         self.edges.append(edge)
@@ -269,9 +298,9 @@ class Graph:
                     merged = codec.merge(spec, new, c)
                     # inflation gate = bind rule (src/lasp_core.erl:301-311)
                     new = _select(codec.is_inflation(spec, new, merged), merged, new)
-                residual += codec.is_strict_inflation(spec, cur, new).astype(
-                    jnp.int32
-                )
+                # ¬equal, not strict-inflation: vclock types can change dots
+                # under equal clocks (same blindness as the mesh residual)
+                residual += (~codec.equal(spec, cur, new)).astype(jnp.int32)
                 new_states[dst] = new
             return new_states, residual
 

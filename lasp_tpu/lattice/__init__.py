@@ -11,14 +11,18 @@ from .base import CrdtType, Threshold, TypeRegistry, replicate, tree_all_equal
 from .gcounter import GCounter, GCounterSpec, GCounterState
 from .gset import GSet, GSetSpec, GSetState
 from .ivar import IVar, IVarSpec, IVarState
+from .map import CrdtMap, MapSpec, MapState
 from .orset import ORSet, ORSetSpec, ORSetState
+from .orswot import ORSWOT, ORSWOTSpec, ORSWOTState
 
 #: ``lasp_orset_gbtree`` is semantically identical to ``lasp_orset`` (same
 #: merge :134-140 / value :67-103 contract); it exists in the reference only
 #: for O(log n) host data structures, which dense tensors subsume.
 ORSetGbtree = type("ORSetGbtree", (ORSet,), {"name": "lasp_orset_gbtree"})
 
-REGISTRY = TypeRegistry(types=(IVar, GSet, ORSet, ORSetGbtree, GCounter))
+REGISTRY = TypeRegistry(
+    types=(IVar, GSet, ORSet, ORSetGbtree, GCounter, ORSWOT, CrdtMap)
+)
 
 
 def get_type(name: str):
@@ -45,6 +49,12 @@ __all__ = [
     "GCounter",
     "GCounterSpec",
     "GCounterState",
+    "ORSWOT",
+    "ORSWOTSpec",
+    "ORSWOTState",
+    "CrdtMap",
+    "MapSpec",
+    "MapState",
     "REGISTRY",
     "get_type",
 ]

@@ -1,0 +1,53 @@
+"""Shared dot-matrix algebra for the vclock-based types (ORSWOT, Map).
+
+Both ``riak_dt_orswot`` and ``riak_dt_map`` track presence with birth dots
+under a vector clock and share one merge/order rule
+(``src/lasp_lattice.erl:163-167, 255-271`` applies the identical logic to
+both); this module is that rule, written once.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def merge_dots(clock_a, dots_a, clock_b, dots_b):
+    """Join two (clock, dots) pairs. A dot survives iff present on both
+    sides (still live everywhere) or present on one side and UNSEEN by the
+    other's clock (a newer add that side hasn't learned; a seen-but-absent
+    dot was removed). Returns (clock, dots)."""
+    clock = jnp.maximum(clock_a, clock_b)
+    keep_a = (dots_a > 0) & ((dots_a == dots_b) | (dots_a > clock_b[None, :]))
+    keep_b = (dots_b > 0) & ((dots_b == dots_a) | (dots_b > clock_a[None, :]))
+    dots = jnp.maximum(
+        jnp.where(keep_a, dots_a, 0), jnp.where(keep_b, dots_b, 0)
+    )
+    return clock, dots
+
+
+def clock_inflation(prev_clock, cur_clock) -> jax.Array:
+    """vclock descends (``src/lasp_lattice.erl:163-167``)."""
+    return jnp.all(prev_clock <= cur_clock)
+
+
+def strict_clock_inflation(prev_clock, prev_dots, cur_clock, cur_dots) -> jax.Array:
+    """``src/lasp_lattice.erl:255-271``: inflation ∧ (equal clocks with
+    fewer present entries — a removal — or strictly dominating clock)."""
+    inflation = clock_inflation(prev_clock, cur_clock)
+    equal_clocks = jnp.all(prev_clock == cur_clock)
+    dominates = inflation & jnp.any(cur_clock > prev_clock)
+    deleted = jnp.sum(jnp.any(cur_dots > 0, axis=-1)) < jnp.sum(
+        jnp.any(prev_dots > 0, axis=-1)
+    )
+    return inflation & ((equal_clocks & deleted) | dominates)
+
+
+def mint_dot(clock, dots, entry_idx, actor_idx):
+    """Advance the actor's clock and replace the entry's dots with the
+    fresh single dot (the shared ``add``/``touch`` move). Returns
+    (clock, dots)."""
+    counter = clock[actor_idx] + 1
+    clock = clock.at[actor_idx].set(counter)
+    row = jnp.zeros_like(clock).at[actor_idx].set(counter)
+    return clock, dots.at[entry_idx].set(row)

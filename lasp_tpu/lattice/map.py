@@ -1,0 +1,122 @@
+"""CRDT Map: composed field lattices under observe-remove key presence.
+
+Reference semantics (external dep ``riak_dt_map``, used by the KVS-replica
+workload ``riak_test/lasp_kvs_replica_test.erl:57-135`` and ordered by the
+framework at ``src/lasp_lattice.erl:166-167, 264-271``): state is
+``{VClock, Entries, Deferred}`` where entries map ``{Name, Type}`` field
+keys to embedded CRDTs plus presence dots; ``{update, [{update, Key, Op} |
+{remove, Key}]}`` applies batched field ops; merge is OR-SWOT presence
+logic over keys plus per-field embedded merge; inflation = clock descends,
+strict inflation = dominating clock or equal clocks with removed fields.
+
+Dense encoding: the field *schema is static* — a ``MapSpec`` fixes the
+ordered tuple of (key, embedded codec, embedded spec) — so a Map state is
+``clock: int32[A]``, ``dots: int32[F, A]`` (presence, exactly the ORSWOT
+dot matrix over field slots) and a tuple of embedded states. Dense-shape
+divergence (documented): the reference resets a field's contents when the
+field is removed and re-added; here contents are join-monotone across
+remove/re-add (presence controls visibility only) — the trade that keeps
+merge a pure elementwise lattice join over fixed shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import CrdtType
+from .dots import clock_inflation, merge_dots, mint_dot, strict_clock_inflation
+
+
+@dataclasses.dataclass(frozen=True)
+class MapSpec:
+    #: ordered static schema: ((key, codec_cls, embedded_spec), ...)
+    fields: tuple
+    n_actors: int
+
+    @property
+    def n_fields(self) -> int:
+        return len(self.fields)
+
+    def field_index(self, key) -> int:
+        for i, (k, _c, _s) in enumerate(self.fields):
+            if k == key:
+                return i
+        raise KeyError(f"riak_dt_map: unknown field {key!r} (static schema)")
+
+
+class MapState(NamedTuple):
+    clock: jax.Array  # int32[A]
+    dots: jax.Array  # int32[F, A] — field-presence dots (ORSWOT logic)
+    fields: tuple  # embedded states, schema order
+
+
+class CrdtMap(CrdtType):
+    name = "riak_dt_map"
+
+    @staticmethod
+    def new(spec: MapSpec) -> MapState:
+        return MapState(
+            clock=jnp.zeros((spec.n_actors,), dtype=jnp.int32),
+            dots=jnp.zeros((spec.n_fields, spec.n_actors), dtype=jnp.int32),
+            fields=tuple(codec.new(espec) for _k, codec, espec in spec.fields),
+        )
+
+    # -- updates ------------------------------------------------------------
+    @staticmethod
+    def touch(spec: MapSpec, state: MapState, field_idx: int, actor_idx) -> MapState:
+        """Mark a field present with a fresh dot (the presence half of
+        ``{update, Key, Op}``); the embedded op is applied by the caller."""
+        clock, dots = mint_dot(state.clock, state.dots, field_idx, actor_idx)
+        return MapState(clock=clock, dots=dots, fields=state.fields)
+
+    @staticmethod
+    def set_field(spec: MapSpec, state: MapState, field_idx: int, fstate) -> MapState:
+        fields = list(state.fields)
+        fields[field_idx] = fstate
+        return MapState(clock=state.clock, dots=state.dots, fields=tuple(fields))
+
+    @staticmethod
+    def remove(spec: MapSpec, state: MapState, field_idx: int) -> MapState:
+        """``{remove, Key}``: drop the presence dots; the clock witnesses
+        them so merges cannot resurrect the removal."""
+        return MapState(
+            clock=state.clock,
+            dots=state.dots.at[field_idx].set(0),
+            fields=state.fields,
+        )
+
+    # -- lattice ------------------------------------------------------------
+    @staticmethod
+    def merge(spec: MapSpec, a: MapState, b: MapState) -> MapState:
+        clock, dots = merge_dots(a.clock, a.dots, b.clock, b.dots)
+        fields = tuple(
+            codec.merge(espec, fa, fb)
+            for (_k, codec, espec), fa, fb in zip(spec.fields, a.fields, b.fields)
+        )
+        return MapState(clock=clock, dots=dots, fields=fields)
+
+    @staticmethod
+    def value(spec: MapSpec, state: MapState) -> jax.Array:
+        """bool[F]: field presence mask (embedded values decode host-side)."""
+        return jnp.any(state.dots > 0, axis=-1)
+
+    @staticmethod
+    def equal(spec: MapSpec, a: MapState, b: MapState) -> jax.Array:
+        acc = jnp.all(a.clock == b.clock) & jnp.all(a.dots == b.dots)
+        for (_k, codec, espec), fa, fb in zip(spec.fields, a.fields, b.fields):
+            acc = acc & codec.equal(espec, fa, fb)
+        return acc
+
+    @staticmethod
+    def is_inflation(spec: MapSpec, prev: MapState, cur: MapState) -> jax.Array:
+        # clock descends (src/lasp_lattice.erl:166-167)
+        return clock_inflation(prev.clock, cur.clock)
+
+    @staticmethod
+    def is_strict_inflation(spec: MapSpec, prev: MapState, cur: MapState) -> jax.Array:
+        # src/lasp_lattice.erl:264-271 (same rule as orswot)
+        return strict_clock_inflation(prev.clock, prev.dots, cur.clock, cur.dots)

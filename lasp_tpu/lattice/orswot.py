@@ -1,0 +1,111 @@
+"""OR-SWOT: observe-remove set WithOut Tombstones, as (vclock, dot-matrix).
+
+Reference semantics (external dep ``riak_dt_orswot``, accepted at
+``include/lasp.hrl:76``; order theory consumed by the framework at
+``src/lasp_lattice.erl:163-167, 255-262``): state is ``{VClock, Entries,
+Deferred}`` where each present element carries a minimal *dot* list (actor,
+event-counter); ``add`` advances the actor's clock and replaces the
+element's dots with the new single dot; ``remove`` drops the entry outright
+(no tombstone — the clock remembers); ``merge`` keeps a dot iff both sides
+have it, or one side has it and the other's clock has not yet seen it
+(i.e. the dot is newer than that clock, so it cannot have been removed).
+
+Dense encoding: ``clock: int32[A]`` (vector clock = per-actor max event)
+and ``dots: int32[E, A]`` (0 = no dot; else the event counter of the add).
+One dot per (element, actor) — exactly what our ``add`` mints (it replaces
+the element's dots, as the reference does), and what merges preserve.
+
+Order theory (the predicates the framework actually uses):
+``is_inflation`` = clock descends (``src/lasp_lattice.erl:163-164``);
+``is_strict_inflation`` = inflation ∧ (equal clocks with fewer elements —
+a removal — or strictly dominating clock) (:255-262); ``threshold_met``
+defaults to the inflation pair like the other set types (:77-80).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import CrdtType
+from .dots import clock_inflation, merge_dots, mint_dot, strict_clock_inflation
+
+
+@dataclasses.dataclass(frozen=True)
+class ORSWOTSpec:
+    n_elems: int
+    n_actors: int
+
+
+class ORSWOTState(NamedTuple):
+    clock: jax.Array  # int32[A] — per-actor max event counter
+    dots: jax.Array  # int32[E, A] — birth dot of each live element, 0 = none
+
+
+class ORSWOT(CrdtType):
+    name = "riak_dt_orswot"
+
+    @staticmethod
+    def new(spec: ORSWOTSpec) -> ORSWOTState:
+        return ORSWOTState(
+            clock=jnp.zeros((spec.n_actors,), dtype=jnp.int32),
+            dots=jnp.zeros((spec.n_elems, spec.n_actors), dtype=jnp.int32),
+        )
+
+    # -- updates ------------------------------------------------------------
+    @staticmethod
+    def add(spec: ORSWOTSpec, state: ORSWOTState, elem_idx, actor_idx) -> ORSWOTState:
+        """``update({add, E}, Actor)``: bump the actor's clock, replace the
+        element's dots with the fresh single dot (riak_dt_orswot add)."""
+        clock, dots = mint_dot(state.clock, state.dots, elem_idx, actor_idx)
+        return ORSWOTState(clock=clock, dots=dots)
+
+    @staticmethod
+    def remove(spec: ORSWOTSpec, state: ORSWOTState, elem_idx) -> ORSWOTState:
+        """``update({remove, E})``: drop the entry; the clock already
+        witnesses its dots, so merges cannot resurrect it."""
+        return ORSWOTState(
+            clock=state.clock,
+            dots=state.dots.at[elem_idx].set(0),
+        )
+
+    # -- lattice ------------------------------------------------------------
+    @staticmethod
+    def merge(spec: ORSWOTSpec, a: ORSWOTState, b: ORSWOTState) -> ORSWOTState:
+        """See :func:`lasp_tpu.lattice.dots.merge_dots` for the survival
+        rule (shared with riak_dt_map)."""
+        clock, dots = merge_dots(a.clock, a.dots, b.clock, b.dots)
+        return ORSWOTState(clock=clock, dots=dots)
+
+    @staticmethod
+    def value(spec: ORSWOTSpec, state: ORSWOTState) -> jax.Array:
+        """bool[E]: element holds at least one live dot."""
+        return jnp.any(state.dots > 0, axis=-1)
+
+    @staticmethod
+    def member_mask(spec: ORSWOTSpec, state: ORSWOTState) -> jax.Array:
+        return jnp.any(state.dots > 0, axis=-1)
+
+    @staticmethod
+    def equal(spec: ORSWOTSpec, a: ORSWOTState, b: ORSWOTState) -> jax.Array:
+        return jnp.all(a.clock == b.clock) & jnp.all(a.dots == b.dots)
+
+    @staticmethod
+    def is_inflation(spec: ORSWOTSpec, prev: ORSWOTState, cur: ORSWOTState) -> jax.Array:
+        return clock_inflation(prev.clock, cur.clock)
+
+    @staticmethod
+    def is_strict_inflation(
+        spec: ORSWOTSpec, prev: ORSWOTState, cur: ORSWOTState
+    ) -> jax.Array:
+        return strict_clock_inflation(prev.clock, prev.dots, cur.clock, cur.dots)
+
+    @staticmethod
+    def stats(spec: ORSWOTSpec, state: ORSWOTState) -> dict:
+        return {
+            "element_count": int(jnp.sum(jnp.any(state.dots > 0, axis=-1))),
+            "clock_total": int(jnp.sum(state.clock)),
+        }

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..lattice.base import replicate
+from ..utils.metrics import StepTrace, Timer
 from .gossip import divergence, gossip_round, join_all
 
 
@@ -39,6 +40,7 @@ class ReplicatedRuntime:
         self.states: dict = {}
         self._step = None
         self._n_edges = -1
+        self.trace = StepTrace()
         self._sync_graph()
 
     def _sync_graph(self) -> None:
@@ -114,15 +116,19 @@ class ReplicatedRuntime:
             for v in self.var_ids:
                 codec, spec = meta[v]
                 new = gossip_round(codec, spec, states[v], neighbors, edge_mask)
-                # residual measures the WHOLE step (pre-sweep -> post-gossip):
-                # comparing post-sweep would miss dataflow-only progress when
-                # replicas are already uniform, ending convergence early
-                strict = jax.vmap(
-                    lambda a, b, _codec=codec, _spec=spec: _codec.is_strict_inflation(
+                # residual measures the WHOLE step (pre-sweep -> post-gossip)
+                # as ANY state change, not strict inflation: vclock types
+                # (ORSWOT/Map) can change dots under equal clocks and equal
+                # element counts, which is_strict_inflation cannot see —
+                # stopping there would declare convergence while replicas
+                # still diverge. Any change is progress toward the fixed
+                # point in a join semilattice, so ¬equal is the right test.
+                changed = jax.vmap(
+                    lambda a, b, _codec=codec, _spec=spec: ~_codec.equal(
                         _spec, a, b
                     )
                 )(prev[v], new)
-                residual += jnp.sum(strict.astype(jnp.int32))
+                residual += jnp.sum(changed.astype(jnp.int32))
                 out[v] = new
             return out, residual
 
@@ -131,18 +137,21 @@ class ReplicatedRuntime:
 
     def step(self, edge_mask=None) -> int:
         """One bulk-synchronous round: local dataflow sweep + gossip.
-        Returns the number of strict inflations the step produced (0 on
-        the final, quiescent round)."""
+        Returns the number of (replica, variable) states the step CHANGED
+        (0 on the final, quiescent round)."""
         if self._n_edges != len(self.graph.edges):
             self._sync_graph()
         if self._step is None:
             self._step = self._build_step()
-        self.states, residual = self._step(self.states, self.neighbors, edge_mask)
-        return int(residual)
+        with Timer() as t:
+            self.states, residual = self._step(self.states, self.neighbors, edge_mask)
+            residual = int(residual)  # device sync closes the timing window
+        self.trace.record_round(residual, t.elapsed)
+        return residual
 
     def run_to_convergence(self, max_rounds: int = 10_000, edge_mask=None) -> int:
-        """Gossip until no replica strictly inflates; returns rounds taken —
-        the rounds-to-convergence benchmark metric (BASELINE.md)."""
+        """Step until no state changes (the join fixed point); returns
+        rounds taken — the rounds-to-convergence metric (BASELINE.md)."""
         for i in range(max_rounds):
             if self.step(edge_mask) == 0:
                 return i + 1
@@ -154,20 +163,12 @@ class ReplicatedRuntime:
         (``src/lasp_execute_coverage_fsm.erl:78-94``)."""
         var = self.store.variable(var_id)
         top = join_all(var.codec, var.spec, self.states[var_id])
-        var.state, saved = top, var.state
-        try:
-            return self.store.value(var_id)
-        finally:
-            var.state = saved
+        return self.store._decode_value(var, top)
 
     def replica_value(self, var_id: str, replica: int):
         var = self.store.variable(var_id)
         row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
-        var.state, saved = row, var.state
-        try:
-            return self.store.value(var_id)
-        finally:
-            var.state = saved
+        return self.store._decode_value(var, row)
 
     def divergence(self, var_id: str) -> int:
         var = self.store.variable(var_id)

@@ -1,5 +1,17 @@
 """Store layer: the single-replica runtime core (reference L1 + L0 storage)."""
 
+from .checkpoint import load_runtime, load_store, save_runtime, save_store
+from .host_store import HostStore
 from .store import PreconditionError, Store, Variable, Watch
 
-__all__ = ["Store", "Variable", "Watch", "PreconditionError"]
+__all__ = [
+    "HostStore",
+    "PreconditionError",
+    "Store",
+    "Variable",
+    "Watch",
+    "load_runtime",
+    "load_store",
+    "save_runtime",
+    "save_store",
+]

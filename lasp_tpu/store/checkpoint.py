@@ -1,0 +1,189 @@
+"""Checkpoint / resume: durable snapshots of store and replica state.
+
+The reference persists (1) variable state per partition via eleveldb /
+bitcask (``src/lasp_eleveldb_backend.erl:38-53``) and (2) the program
+registry in per-partition dets tables reloaded at vnode init
+(``src/lasp_vnode.erl:220-237``) — SURVEY.md §5 checkpoint/resume. Here a
+checkpoint is a single :class:`~lasp_tpu.store.host_store.HostStore` log:
+a pickled manifest (variable specs, interner contents, and the store's
+metric counters) plus one raw-bytes record per array leaf. ``save_runtime`` additionally
+captures every variable's replicated ``[R, ...]`` state and the topology.
+
+Programs and dataflow edges hold arbitrary Python callables and are NOT
+serialized; re-register them after load (the app layer owns code, exactly
+as the reference re-ships program sources at registration time)."""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import numpy as np
+
+from .host_store import HostStore
+from .store import Store, Variable
+
+
+def _leaf_key(var_id: str, i: int) -> str:
+    return f"leaf/{var_id}/{i}"
+
+
+def _var_manifest(var: Variable) -> dict:
+    m = {
+        "type_name": var.type_name,
+        "spec": var.spec,
+        "elems": None,
+        "ivar_payloads": None,
+        "map_aux": None,
+    }
+    if var.elems is not None and hasattr(var.elems, "terms"):
+        # PairUniverse terms are derived from source interners; only plain
+        # interners persist their own term lists
+        from ..dataflow.engine import PairUniverse
+
+        if not isinstance(var.elems, PairUniverse):
+            m["elems"] = list(var.elems.terms())
+    if var.ivar_payloads is not None:
+        m["ivar_payloads"] = list(var.ivar_payloads.terms())
+    if var.map_aux is not None:
+        m["map_aux"] = [
+            {
+                "elems": list(s.elems.terms()) if s.elems is not None else None,
+                "ivar_payloads": (
+                    list(s.ivar_payloads.terms())
+                    if s.ivar_payloads is not None
+                    else None
+                ),
+            }
+            for s in var.map_aux
+        ]
+    if var.actors is not None:
+        m["actors"] = list(var.actors.terms())
+    return m
+
+
+def _restore_interners(var: Variable, m: dict) -> None:
+    if m.get("elems") is not None:
+        for t in m["elems"]:
+            var.elems.intern(t)
+    if m.get("ivar_payloads") is not None:
+        for t in m["ivar_payloads"]:
+            var.ivar_payloads.intern(t)
+    if m.get("actors") is not None:
+        for t in m["actors"]:
+            var.actors.intern(t)
+    if m.get("map_aux") is not None:
+        for shim, sm in zip(var.map_aux, m["map_aux"]):
+            if sm["elems"] is not None:
+                for t in sm["elems"]:
+                    shim.elems.intern(t)
+            if sm["ivar_payloads"] is not None:
+                for t in sm["ivar_payloads"]:
+                    shim.ivar_payloads.intern(t)
+
+
+def _put_state(hs: HostStore, var_id: str, state, manifest_entry: dict) -> None:
+    leaves = jax.tree_util.tree_leaves(state)
+    manifest_entry["leaves"] = []
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        manifest_entry["leaves"].append((str(arr.dtype), arr.shape))
+        hs.put(_leaf_key(var_id, i), arr.tobytes())
+
+
+def _get_state(hs: HostStore, var_id: str, template, manifest_entry: dict):
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    import jax.numpy as jnp
+
+    out = []
+    for i, (dtype, shape) in enumerate(manifest_entry["leaves"]):
+        raw = hs.get(_leaf_key(var_id, i))
+        if raw is None:
+            raise IOError(f"checkpoint missing leaf {var_id}/{i}")
+        # device arrays, not numpy views: codec ops use .at[] updates
+        out.append(jnp.asarray(np.frombuffer(raw, dtype=dtype).reshape(shape)))
+    assert len(out) == len(leaves)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def save_store(store: Store, path: str) -> None:
+    """Snapshot a single-replica store (the eleveldb persistence role)."""
+    with HostStore(path) as hs:
+        manifest = {
+            "kind": "store",
+            "n_actors": store.n_actors,
+            "metrics": dict(store.metrics),
+            "mutations": store.mutations,
+            "vars": {},
+        }
+        for var_id in store.ids():
+            var = store.variable(var_id)
+            entry = _var_manifest(var)
+            _put_state(hs, var_id, var.state, entry)
+            manifest["vars"][var_id] = entry
+        hs.put("manifest", pickle.dumps(manifest))
+
+
+def load_store(path: str) -> Store:
+    """Rebuild a store from a snapshot (``lasp_vnode:init`` reload role)."""
+    with HostStore(path) as hs:
+        raw = hs.get("manifest")
+        if raw is None:
+            raise IOError(f"no checkpoint manifest in {path}")
+        manifest = pickle.loads(raw)
+        store = Store(n_actors=manifest["n_actors"])
+        store.metrics.update(manifest.get("metrics", {}))
+        store.mutations = manifest.get("mutations", 0)
+        for var_id, entry in manifest["vars"].items():
+            store.declare(id=var_id, type=entry["type_name"], spec=entry["spec"])
+            var = store.variable(var_id)
+            _restore_interners(var, entry)
+            var.state = _get_state(hs, var_id, var.state, entry)
+        return store
+
+
+def save_runtime(runtime, path: str) -> None:
+    """Snapshot a ReplicatedRuntime: per-variable ``[R, ...]`` states plus
+    topology (device-array checkpoint of the replica population)."""
+    with HostStore(path) as hs:
+        manifest = {
+            "kind": "runtime",
+            "n_actors": runtime.store.n_actors,
+            "n_replicas": runtime.n_replicas,
+            "vars": {},
+        }
+        for var_id in runtime.var_ids:
+            var = runtime.store.variable(var_id)
+            entry = _var_manifest(var)
+            _put_state(hs, var_id, runtime.states[var_id], entry)
+            manifest["vars"][var_id] = entry
+        nb = np.asarray(runtime.neighbors)
+        manifest["neighbors"] = (str(nb.dtype), nb.shape)
+        hs.put("neighbors", nb.tobytes())
+        hs.put("manifest", pickle.dumps(manifest))
+
+
+def load_runtime(path: str, graph=None):
+    """Rebuild a ReplicatedRuntime (store + replica states + topology).
+    Dataflow edges are code, not data — pass a freshly built ``graph``
+    (against the RETURNED runtime's store) via the callback form:
+    ``load_runtime(path, graph=lambda store: build_graph(store))``."""
+    from ..dataflow.engine import Graph
+    from ..mesh.runtime import ReplicatedRuntime
+
+    with HostStore(path) as hs:
+        manifest = pickle.loads(hs.get("manifest"))
+        assert manifest["kind"] == "runtime"
+        store = Store(n_actors=manifest["n_actors"])
+        for var_id, entry in manifest["vars"].items():
+            store.declare(id=var_id, type=entry["type_name"], spec=entry["spec"])
+            _restore_interners(store.variable(var_id), entry)
+        g = graph(store) if callable(graph) else Graph(store)
+        dtype, shape = manifest["neighbors"]
+        neighbors = np.frombuffer(hs.get("neighbors"), dtype=dtype).reshape(shape)
+        rt = ReplicatedRuntime(store, g, manifest["n_replicas"], neighbors)
+        for var_id, entry in manifest["vars"].items():
+            rt.states[var_id] = _get_state(
+                hs, var_id, rt.states[var_id], entry
+            )
+        return rt

@@ -1,0 +1,243 @@
+"""Durable host KV store: ctypes binding to the native log-structured store.
+
+The live lattice state lives in device HBM; this is the durable host half —
+the role the reference fills with native storage engines (eleveldb C++ NIF,
+the default backend per ``include/lasp.hrl:14``; bitcask C NIFs as the
+alternative — SURVEY.md §2.4). ``native/laspstore.cpp`` implements a
+bitcask-style append-only record log with CRC-checked records, torn-write
+truncation on open, tombstone deletes, and an in-memory index.
+
+The behaviour contract mirrors ``lasp_backend`` (``src/lasp_backend.erl:
+26-28``: ``start/put/get``) plus delete/keys. A pure-Python fallback with
+the identical on-disk format keeps the package importable before
+``make -C native`` has run (it is NOT a silent replacement: ``backend``
+reports which engine is active, and the native build is the supported
+path)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import zlib
+
+_SO_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+    "liblaspstore.so",
+)
+
+_FILE_MAGIC = 0x4C535354
+_REC_MAGIC = 0x4C535052
+_VERSION = 1
+_TOMBSTONE = 0xFFFFFFFFFFFFFFFF
+
+
+def _load_native():
+    if not os.path.exists(_SO_PATH):
+        return None
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.lasp_store_open.restype = ctypes.c_void_p
+    lib.lasp_store_open.argtypes = [ctypes.c_char_p]
+    lib.lasp_store_put.restype = ctypes.c_int
+    lib.lasp_store_put.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+    lib.lasp_store_len.restype = ctypes.c_int64
+    lib.lasp_store_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+    lib.lasp_store_get.restype = ctypes.c_int64
+    lib.lasp_store_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+    ]
+    lib.lasp_store_delete.restype = ctypes.c_int
+    lib.lasp_store_delete.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p,
+        ctypes.c_uint32,
+    ]
+    lib.lasp_store_count.restype = ctypes.c_uint64
+    lib.lasp_store_count.argtypes = [ctypes.c_void_p]
+    lib.lasp_store_wasted.restype = ctypes.c_uint64
+    lib.lasp_store_wasted.argtypes = [ctypes.c_void_p]
+    lib.lasp_store_keys_len.restype = ctypes.c_uint64
+    lib.lasp_store_keys_len.argtypes = [ctypes.c_void_p]
+    lib.lasp_store_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.lasp_store_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_NATIVE = _load_native()
+
+
+class HostStore:
+    """Bitcask-style durable KV store (native when built, else fallback)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if _NATIVE is not None:
+            self._h = _NATIVE.lasp_store_open(path.encode())
+            if not self._h:
+                raise IOError(f"laspstore: cannot open {path}")
+            self.backend = "native"
+        else:
+            self._py = _PyLog(path)
+            self.backend = "python-fallback"
+
+    # -- lasp_backend contract (start/put/get) + delete/keys ---------------
+    def put(self, key: str, value: bytes) -> None:
+        k = key.encode()
+        if self.backend == "native":
+            rc = _NATIVE.lasp_store_put(self._h, k, len(k), bytes(value), len(value))
+            if rc != 0:
+                raise IOError(f"laspstore put failed: {rc}")
+        else:
+            self._py.put(k, bytes(value))
+
+    def get(self, key: str):
+        k = key.encode()
+        if self.backend == "native":
+            n = _NATIVE.lasp_store_len(self._h, k, len(k))
+            if n < 0:
+                return None
+            buf = ctypes.create_string_buffer(int(n))
+            got = _NATIVE.lasp_store_get(self._h, k, len(k), buf, n)
+            if got != n:
+                raise IOError(f"laspstore get failed: {got}")
+            return buf.raw[:n]
+        return self._py.get(k)
+
+    def delete(self, key: str) -> bool:
+        k = key.encode()
+        if self.backend == "native":
+            return _NATIVE.lasp_store_delete(self._h, k, len(k)) == 0
+        return self._py.delete(k)
+
+    def keys(self) -> list[str]:
+        if self.backend == "native":
+            n = _NATIVE.lasp_store_keys_len(self._h)
+            if n == 0:
+                return []
+            buf = ctypes.create_string_buffer(int(n))
+            _NATIVE.lasp_store_keys(self._h, buf)
+            return [k.decode() for k in buf.raw[: int(n)].split(b"\n") if k]
+        return sorted(k.decode() for k in self._py.index)
+
+    def stats(self) -> dict:
+        if self.backend == "native":
+            return {
+                "keys": int(_NATIVE.lasp_store_count(self._h)),
+                "wasted_bytes": int(_NATIVE.lasp_store_wasted(self._h)),
+                "backend": self.backend,
+            }
+        return {
+            "keys": len(self._py.index),
+            "wasted_bytes": self._py.wasted,
+            "backend": self.backend,
+        }
+
+    def close(self) -> None:
+        if self.backend == "native":
+            if self._h:
+                _NATIVE.lasp_store_close(self._h)
+                self._h = None
+        else:
+            self._py.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _PyLog:
+    """Same on-disk format as native/laspstore.cpp, in Python."""
+
+    def __init__(self, path: str):
+        exists = os.path.exists(path)
+        self.f = open(path, "r+b" if exists else "w+b")
+        self.index: dict[bytes, tuple[int, int]] = {}
+        self.wasted = 0
+        if not exists:
+            self.f.write(struct.pack("<II", _FILE_MAGIC, _VERSION))
+            self.f.flush()
+        else:
+            self._scan()
+
+    def _scan(self):
+        hdr = self.f.read(8)
+        if len(hdr) < 8 or struct.unpack("<II", hdr) != (_FILE_MAGIC, _VERSION):
+            raise IOError("laspstore: bad header")
+        pos = self.f.tell()
+        while True:
+            head = self.f.read(16)
+            if len(head) < 16:
+                break
+            rmagic, key_len, val_len = struct.unpack("<IIQ", head)
+            if rmagic != _REC_MAGIC:
+                break
+            tomb = val_len == _TOMBSTONE
+            vlen = 0 if tomb else val_len
+            if key_len > (1 << 24) or vlen > (1 << 38):
+                break  # garbage header from a torn write; truncate here
+            payload = self.f.read(key_len + vlen)
+            crc_raw = self.f.read(4)
+            if len(payload) < key_len + vlen or len(crc_raw) < 4:
+                break
+            if zlib.crc32(payload) & 0xFFFFFFFF != struct.unpack("<I", crc_raw)[0]:
+                break
+            key = payload[:key_len]
+            if key in self.index:
+                self.wasted += self.index[key][1]
+            if tomb:
+                self.index.pop(key, None)
+            else:
+                self.index[key] = (pos + 16 + key_len, vlen)
+            pos = self.f.tell()
+        self.f.seek(pos)
+        self.f.truncate()
+
+    def put(self, key: bytes, value: bytes):
+        pos = self.f.tell()
+        crc = zlib.crc32(key + value) & 0xFFFFFFFF
+        self.f.write(struct.pack("<IIQ", _REC_MAGIC, len(key), len(value)))
+        self.f.write(key)
+        self.f.write(value)
+        self.f.write(struct.pack("<I", crc))
+        self.f.flush()
+        if key in self.index:
+            self.wasted += self.index[key][1]
+        self.index[key] = (pos + 16 + len(key), len(value))
+
+    def get(self, key: bytes):
+        if key not in self.index:
+            return None
+        off, n = self.index[key]
+        saved = self.f.tell()
+        self.f.seek(off)
+        data = self.f.read(n)
+        self.f.seek(saved)
+        return data
+
+    def delete(self, key: bytes) -> bool:
+        if key not in self.index:
+            return False
+        crc = zlib.crc32(key) & 0xFFFFFFFF
+        self.f.write(struct.pack("<IIQ", _REC_MAGIC, len(key), _TOMBSTONE))
+        self.f.write(key)
+        self.f.write(struct.pack("<I", crc))
+        self.f.flush()
+        self.wasted += self.index[key][1]
+        del self.index[key]
+        return True
+
+    def close(self):
+        self.f.close()

@@ -37,8 +37,10 @@ from ..lattice import (
     GSetSpec,
     IVar,
     IVarSpec,
+    MapSpec,
     ORSet,
     ORSetSpec,
+    ORSWOTSpec,
     Threshold,
     get_type,
 )
@@ -54,6 +56,9 @@ DEFAULT_SPECS = {
         n_elems=n_elems, n_actors=n_actors, tokens_per_actor=tokens_per_actor
     ),
     "riak_dt_gcounter": lambda n_actors=16, **kw: GCounterSpec(n_actors=n_actors),
+    "riak_dt_orswot": lambda n_elems=64, n_actors=16, **kw: ORSWOTSpec(
+        n_elems=n_elems, n_actors=n_actors
+    ),
 }
 
 #: capacity kwargs each type's declare() accepts; anything else is a loud
@@ -65,7 +70,45 @@ ALLOWED_CAPS = {
     "lasp_orset": {"n_elems", "n_actors", "tokens_per_actor"},
     "lasp_orset_gbtree": {"n_elems", "n_actors", "tokens_per_actor"},
     "riak_dt_gcounter": {"n_actors"},
+    "riak_dt_orswot": {"n_elems", "n_actors"},
+    "riak_dt_map": {"fields", "n_actors"},
 }
+
+
+def build_map_spec(fields, n_actors: int) -> MapSpec:
+    """Build a static Map schema from ``[(key, type_name, caps_dict), ...]``
+    (the dense analogue of riak_dt_map's dynamic ``{Name, Type}`` keys —
+    fields are declared up front so shapes stay fixed)."""
+    resolved = []
+    for key, type_name, caps in fields:
+        caps = dict(caps or {})
+        if type_name == "riak_dt_map":
+            raise TypeError(
+                f"map field {key!r}: nested riak_dt_map fields are not "
+                "supported (flatten the schema)"
+            )
+        if type_name not in ALLOWED_CAPS:
+            raise TypeError(f"map field {key!r}: unknown type {type_name!r}")
+        unknown = set(caps) - ALLOWED_CAPS[type_name]
+        if unknown:
+            raise TypeError(
+                f"map field {key!r} ({type_name}): unknown capacity kwargs "
+                f"{sorted(unknown)} (allowed: {sorted(ALLOWED_CAPS[type_name])})"
+            )
+        if "n_actors" in ALLOWED_CAPS[type_name]:
+            # embedded writer width must EQUAL the map's: field shims share
+            # the map's actor interner (field dots and embedded actor slots
+            # name the same actors), so a narrower embedded state would turn
+            # overflow into a silently-dropped out-of-bounds scatter
+            if caps.get("n_actors", n_actors) != n_actors:
+                raise TypeError(
+                    f"map field {key!r}: n_actors must match the map's "
+                    f"({n_actors}); per-field writer universes are not "
+                    "separable from the map clock"
+                )
+            caps["n_actors"] = n_actors
+        resolved.append((key, get_type(type_name), DEFAULT_SPECS[type_name](**caps)))
+    return MapSpec(fields=tuple(resolved), n_actors=n_actors)
 
 
 class PreconditionError(RuntimeError):
@@ -116,6 +159,9 @@ class Variable:
     #: per-variable writer universe, sized to spec.n_actors so overflow is a
     #: loud CapacityError instead of a silently-dropped out-of-bounds scatter
     actors: Optional[Interner] = None
+    #: riak_dt_map only: per-field Variable shims (codec/spec/interners for
+    #: each embedded lattice) so field ops reuse the normal op machinery
+    map_aux: Optional[list] = None
 
 
 class Store:
@@ -160,7 +206,12 @@ class Store:
                 )
             if "n_actors" in allowed:
                 caps.setdefault("n_actors", self.n_actors)
-            spec = DEFAULT_SPECS[type](**caps)
+            if type == "riak_dt_map":
+                spec = build_map_spec(
+                    caps.get("fields", ()), caps.get("n_actors", self.n_actors)
+                )
+            else:
+                spec = DEFAULT_SPECS[type](**caps)
         var = Variable(
             id=id, type_name=type, codec=codec, spec=spec, state=codec.new(spec)
         )
@@ -172,8 +223,33 @@ class Store:
             var.actors = Interner(spec.n_actors, kind="actor")
         if type == "lasp_ivar":
             var.ivar_payloads = Interner(2**31 - 1, kind="ivar payload")
+        if type == "riak_dt_map":
+            var.map_aux = [
+                self._field_shim(id, key, fcodec, fspec, var)
+                for key, fcodec, fspec in spec.fields
+            ]
         self._vars[id] = var
         return id
+
+    @staticmethod
+    def _field_shim(map_id, key, fcodec, fspec, parent: Variable) -> Variable:
+        """A Variable-shaped holder for one embedded map field: gives the
+        field its own element/payload universes while SHARING the parent
+        map's writer universe (field dots and embedded actor slots must name
+        the same actors as the map's clock)."""
+        shim = Variable(
+            id=f"{map_id}.{key!r}",
+            type_name=fcodec.name,
+            codec=fcodec,
+            spec=fspec,
+            state=None,  # live state lives in the parent MapState
+        )
+        if hasattr(fspec, "n_elems"):
+            shim.elems = Interner(fspec.n_elems, kind="element")
+        shim.actors = parent.actors
+        if fcodec.name == "lasp_ivar":
+            shim.ivar_payloads = Interner(2**31 - 1, kind="ivar payload")
+        return shim
 
     def redeclare_derived(self, id: str, type: str, spec: Any, elems: Any) -> str:
         """Replace a (still-bottom) variable's codec layout with a derived
@@ -256,6 +332,36 @@ class Store:
                 for e in op[1]:
                     state = codec.add(spec, state, var.elems.intern(e))
                 return state
+        elif var.type_name == "riak_dt_orswot":
+            if verb == "add":
+                return codec.add(
+                    spec, state, var.elems.intern(op[1]), var.actors.intern(actor)
+                )
+            if verb == "add_all":
+                a = var.actors.intern(actor)
+                for e in op[1]:
+                    state = codec.add(spec, state, var.elems.intern(e), a)
+                return state
+            if verb in ("remove", "remove_all"):
+                elems = op[1] if verb == "remove_all" else [op[1]]
+                for e in elems:
+                    # re-check against the EVOLVING state: riak applies
+                    # batched removes sequentially, so a duplicate removal
+                    # in one batch is a precondition error too
+                    member = codec.member_mask(spec, state)
+                    if e not in var.elems or not bool(member[var.elems.index_of(e)]):
+                        raise PreconditionError(f"not_present: {e!r}")
+                    state = codec.remove(spec, state, var.elems.index_of(e))
+                return state
+        elif var.type_name == "riak_dt_map":
+            # riak_dt_map batched op shape: ("update", [("update", Key, Op) |
+            # ("remove", Key), ...]); single field ops also accepted
+            if verb == "update" and len(op) == 2:
+                for sub in op[1]:
+                    state = self._apply_map_field(var, state, sub, actor)
+                return state
+            if verb in ("update", "remove"):
+                return self._apply_map_field(var, state, op, actor)
         elif var.type_name == "riak_dt_gcounter":
             if verb == "increment":
                 by = op[1] if len(op) > 1 else 1
@@ -264,6 +370,22 @@ class Store:
             if verb == "set":
                 return codec.set(spec, state, var.ivar_payloads.intern(op[1]))
         raise ValueError(f"unsupported op {op!r} for type {var.type_name}")
+
+    def _apply_map_field(self, var: Variable, state, sub: tuple, actor):
+        """One ``{update, Key, Op}`` / ``{remove, Key}`` against a map field
+        (``riak_test/lasp_kvs_replica_test.erl:120-133`` shapes)."""
+        spec, codec = var.spec, var.codec
+        if sub[0] == "remove":
+            f = spec.field_index(sub[1])
+            if not bool(codec.value(spec, state)[f]):
+                raise PreconditionError(f"not_present: {sub[1]!r}")
+            return codec.remove(spec, state, f)
+        _verb, key, inner = sub
+        f = spec.field_index(key)
+        state = codec.touch(spec, state, f, var.actors.intern(actor))
+        shim = var.map_aux[f]
+        new_field = self._apply_op(shim, state.fields[f], inner, actor)
+        return codec.set_field(spec, state, f, new_field)
 
     def bind(self, id: str, state) -> Any:
         """Merge + inflation gate + write (``src/lasp_core.erl:291-312``)."""
@@ -450,8 +572,16 @@ class Store:
     def value(self, id: str):
         """Decoded observable value (``Type:value/1``) as host Python data."""
         var = self._vars[id]
-        state = var.state
-        if var.type_name in ("lasp_orset", "lasp_orset_gbtree", "lasp_gset"):
+        return self._decode_value(var, var.state)
+
+    def _decode_value(self, var: Variable, state):
+        mask_types = (
+            "lasp_orset",
+            "lasp_orset_gbtree",
+            "lasp_gset",
+            "riak_dt_orswot",
+        )
+        if var.type_name in mask_types:
             import numpy as np
 
             mask = np.asarray(var.codec.value(var.spec, state))
@@ -462,6 +592,15 @@ class Store:
             if not bool(state.defined):
                 return None
             return var.ivar_payloads.term_of(int(state.value))
+        if var.type_name == "riak_dt_map":
+            import numpy as np
+
+            present = np.asarray(var.codec.value(var.spec, state))
+            return {
+                key: self._decode_value(var.map_aux[f], state.fields[f])
+                for f, (key, _c, _s) in enumerate(var.spec.fields)
+                if present[f]
+            }
         raise ValueError(var.type_name)
 
     def state(self, id: str):

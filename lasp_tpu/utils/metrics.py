@@ -1,0 +1,69 @@
+"""Step-level metrics and tracing (SURVEY.md §5 auxiliary subsystems).
+
+The reference has only lager log lines plus per-type ``stats/1``
+introspection (``src/lasp_orset.erl:156-192``); riak_core's stat subsystem
+is not wired. The TPU build makes observability first-class: every
+convergence loop records per-round residuals and wall time, CRDT ``stats``
+are cheap tensor reductions, and ``profile()`` wraps a block in a
+``jax.profiler`` trace for XLA-level inspection."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+class StepTrace:
+    """Append-only record of bulk-synchronous rounds: residuals, timings,
+    and arbitrary counters. One per runtime/graph; cheap enough to always
+    keep on."""
+
+    def __init__(self):
+        self.rounds: list[dict] = []
+        self.counters: dict[str, int] = {}
+
+    def record_round(self, residual: int, seconds: float, **extra) -> None:
+        self.rounds.append({"residual": residual, "seconds": seconds, **extra})
+
+    def bump(self, name: str, by: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + by
+
+    @property
+    def total_rounds(self) -> int:
+        return len(self.rounds)
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(r["seconds"] for r in self.rounds)
+
+    def summary(self) -> dict:
+        residuals = [r["residual"] for r in self.rounds]
+        return {
+            "rounds": len(self.rounds),
+            "seconds": round(self.total_seconds, 6),
+            "residual_path": residuals,
+            **self.counters,
+        }
+
+
+@contextlib.contextmanager
+def profile(log_dir: str):
+    """``jax.profiler`` trace around a block (view with TensorBoard/xprof)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    __slots__ = ("t0", "elapsed")
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.t0

@@ -1,0 +1,244 @@
+// laspstore: log-structured host key-value store for dense CRDT state.
+//
+// The TPU framework keeps live lattice state in device HBM; this library is
+// the durable host-side half — the role the reference fills with its native
+// storage engines (eleveldb, a C++ LevelDB NIF, as the default backend at
+// include/lasp.hrl:14, and bitcask's C NIFs as the alternative; see
+// SURVEY.md §2.4 native-code census). The format is bitcask-style: an
+// append-only record log with an in-memory index built by a single
+// sequential scan on open; the last record for a key wins; deletes are
+// tombstone records. Values are raw byte buffers (the Python layer stores
+// array payloads and msgpack-ish manifests).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in the image).
+//
+// Record layout (little-endian):
+//   u32 magic 0x4C535052 ("LSPR")  | u32 key_len | u64 val_len (UINT64_MAX
+//   = tombstone) | key bytes | val bytes | u32 crc32 of key+val
+//
+// File header: u32 magic 0x4C535354 ("LSST") | u32 version
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kFileMagic = 0x4C535354;  // "LSST"
+constexpr uint32_t kRecMagic = 0x4C535052;   // "LSPR"
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kTombstone = UINT64_MAX;
+
+const uint32_t* crc_table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+// raw running state; start with 0xFFFFFFFF, finalize with ~state
+uint32_t crc32_update(uint32_t state, const uint8_t* data, size_t n) {
+  const uint32_t* table = crc_table();
+  for (size_t i = 0; i < n; i++)
+    state = table[(state ^ data[i]) & 0xFF] ^ (state >> 8);
+  return state;
+}
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  return ~crc32_update(0xFFFFFFFFu, data, n);
+}
+
+struct Entry {
+  uint64_t offset;  // offset of value bytes in file
+  uint64_t len;
+};
+
+struct Store {
+  FILE* f = nullptr;
+  std::map<std::string, Entry> index;
+  std::string error;
+  uint64_t wasted = 0;  // bytes superseded by later writes (compaction cue)
+};
+
+bool read_exact(FILE* f, void* buf, size_t n) {
+  return fread(buf, 1, n, f) == n;
+}
+
+// scan the log, building the index; truncate at the first torn/corrupt record
+bool scan(Store* s) {
+  uint32_t magic = 0, version = 0;
+  if (!read_exact(s->f, &magic, 4) || !read_exact(s->f, &version, 4)) {
+    s->error = "missing file header";
+    return false;
+  }
+  if (magic != kFileMagic || version != kVersion) {
+    s->error = "bad magic/version";
+    return false;
+  }
+  long pos = ftell(s->f);
+  std::vector<uint8_t> buf;
+  for (;;) {
+    uint32_t rmagic, key_len;
+    uint64_t val_len;
+    if (!read_exact(s->f, &rmagic, 4)) break;  // clean EOF
+    if (rmagic != kRecMagic) break;            // torn write: stop here
+    if (!read_exact(s->f, &key_len, 4) || !read_exact(s->f, &val_len, 8)) break;
+    bool tomb = (val_len == kTombstone);
+    uint64_t vlen = tomb ? 0 : val_len;
+    // torn-write/garbage guard: implausible lengths mean the record header
+    // is trash, not a record — truncate here instead of trying to allocate
+    if (key_len > (1u << 24) || vlen > (1ull << 38)) break;
+    try {
+      buf.resize(key_len + vlen);
+    } catch (...) {
+      break;
+    }
+    if (!read_exact(s->f, buf.data(), key_len + vlen)) break;
+    uint32_t stored_crc;
+    if (!read_exact(s->f, &stored_crc, 4)) break;
+    if (crc32(buf.data(), buf.size()) != stored_crc) break;
+    std::string key(reinterpret_cast<char*>(buf.data()), key_len);
+    uint64_t val_off = static_cast<uint64_t>(pos) + 4 + 4 + 8 + key_len;
+    auto it = s->index.find(key);
+    if (it != s->index.end()) s->wasted += it->second.len;
+    if (tomb) {
+      s->index.erase(key);
+    } else {
+      s->index[key] = Entry{val_off, vlen};
+    }
+    pos = ftell(s->f);
+  }
+  // position for appends at the last valid record boundary
+  fseek(s->f, pos, SEEK_SET);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* lasp_store_open(const char* path) {
+  Store* s = new Store();
+  s->f = fopen(path, "r+b");
+  if (!s->f) {
+    s->f = fopen(path, "w+b");
+    if (!s->f) {
+      delete s;
+      return nullptr;
+    }
+    fwrite(&kFileMagic, 4, 1, s->f);
+    fwrite(&kVersion, 4, 1, s->f);
+    fflush(s->f);
+    return s;
+  }
+  if (!scan(s)) {
+    fclose(s->f);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int lasp_store_put(void* handle, const char* key, uint32_t key_len,
+                   const uint8_t* val, uint64_t val_len) {
+  Store* s = static_cast<Store*>(handle);
+  long pos = ftell(s->f);
+  uint32_t state = crc32_update(
+      0xFFFFFFFFu, reinterpret_cast<const uint8_t*>(key), key_len);
+  state = crc32_update(state, val, val_len);
+  uint32_t crc = ~state;
+  if (fwrite(&kRecMagic, 4, 1, s->f) != 1) return -1;
+  fwrite(&key_len, 4, 1, s->f);
+  fwrite(&val_len, 8, 1, s->f);
+  fwrite(key, 1, key_len, s->f);
+  if (val_len) fwrite(val, 1, val_len, s->f);
+  fwrite(&crc, 4, 1, s->f);
+  fflush(s->f);
+  uint64_t val_off = static_cast<uint64_t>(pos) + 4 + 4 + 8 + key_len;
+  std::string k(key, key_len);
+  auto it = s->index.find(k);
+  if (it != s->index.end()) s->wasted += it->second.len;
+  s->index[k] = Entry{val_off, val_len};
+  return 0;
+}
+
+// returns value length, or -1 if absent; copies into out (caller sizes it
+// via lasp_store_len first)
+int64_t lasp_store_len(void* handle, const char* key, uint32_t key_len) {
+  Store* s = static_cast<Store*>(handle);
+  auto it = s->index.find(std::string(key, key_len));
+  if (it == s->index.end()) return -1;
+  return static_cast<int64_t>(it->second.len);
+}
+
+int64_t lasp_store_get(void* handle, const char* key, uint32_t key_len,
+                       uint8_t* out, uint64_t out_cap) {
+  Store* s = static_cast<Store*>(handle);
+  auto it = s->index.find(std::string(key, key_len));
+  if (it == s->index.end()) return -1;
+  if (it->second.len > out_cap) return -2;
+  long saved = ftell(s->f);
+  fseek(s->f, static_cast<long>(it->second.offset), SEEK_SET);
+  size_t got = fread(out, 1, it->second.len, s->f);
+  fseek(s->f, saved, SEEK_SET);
+  return got == it->second.len ? static_cast<int64_t>(got) : -3;
+}
+
+int lasp_store_delete(void* handle, const char* key, uint32_t key_len) {
+  Store* s = static_cast<Store*>(handle);
+  std::string k(key, key_len);
+  if (s->index.find(k) == s->index.end()) return -1;
+  uint32_t crc = crc32(reinterpret_cast<const uint8_t*>(key), key_len);
+  fwrite(&kRecMagic, 4, 1, s->f);
+  fwrite(&key_len, 4, 1, s->f);
+  fwrite(&kTombstone, 8, 1, s->f);
+  fwrite(key, 1, key_len, s->f);
+  fwrite(&crc, 4, 1, s->f);
+  fflush(s->f);
+  s->wasted += s->index[k].len;
+  s->index.erase(k);
+  return 0;
+}
+
+uint64_t lasp_store_count(void* handle) {
+  return static_cast<Store*>(handle)->index.size();
+}
+
+uint64_t lasp_store_wasted(void* handle) {
+  return static_cast<Store*>(handle)->wasted;
+}
+
+// iterate keys: fills out with \n-joined keys (caller sizes via keys_len)
+uint64_t lasp_store_keys_len(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  uint64_t n = 0;
+  for (auto& kv : s->index) n += kv.first.size() + 1;
+  return n;
+}
+
+void lasp_store_keys(void* handle, char* out) {
+  Store* s = static_cast<Store*>(handle);
+  for (auto& kv : s->index) {
+    memcpy(out, kv.first.data(), kv.first.size());
+    out += kv.first.size();
+    *out++ = '\n';
+  }
+}
+
+void lasp_store_close(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  if (s->f) fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

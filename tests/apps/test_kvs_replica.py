@@ -1,0 +1,93 @@
+"""KVS-replica workload: the CRDT-Map store of
+``riak_test/lasp_kvs_replica_test.erl:55-135`` — put/get/remove against a
+``riak_dt_map`` with an OR-Set field, plus multi-replica convergence of map
+state under gossip (which the reference test never exercises)."""
+
+import jax
+
+from lasp_tpu.lattice import CrdtMap
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.store import PreconditionError, Store
+
+
+def make_store():
+    store = Store(n_actors=4)
+    m = store.declare(
+        id="kvs",
+        type="riak_dt_map",
+        fields=[
+            (("X", "lasp_orset"), "lasp_orset", {"n_elems": 4}),
+            (("Y", "riak_dt_gcounter"), "riak_dt_gcounter", {}),
+        ],
+    )
+    return store, m
+
+
+def test_put_get_remove():
+    # the reference's exact flow: put {'X', lasp_orset} <- add "Chris",
+    # get, remove (riak_test/lasp_kvs_replica_test.erl:62-92)
+    store, m = make_store()
+    key = ("X", "lasp_orset")
+    store.update(m, ("update", [("update", key, ("add", "Chris"))]), "replica1")
+    assert store.value(m) == {key: frozenset({"Chris"})}
+    store.update(m, ("update", [("remove", key)]), "replica1")
+    assert store.value(m) == {}
+    # removing an absent key is a precondition error, as in riak_dt_map
+    try:
+        store.update(m, ("update", [("remove", key)]), "replica1")
+        raise AssertionError("expected PreconditionError")
+    except PreconditionError:
+        pass
+
+
+def test_mixed_fields_and_batched_ops():
+    store, m = make_store()
+    kx = ("X", "lasp_orset")
+    ky = ("Y", "riak_dt_gcounter")
+    store.update(
+        m,
+        ("update", [("update", kx, ("add", "a")), ("update", ky, ("increment", 5))]),
+        "r1",
+    )
+    store.update(m, ("update", [("update", ky, ("increment",))]), "r2")
+    assert store.value(m) == {kx: frozenset({"a"}), ky: 6}
+
+
+def test_map_remove_readd_presence():
+    store, m = make_store()
+    key = ("X", "lasp_orset")
+    store.update(m, ("update", [("update", key, ("add", "v1"))]), "r1")
+    store.update(m, ("update", [("remove", key)]), "r1")
+    assert store.value(m) == {}
+    store.update(m, ("update", [("update", key, ("add", "v2"))]), "r1")
+    # documented dense-shape divergence: contents are join-monotone across
+    # remove/re-add, so v1 resurfaces alongside v2 (presence was the only
+    # thing removed)
+    assert store.value(m)[key] >= frozenset({"v2"})
+
+
+def test_map_gossip_convergence():
+    store, m = make_store()
+    graph = Graph(store)
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 2))
+    key = ("X", "lasp_orset")
+    rt.update_at(0, m, ("update", [("update", key, ("add", "from0"))]), "r0")
+    rt.update_at(2, m, ("update", [("update", key, ("add", "from2"))]), "r2")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.coverage_value(m) == {key: frozenset({"from0", "from2"})}
+    for r in range(4):
+        assert rt.replica_value(m, r) == {key: frozenset({"from0", "from2"})}
+    # a remove at one replica (after observing both adds) wins everywhere
+    rt.update_at(1, m, ("update", [("remove", key)]), "r1")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.coverage_value(m) == {}
+
+
+def test_orswot_store_roundtrip():
+    store = Store(n_actors=4)
+    s = store.declare(type="riak_dt_orswot", n_elems=4)
+    store.update(s, ("add_all", ["a", "b"]), "w1")
+    assert store.value(s) == frozenset({"a", "b"})
+    store.update(s, ("remove", "a"), "w1")
+    assert store.value(s) == frozenset({"b"})

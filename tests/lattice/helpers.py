@@ -37,6 +37,21 @@ def decode_ivar(state):
     return int(np.asarray(state.value)) if bool(np.asarray(state.defined)) else None
 
 
+def decode_orswot(spec, state, elems):
+    """Dense (clock, dots) -> (clock dict, entries dict elem -> actor -> ctr)."""
+    clock = np.asarray(state.clock)
+    dots = np.asarray(state.dots)
+    cdict = {a: int(clock[a]) for a in range(spec.n_actors) if clock[a] != 0}
+    entries = {}
+    for e in range(spec.n_elems):
+        row = {
+            a: int(dots[e, a]) for a in range(spec.n_actors) if dots[e, a] != 0
+        }
+        if row:
+            entries[elems[e]] = row
+    return (cdict, entries)
+
+
 def decode_orset(spec: ORSetSpec, state, elems):
     """Dense (exists, removed) -> dict elem -> dict((actor, k) -> removed)."""
     exists = np.asarray(state.exists)

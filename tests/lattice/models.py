@@ -113,6 +113,82 @@ class PyGCounter:
         return PyGCounter.value(prev) < PyGCounter.value(cur)
 
 
+class PyORSWOT:
+    """Oracle for ``riak_dt_orswot`` as consumed by the framework
+    (``src/lasp_lattice.erl:163-167, 255-262``): state = (clock dict
+    actor -> max event, entries dict elem -> dict actor -> birth counter).
+    ``add`` bumps the actor clock and replaces the element's dots with the
+    fresh single dot; ``remove`` drops the entry; ``merge`` keeps a dot iff
+    both sides hold it or the other side's clock has not seen it."""
+
+    @staticmethod
+    def new():
+        return ({}, {})
+
+    @staticmethod
+    def add(state, elem, actor):
+        clock, entries = state
+        clock = dict(clock)
+        clock[actor] = clock.get(actor, 0) + 1
+        entries = {e: dict(d) for e, d in entries.items()}
+        entries[elem] = {actor: clock[actor]}
+        return (clock, entries)
+
+    @staticmethod
+    def remove(state, elem):
+        clock, entries = state
+        if elem not in entries:
+            raise KeyError(f"precondition: not_present {elem!r}")
+        entries = {e: dict(d) for e, d in entries.items() if e != elem}
+        return (clock, entries)
+
+    @staticmethod
+    def merge(a, b):
+        ca, ea = a
+        cb, eb = b
+        clock = dict(ca)
+        for actor, c in cb.items():
+            clock[actor] = max(clock.get(actor, 0), c)
+        entries = {}
+        for elem in set(ea) | set(eb):
+            da = ea.get(elem, {})
+            db = eb.get(elem, {})
+            keep = {}
+            for actor in set(da) | set(db):
+                va, vb = da.get(actor, 0), db.get(actor, 0)
+                kept = 0
+                if va and (va == vb or va > cb.get(actor, 0)):
+                    kept = max(kept, va)
+                if vb and (vb == va or vb > ca.get(actor, 0)):
+                    kept = max(kept, vb)
+                if kept:
+                    keep[actor] = kept
+            if keep:
+                entries[elem] = keep
+        return (clock, entries)
+
+    @staticmethod
+    def value(state):
+        return frozenset(state[1])
+
+    @staticmethod
+    def is_inflation(prev, cur):
+        # vclock descends (src/lasp_lattice.erl:163-164)
+        return all(cur[0].get(a, 0) >= c for a, c in prev[0].items())
+
+    @staticmethod
+    def is_strict_inflation(prev, cur):
+        # src/lasp_lattice.erl:255-262
+        if not PyORSWOT.is_inflation(prev, cur):
+            return False
+        pc = {a: c for a, c in prev[0].items() if c}
+        cc = {a: c for a, c in cur[0].items() if c}
+        equal_clocks = pc == cc
+        dominates = not equal_clocks
+        deleted = len(cur[1]) < len(prev[1])
+        return (equal_clocks and deleted) or dominates
+
+
 class PyORSet:
     """Oracle for ``src/lasp_orset.erl``: dict elem -> dict(token -> removed?).
 

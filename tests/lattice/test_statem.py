@@ -18,12 +18,14 @@ from lasp_tpu.lattice import (
     GCounterSpec,
     GSet,
     GSetSpec,
+    ORSWOT,
+    ORSWOTSpec,
     ORSet,
     ORSetSpec,
 )
 
-from .helpers import decode_gcounter, decode_gset, decode_orset
-from .models import PyGCounter, PyGSet, PyORSet
+from .helpers import decode_gcounter, decode_gset, decode_orset, decode_orswot
+from .models import PyGCounter, PyGSet, PyORSWOT, PyORSet
 
 N_REPLICAS = 5
 N_OPS = 40
@@ -97,10 +99,34 @@ def run_orset(seed):
     return spec, dense, model
 
 
+def run_orswot(seed):
+    rng = random.Random(seed)
+    spec = ORSWOTSpec(n_elems=len(ELEMS), n_actors=N_REPLICAS)
+    dense = [ORSWOT.new(spec) for _ in range(N_REPLICAS)]
+    model = [PyORSWOT.new() for _ in range(N_REPLICAS)]
+    for _ in range(N_OPS):
+        r = rng.randrange(N_REPLICAS)
+        roll = rng.random()
+        if roll < 0.5:
+            e = rng.randrange(len(ELEMS))
+            dense[r] = ORSWOT.add(spec, dense[r], e, r)
+            model[r] = PyORSWOT.add(model[r], ELEMS[e], r)
+        elif roll < 0.7 and model[r][1]:
+            elem = rng.choice(sorted(model[r][1]))
+            dense[r] = ORSWOT.remove(spec, dense[r], ELEMS.index(elem))
+            model[r] = PyORSWOT.remove(model[r], elem)
+        else:
+            r2 = rng.randrange(N_REPLICAS)
+            dense[r] = ORSWOT.merge(spec, dense[r], dense[r2])
+            model[r] = PyORSWOT.merge(model[r], model[r2])
+    return spec, dense, model
+
+
 CASES = {
     "gset": (run_gset, GSet, decode_gset, PyGSet, True),
     "gcounter": (run_gcounter, GCounter, decode_gcounter, PyGCounter, False),
     "orset": (run_orset, ORSet, decode_orset, PyORSet, True),
+    "orswot": (run_orswot, ORSWOT, decode_orswot, PyORSWOT, True),
 }
 
 
@@ -161,6 +187,34 @@ def test_vmapped_merge_matches_loop(name):
         expect = codec.merge(spec, dense[i], dense[(i - 1) % N_REPLICAS])
         got = jax.tree_util.tree_map(lambda x: x[i], vmerged)
         assert bool(codec.equal(spec, expect, got))
+
+
+def test_orswot_inflation_matches_model():
+    spec, dense, model = run_orswot(31)
+    for i in range(N_REPLICAS):
+        for j in range(N_REPLICAS):
+            assert bool(ORSWOT.is_inflation(spec, dense[i], dense[j])) == (
+                PyORSWOT.is_inflation(model[i], model[j])
+            ), (i, j)
+            assert bool(ORSWOT.is_strict_inflation(spec, dense[i], dense[j])) == (
+                PyORSWOT.is_strict_inflation(model[i], model[j])
+            ), (i, j)
+
+
+def test_orswot_remove_wins_over_stale_add():
+    # the no-tombstone property: a removal propagates to a replica that
+    # still holds the element, because its dot is seen by the remover's
+    # clock; a concurrent NEWER add survives
+    spec = ORSWOTSpec(n_elems=2, n_actors=2)
+    a = ORSWOT.add(spec, ORSWOT.new(spec), 0, 0)
+    b = ORSWOT.merge(spec, ORSWOT.new(spec), a)  # b observed the add
+    b = ORSWOT.remove(spec, b, 0)
+    merged = ORSWOT.merge(spec, a, b)
+    assert not bool(ORSWOT.value(spec, merged)[0])  # remove wins
+    # concurrent re-add at a (unseen by b) must survive the same merge
+    a2 = ORSWOT.add(spec, a, 0, 0)
+    merged2 = ORSWOT.merge(spec, a2, b)
+    assert bool(ORSWOT.value(spec, merged2)[0])
 
 
 def test_orset_inflation_matches_model():

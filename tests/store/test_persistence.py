@@ -1,0 +1,206 @@
+"""Host-store and checkpoint tests: the durable layer (SURVEY.md §2.4/§5 —
+the eleveldb/bitcask/dets roles). Covers native-vs-Python on-disk format
+interop, torn-write recovery, and full store/runtime checkpoint roundtrips."""
+
+import os
+
+import pytest
+
+import lasp_tpu.store.host_store as hs_mod
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import (
+    HostStore,
+    Store,
+    load_runtime,
+    load_store,
+    save_runtime,
+    save_store,
+)
+
+BACKENDS = ["native", "python-fallback"]
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request, monkeypatch):
+    if request.param == "python-fallback":
+        monkeypatch.setattr(hs_mod, "_NATIVE", None)
+    elif hs_mod._NATIVE is None:
+        pytest.skip("native library not built")
+    return request.param
+
+
+def test_put_get_delete_roundtrip(tmp_path, backend):
+    p = str(tmp_path / "kv.log")
+    with HostStore(p) as s:
+        assert s.backend == backend
+        s.put("a", b"hello")
+        s.put("b", b"\x00" * 1000)
+        s.put("a", b"hello2")  # supersede
+        assert s.get("a") == b"hello2"
+        assert s.get("b") == b"\x00" * 1000
+        assert s.get("missing") is None
+        assert s.delete("b")
+        assert not s.delete("b")
+        assert s.get("b") is None
+        assert s.stats()["keys"] == 1
+        assert s.stats()["wasted_bytes"] > 0
+    # reopen: index rebuilt from the log
+    with HostStore(p) as s:
+        assert s.get("a") == b"hello2"
+        assert s.get("b") is None
+        assert s.keys() == ["a"]
+
+
+def test_format_interop(tmp_path):
+    """The Python fallback reads files the native engine wrote and vice
+    versa (identical record format, zlib CRC-32)."""
+    if hs_mod._NATIVE is None:
+        pytest.skip("native library not built")
+    p = str(tmp_path / "x.log")
+    with HostStore(p) as s:
+        assert s.backend == "native"
+        s.put("k1", b"from-native")
+    native = hs_mod._NATIVE
+    try:
+        hs_mod._NATIVE = None
+        with HostStore(p) as s:
+            assert s.backend == "python-fallback"
+            assert s.get("k1") == b"from-native"
+            s.put("k2", b"from-python")
+    finally:
+        hs_mod._NATIVE = native
+    with HostStore(p) as s:
+        assert s.backend == "native"
+        assert s.get("k1") == b"from-native"
+        assert s.get("k2") == b"from-python"
+
+
+def test_torn_write_recovery(tmp_path, backend):
+    p = str(tmp_path / "torn.log")
+    with HostStore(p) as s:
+        s.put("good", b"A" * 100)
+    size = os.path.getsize(p)
+    with open(p, "ab") as f:  # simulate a crash mid-record
+        f.write(b"\x52\x50\x53\x4c" + b"garbage-partial-record")
+    with HostStore(p) as s:
+        assert s.get("good") == b"A" * 100  # valid prefix survives
+        s.put("after", b"B")  # appends over the torn tail
+        assert s.get("after") == b"B"
+    assert os.path.getsize(p) > size - 1
+
+
+def test_store_checkpoint_roundtrip(tmp_path):
+    store = Store(n_actors=4)
+    o = store.declare(type="lasp_orset", n_elems=8)
+    c = store.declare(type="riak_dt_gcounter")
+    v = store.declare(type="lasp_ivar")
+    m = store.declare(
+        type="riak_dt_map",
+        fields=[(("X", "lasp_orset"), "lasp_orset", {"n_elems": 4})],
+    )
+    store.update(o, ("add_all", ["a", "b"]), "w1")
+    store.update(o, ("remove", "a"), "w1")
+    store.update(c, ("increment", 7), "w2")
+    store.update(v, ("set", ("compound", "payload")), "w1")
+    store.update(m, ("update", [("update", ("X", "lasp_orset"), ("add", "f"))]), "w3")
+
+    path = str(tmp_path / "ckpt.log")
+    save_store(store, path)
+    loaded = load_store(path)
+    assert loaded.value(o) == frozenset({"b"})
+    assert loaded.value(c) == 7
+    assert loaded.value(v) == ("compound", "payload")
+    assert loaded.value(m) == {("X", "lasp_orset"): frozenset({"f"})}
+    # resumed stores keep working: writer universes restored in order
+    loaded.update(o, ("add", "c"), "w1")
+    assert loaded.value(o) == frozenset({"b", "c"})
+
+
+def test_store_resume_with_dataflow_outputs(tmp_path):
+    # the documented workflow: save a store whose combinator outputs hold
+    # values, load it, re-register the same edges, keep going — covers every
+    # universe flavor (own interner: map; shared: filter; derived: product)
+    store = Store(n_actors=4)
+    g = Graph(store)
+    a = store.declare(id="a", type="lasp_orset", n_elems=4)
+    b = store.declare(id="b", type="lasp_orset", n_elems=4)
+    g.map(a, lambda x: x * 2, dst="m")
+    g.filter(a, lambda x: x > 1, dst="f")
+    g.product(a, b, dst="p")
+    store.update(a, ("add_all", [1, 2]), "w")
+    store.update(b, ("add", "z"), "w")
+    g.propagate()
+    assert store.value("m") == frozenset({2, 4})
+
+    path = str(tmp_path / "flow.ck")
+    save_store(store, path)
+    s2 = load_store(path)
+    g2 = Graph(s2)
+    g2.map("a", lambda x: x * 2, dst="m")
+    g2.filter("a", lambda x: x > 1, dst="f")
+    g2.product("a", "b", dst="p")
+    # restored values intact and decodable
+    assert s2.value("m") == frozenset({2, 4})
+    assert s2.value("f") == frozenset({2})
+    assert s2.value("p") == frozenset({(1, "z"), (2, "z")})
+    # and the resumed graph keeps propagating
+    s2.update("a", ("add", 3), "w")
+    g2.propagate()
+    assert s2.value("m") == frozenset({2, 4, 6})
+    assert s2.value("f") == frozenset({2, 3})
+    assert s2.value("p") == frozenset({(1, "z"), (2, "z"), (3, "z")})
+
+
+def test_map_field_caps_validated():
+    import pytest
+
+    store = Store(n_actors=4)
+    with pytest.raises(TypeError, match="n_elem"):
+        store.declare(
+            type="riak_dt_map",
+            fields=[(("k", "lasp_orset"), "lasp_orset", {"n_elem": 2})],
+        )
+    with pytest.raises(TypeError, match="nested"):
+        store.declare(
+            type="riak_dt_map",
+            fields=[(("k", "riak_dt_map"), "riak_dt_map", {})],
+        )
+
+
+def test_orswot_duplicate_remove_in_batch_rejected():
+    import pytest
+
+    from lasp_tpu.store import PreconditionError
+
+    store = Store(n_actors=4)
+    s = store.declare(type="riak_dt_orswot", n_elems=4)
+    store.update(s, ("add", "x"), "w")
+    with pytest.raises(PreconditionError):
+        store.update(s, ("remove_all", ["x", "x"]), "w")
+
+
+def test_runtime_checkpoint_roundtrip(tmp_path):
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    src = store.declare(id="src", type="lasp_orset", n_elems=4)
+    graph.map(src, lambda x: x * 2, dst="out")
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 2))
+    rt.update_at(0, src, ("add", 3), "a")
+    rt.run_to_convergence(max_rounds=16)
+
+    path = str(tmp_path / "rt.log")
+    save_runtime(rt, path)
+
+    def rebuild(new_store):
+        g = Graph(new_store)
+        g.map("src", lambda x: x * 2, dst="out")
+        return g
+
+    rt2 = load_runtime(path, graph=rebuild)
+    assert rt2.n_replicas == 4
+    assert rt2.coverage_value("out") == frozenset({6})
+    # resumed runtime continues: new update converges through the graph
+    rt2.update_at(2, "src", ("add", 5), "a")
+    rt2.run_to_convergence(max_rounds=16)
+    assert rt2.coverage_value("out") == frozenset({6, 10})

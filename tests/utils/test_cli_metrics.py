@@ -1,0 +1,67 @@
+"""CLI (L6 console role) and step-trace metrics tests."""
+
+import json
+
+from lasp_tpu import cli
+from lasp_tpu.utils.metrics import StepTrace
+
+
+def test_cli_status(capsys):
+    assert cli.main(["status"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["platform"] == "cpu"
+    assert len(out["devices"]) == 8
+
+
+def test_cli_simulate(capsys):
+    rc = cli.main(
+        ["simulate", "--replicas", "64", "--topology", "ring", "--writers", "4"]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["rounds_to_convergence"] >= 1
+    assert out["residual_path"][-1] == 0
+    assert out["value_size"] == 4
+
+
+def test_cli_inspect_checkpoint(tmp_path, capsys):
+    from lasp_tpu.store import Store, save_store
+
+    store = Store(n_actors=4)
+    v = store.declare(type="lasp_gset", n_elems=4)
+    store.update(v, ("add", "x"), "w")
+    path = str(tmp_path / "c.log")
+    save_store(store, path)
+    assert cli.main(["inspect", path]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["kind"] == "store"
+    assert out["vars"][v] == "lasp_gset"
+
+
+def test_runtime_records_trace():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=4)
+    v = store.declare(type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, Graph(store), 8, ring(8, 2))
+    rt.update_at(0, v, ("add", "x"), "w")
+    rounds = rt.run_to_convergence(max_rounds=16)
+    s = rt.trace.summary()
+    assert s["rounds"] == rounds
+    assert s["residual_path"][-1] == 0
+    assert s["seconds"] > 0
+
+
+def test_step_trace_counters():
+    t = StepTrace()
+    t.bump("merges", 5)
+    t.bump("merges")
+    t.record_round(3, 0.25)
+    assert t.summary() == {
+        "rounds": 1,
+        "seconds": 0.25,
+        "residual_path": [3],
+        "merges": 6,
+    }
