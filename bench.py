@@ -1,13 +1,16 @@
 """Benchmark: OR-Set anti-entropy convergence (BASELINE.md headline).
 
-Workload: the 100K-replica OR-Set anti-entropy config from the driver's
-BASELINE ("random gossip"): every replica performs one local add, then
-gossip rounds run until every replica equals the global join. The headline
-metric is replica-merges/sec/chip (one merge = one pairwise OR-Set join of
-``[E, T]`` token tensors); ``vs_baseline`` is the speedup over a host-side
-NumPy merge loop measured in the same run — the stand-in for the reference's
-per-replica sequential ETS-backend merge path (the reference itself
-publishes no numbers, SURVEY.md §6).
+Workload: the 1M-replica OR-Set anti-entropy config ("random gossip"):
+every replica performs one local add, then pull-gossip rounds run until no
+replica's state changes (the join fixed point). State rides the bit-packed
+OR-Set codec (``lasp_tpu.ops.packed`` — 1 bit/token in HBM) and rounds run
+in fused blocks (``lasp_tpu.ops.fused``) so dispatch does not dominate.
+
+The headline metric is replica-merges/sec/chip (one merge = one pairwise
+OR-Set join); ``vs_baseline`` is the speedup over a host-side NumPy merge
+loop on the SAME logical state shape — the stand-in for the reference's
+sequential per-replica ETS-backend merge path (the reference publishes no
+numbers of its own, SURVEY.md §6).
 
 Prints exactly one JSON line:
     {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
@@ -25,54 +28,19 @@ import numpy as np
 
 def main() -> None:
     import jax
-    import jax.numpy as jnp
 
-    from lasp_tpu.lattice import ORSet, ORSetSpec, replicate
-    from lasp_tpu.mesh import divergence, gossip_round, random_regular
+    from lasp_tpu.bench_scenarios import orset_anti_entropy
 
-    n_replicas = int(os.environ.get("LASP_BENCH_REPLICAS", 1 << 17))
-    k = 3
-    spec = ORSetSpec(n_elems=8, n_actors=8, tokens_per_actor=4)
+    n_replicas = int(os.environ.get("LASP_BENCH_REPLICAS", 1 << 20))
+    block = int(os.environ.get("LASP_BENCH_BLOCK", 4))
 
-    def seed(n):
-        states = replicate(ORSet.new(spec), n)
-        r = jnp.arange(n)
-        return jax.vmap(lambda i, s: ORSet.add(spec, s, i % spec.n_elems, i % spec.n_actors))(
-            r, states
-        )
+    out = orset_anti_entropy(n_replicas, block=block)
+    tpu_rate = out["merges_per_sec"]
 
-    neighbors = jnp.asarray(random_regular(n_replicas, k, seed=7))
-
-    @jax.jit
-    def round_fn(s, nb):
-        return gossip_round(ORSet, spec, s, nb)
-
-    @jax.jit
-    def residual_fn(s):
-        return divergence(ORSet, spec, s)
-
-    # compile warmup (not timed)
-    states = seed(n_replicas)
-    jax.block_until_ready(round_fn(states, neighbors))
-    jax.block_until_ready(residual_fn(states))
-
-    # timed convergence run from fresh state
-    states = seed(n_replicas)
-    jax.block_until_ready(states)
-    t0 = time.perf_counter()
-    rounds = 0
-    for _ in range(64):
-        states = round_fn(states, neighbors)
-        rounds += 1
-        if int(residual_fn(states)) == 0:
-            break
-    jax.block_until_ready(states)
-    elapsed = time.perf_counter() - t0
-    merges = n_replicas * k * rounds
-    tpu_rate = merges / elapsed
-
-    # host NumPy baseline: sequential pairwise joins of the same state shape
-    a_e = np.zeros((spec.n_elems, spec.n_tokens), dtype=bool)
+    # host NumPy baseline: sequential pairwise joins of the same logical
+    # state shape (byte bools, as a host implementation would hold them)
+    e, t = 8, 32  # matches orset_anti_entropy's spec (n_elems, n_tokens)
+    a_e = np.zeros((e, t), dtype=bool)
     a_r = np.zeros_like(a_e)
     b_e = np.ones_like(a_e)
     b_r = np.zeros_like(a_e)
@@ -88,14 +56,15 @@ def main() -> None:
         json.dumps(
             {
                 "metric": "orset_replica_merges_per_sec_per_chip",
-                "value": round(tpu_rate, 1),
+                "value": tpu_rate,
                 "unit": "merges/s",
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
                 "detail": {
                     "n_replicas": n_replicas,
-                    "fanout": k,
-                    "rounds_to_convergence": rounds,
-                    "elapsed_s": round(elapsed, 3),
+                    "fanout": out["fanout"],
+                    "rounds_executed": out["rounds"],
+                    "elapsed_s": out["seconds"],
+                    "encoding": "packed-uint32",
                     "cpu_baseline_merges_per_sec": round(cpu_rate, 1),
                     "device": str(jax.devices()[0].platform),
                 },

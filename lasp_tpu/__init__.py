@@ -16,7 +16,7 @@ Layer map (mirrors SURVEY.md §1, redesigned per §7):
 
 __version__ = "0.1.0"
 
-from . import api, dataflow, lattice, mesh, programs, store
+from . import api, dataflow, lattice, mesh, ops, programs, store
 from .api import Session
 
 __all__ = [
@@ -25,6 +25,7 @@ __all__ = [
     "dataflow",
     "lattice",
     "mesh",
+    "ops",
     "programs",
     "store",
     "__version__",

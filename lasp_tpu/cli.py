@@ -70,6 +70,26 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def cmd_scenario(args) -> int:
+    import inspect
+
+    from lasp_tpu.bench_scenarios import SCENARIOS
+
+    fn = SCENARIOS[args.name]
+    kwargs = {}
+    if args.replicas:
+        if "n_replicas" not in inspect.signature(fn).parameters:
+            print(
+                f"error: scenario {args.name!r} has a fixed population; "
+                "--replicas is not applicable",
+                file=sys.stderr,
+            )
+            return 2
+        kwargs["n_replicas"] = args.replicas
+    print(json.dumps(fn(**kwargs)))
+    return 0
+
+
 def cmd_inspect(args) -> int:
     import pickle
 
@@ -109,6 +129,15 @@ def main(argv=None) -> int:
     bench = sub.add_parser("bench", help="run the headline benchmark")
     bench.add_argument("--replicas", type=int, default=0)
 
+    scen = sub.add_parser("scenario", help="run a BASELINE eval config")
+    scen.add_argument(
+        "name",
+        choices=["adcounter_6", "gset_1k", "orset_100k", "pipeline_1m",
+                 "adcounter_10m"],
+    )
+    scen.add_argument("--replicas", type=int, default=0,
+                      help="override the population for sized scenarios")
+
     ins = sub.add_parser("inspect", help="list a checkpoint's contents")
     ins.add_argument("path")
 
@@ -117,6 +146,7 @@ def main(argv=None) -> int:
         "status": cmd_status,
         "simulate": cmd_simulate,
         "bench": cmd_bench,
+        "scenario": cmd_scenario,
         "inspect": cmd_inspect,
     }[args.verb](args)
 

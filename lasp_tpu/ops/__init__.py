@@ -1,0 +1,22 @@
+"""Hot-path kernels (SURVEY.md §7 stance: Pallas/packed kernels for the
+irregular merge cores). The packed OR-Set is the HBM-bandwidth-optimal
+encoding of the framework's hottest object (reference hot path
+``src/lasp_core.erl:300-301``)."""
+
+from .packed import (
+    PackedORSet,
+    PackedORSetSpec,
+    PackedORSetState,
+    pack_orset,
+    unpack_orset,
+)
+from .fused import fused_gossip_rounds
+
+__all__ = [
+    "PackedORSet",
+    "PackedORSetSpec",
+    "PackedORSetState",
+    "fused_gossip_rounds",
+    "pack_orset",
+    "unpack_orset",
+]

@@ -1,0 +1,191 @@
+"""Bit-packed OR-Set: token flags as uint32 words, 8x less HBM than bools.
+
+The dense OR-Set (``lasp_tpu.lattice.orset``) stores ``bool[E, T]`` planes;
+XLA materializes bools as one byte each, so a gossip round at 10M replicas
+moves ~8x more HBM bytes than the information content. This codec packs the
+token axis into ``uint32[E, ceil(T/32)]`` words: merge stays a pure
+elementwise OR (now on 32 tokens per lane), value/member become popcount
+reductions, and the whole state is 1 bit per token — the encoding the
+BASELINE 10M-replica configs run on.
+
+Semantics are IDENTICAL to the dense codec (same reference contract,
+``src/lasp_orset.erl:128-134`` merge / :67-73 value); ``pack_orset`` /
+``unpack_orset`` convert losslessly, and the property suite cross-checks
+every operation against the dense codec.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..lattice.orset import ORSetSpec, ORSetState
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedORSetSpec:
+    n_elems: int
+    n_actors: int
+    tokens_per_actor: int = 4
+    token_space: int | None = None
+
+    @property
+    def n_tokens(self) -> int:
+        if self.token_space is not None:
+            return self.token_space
+        return self.n_actors * self.tokens_per_actor
+
+    @property
+    def n_words(self) -> int:
+        return (self.n_tokens + 31) // 32
+
+    def dense(self) -> ORSetSpec:
+        return ORSetSpec(
+            n_elems=self.n_elems,
+            n_actors=self.n_actors,
+            tokens_per_actor=self.tokens_per_actor,
+            token_space=self.token_space,
+        )
+
+
+class PackedORSetState(NamedTuple):
+    exists: jax.Array  # uint32[E, W]
+    removed: jax.Array  # uint32[E, W]
+
+
+def _word_bit(token_idx):
+    return token_idx // 32, jnp.uint32(1) << (token_idx % 32).astype(jnp.uint32)
+
+
+class PackedORSet:
+    name = "lasp_orset_packed"
+
+    @staticmethod
+    def new(spec: PackedORSetSpec) -> PackedORSetState:
+        shape = (spec.n_elems, spec.n_words)
+        return PackedORSetState(
+            exists=jnp.zeros(shape, dtype=jnp.uint32),
+            removed=jnp.zeros(shape, dtype=jnp.uint32),
+        )
+
+    # -- updates ------------------------------------------------------------
+    @staticmethod
+    def add_by_token(spec, state, elem_idx, token_idx) -> PackedORSetState:
+        token_idx = jnp.asarray(token_idx)
+        w, bit = _word_bit(token_idx)
+        return PackedORSetState(
+            exists=state.exists.at[elem_idx, w].set(state.exists[elem_idx, w] | bit),
+            removed=state.removed.at[elem_idx, w].set(
+                state.removed[elem_idx, w] & ~bit
+            ),
+        )
+
+    @staticmethod
+    def add(spec, state, elem_idx, actor_idx) -> PackedORSetState:
+        """Mint the actor's first free slot (dense ``ORSet.add`` contract:
+        pool-exhausted adds drop)."""
+        k = spec.tokens_per_actor
+        base = actor_idx * k
+        # extract the actor's k-bit pool spread over words
+        offs = base + jnp.arange(k)
+        w, bit = _word_bit(offs)
+        taken = (state.exists[elem_idx, w] & bit) != 0
+        free = jnp.argmax(~taken)
+        in_range = ~taken[free]
+        slot = base + free
+        sw, sbit = _word_bit(slot)
+        sbit = jnp.where(in_range, sbit, jnp.uint32(0))
+        return PackedORSetState(
+            exists=state.exists.at[elem_idx, sw].set(state.exists[elem_idx, sw] | sbit),
+            removed=state.removed.at[elem_idx, sw].set(
+                state.removed[elem_idx, sw] & ~sbit
+            ),
+        )
+
+    @staticmethod
+    def remove(spec, state, elem_idx) -> PackedORSetState:
+        return PackedORSetState(
+            exists=state.exists,
+            removed=state.removed.at[elem_idx].set(
+                state.removed[elem_idx] | state.exists[elem_idx]
+            ),
+        )
+
+    @staticmethod
+    def apply_masks(spec, state, add_tokens, remove_elems) -> PackedORSetState:
+        """Batched update kernel (packed counterpart of
+        ``ORSet.apply_masks``): ``add_tokens: uint32[E, W]``,
+        ``remove_elems: bool[E]``."""
+        exists = state.exists | add_tokens
+        removed = state.removed | jnp.where(
+            remove_elems[..., None], exists, jnp.uint32(0)
+        )
+        return PackedORSetState(exists=exists, removed=removed)
+
+    # -- lattice ------------------------------------------------------------
+    @staticmethod
+    def merge(spec, a, b) -> PackedORSetState:
+        return PackedORSetState(
+            exists=a.exists | b.exists, removed=a.removed | b.removed
+        )
+
+    @staticmethod
+    def value(spec, state) -> jax.Array:
+        """bool[E]: any live token (exists bit without removed bit)."""
+        return jnp.any(state.exists & ~state.removed, axis=-1)
+
+    @staticmethod
+    def member_mask(spec, state) -> jax.Array:
+        return jnp.any(state.exists != 0, axis=-1)
+
+    @staticmethod
+    def equal(spec, a, b) -> jax.Array:
+        return jnp.all(a.exists == b.exists) & jnp.all(
+            (a.removed & a.exists) == (b.removed & b.exists)
+        )
+
+    @staticmethod
+    def is_inflation(spec, prev, cur) -> jax.Array:
+        return jnp.all((prev.exists & ~cur.exists) == 0)
+
+    @staticmethod
+    def is_strict_inflation(spec, prev, cur) -> jax.Array:
+        inflation = jnp.all((prev.exists & ~cur.exists) == 0)
+        changed = jnp.any(
+            (prev.exists != cur.exists)
+            | ((prev.removed & prev.exists) != (cur.removed & cur.exists))
+        )
+        return inflation & changed
+
+
+def pack_orset(spec: PackedORSetSpec, dense: ORSetState) -> PackedORSetState:
+    """bool[..., E, T] planes -> uint32[..., E, W] words (lossless)."""
+    t = spec.n_tokens
+    pad = spec.n_words * 32 - t
+
+    def pack_plane(plane):
+        p = jnp.pad(plane.astype(jnp.uint32), [(0, 0)] * (plane.ndim - 1) + [(0, pad)])
+        p = p.reshape(p.shape[:-1] + (spec.n_words, 32))
+        weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+        return jnp.sum(p * weights, axis=-1, dtype=jnp.uint32)
+
+    return PackedORSetState(
+        exists=pack_plane(dense.exists),
+        removed=pack_plane(dense.removed & dense.exists),
+    )
+
+
+def unpack_orset(spec: PackedORSetSpec, packed: PackedORSetState) -> ORSetState:
+    t = spec.n_tokens
+
+    def unpack_plane(words):
+        bits = (words[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+        flat = bits.reshape(words.shape[:-1] + (spec.n_words * 32,))
+        return flat[..., :t].astype(bool)
+
+    return ORSetState(
+        exists=unpack_plane(packed.exists), removed=unpack_plane(packed.removed)
+    )

@@ -1,0 +1,57 @@
+"""BASELINE eval-config scenarios at CI scale (the full populations run on
+the TPU via ``cli scenario`` / the driver bench). Every scenario embeds its
+own correctness cross-check against reference semantics; these tests assert
+the checks hold at small populations on the CPU mesh."""
+
+import pytest
+
+from lasp_tpu.bench_scenarios import (
+    SCENARIOS,
+    adcounter_6,
+    adcounter_10m,
+    gset_1k,
+    orset_100k,
+    pipeline_1m,
+)
+
+
+def test_scenario_registry_complete():
+    assert set(SCENARIOS) == {
+        "adcounter_6",
+        "gset_1k",
+        "orset_100k",
+        "pipeline_1m",
+        "adcounter_10m",
+    }
+
+
+def test_adcounter_6():
+    out = adcounter_6()
+    assert sum(out["totals"]) == 100
+    assert out["rounds"] >= 1
+
+
+def test_gset_1k():
+    out = gset_1k()
+    assert out["union_size"] >= out["intersection_size"]
+    assert out["check"] == "matches-global-reference"
+
+
+def test_orset_small():
+    out = orset_100k(n_replicas=2048)
+    assert out["check"] == "converged+all-live"
+    assert out["merges_per_sec"] > 0
+
+
+def test_pipeline_small():
+    out = pipeline_1m(n_replicas=4096)
+    assert out["check"] == "fold==reference"
+    assert out["folded_count"] > 0
+
+
+def test_adcounter_small():
+    out = adcounter_10m(n_replicas=8192, threshold=5)
+    assert out["check"] == "live==(<threshold)"
+    # with 8192 replicas spread over 8 ads x 8 buckets, every ad passes the
+    # threshold and gets disabled
+    assert out["live_ads"] == 0
