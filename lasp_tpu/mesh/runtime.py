@@ -174,6 +174,33 @@ class ReplicatedRuntime:
         var = self.store.variable(var_id)
         return int(divergence(var.codec, var.spec, self.states[var_id]))
 
+    def read_at(self, replica: int, var_id: str, threshold=None):
+        """Non-blocking threshold check against one replica's row — the
+        vnode-local read (``src/lasp_vnode.erl:402-407``). Returns the row
+        state when the threshold is met, else None."""
+        var = self.store.variable(var_id)
+        thr = self.store._resolve_threshold(var, threshold)
+        row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
+        if bool(var.codec.threshold_met(var.spec, row, thr)):
+            return row
+        return None
+
+    def read_until(self, replica: int, var_id: str, threshold=None,
+                   max_rounds: int = 10_000, edge_mask=None):
+        """Blocking monotonic threshold read (``lasp:read/2`` semantics,
+        ``src/lasp_core.erl:329-364``): steps the mesh until the threshold
+        is met at the given replica, then returns that replica's state.
+        The reference parks a process and wakes it on write; here the
+        bulk-synchronous loop IS the scheduler."""
+        for _ in range(max_rounds):
+            row = self.read_at(replica, var_id, threshold)
+            if row is not None:
+                return row
+            self.step(edge_mask)
+        raise TimeoutError(
+            f"threshold not met at replica {replica} within {max_rounds} rounds"
+        )
+
     # -- sharding -------------------------------------------------------------
     def shard(self, mesh: jax.sharding.Mesh, axis: str = "replicas") -> None:
         """Distribute every variable's replica axis over a mesh axis; states

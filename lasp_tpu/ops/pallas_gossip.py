@@ -1,0 +1,155 @@
+"""Pallas TPU kernel: fused gather + lattice-join gossip round.
+
+The XLA lowering of a gossip round materializes K gathered neighbor arrays
+(one ``[R, D]`` copy per fan-in edge per plane) in HBM before the OR joins
+fuse. This kernel streams instead: for each replica-block, the neighbor
+rows are DMA'd directly from the full HBM-resident state into VMEM scratch
+and joined there — per round, HBM sees K row *reads* and one row *write*
+per replica per plane, never an intermediate gathered array.
+
+Shapes: packed planes ride as ``uint32[R, D//128, 128]`` (``D`` =
+n_elems * n_words lane-padded to 128; the leading replica axis must stay
+OUTSIDE the (8, 128)-tiled trailing pair, because Mosaic only allows
+single-row dynamic HBM slices along untiled batch dimensions) with
+``neighbors int32[R, K]`` blocked into SMEM per replica-block (a whole-table
+scalar prefetch would overflow SMEM at million-replica populations). Both
+OR-Set planes are joined in one kernel launch since they share the
+neighbor gather.
+
+Correctness is pinned against :func:`lasp_tpu.mesh.gossip.gossip_round` in
+interpret mode on CPU and compiled on TPU; ``bench_pallas.py`` compares
+against the XLA path (results recorded in the docstring of that script).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+
+
+def _round_kernel(
+    nbr_ref,  # int32[B, K] — this block's neighbor rows (SMEM)
+    exists_blk,  # uint32[B, D] — own rows of the exists plane
+    removed_blk,  # uint32[B, D] — own rows of the removed plane
+    exists_hbm,  # uint32[R, D] — full plane (ANY/HBM, DMA source)
+    removed_hbm,  # uint32[R, D]
+    out_exists,  # uint32[B, D]
+    out_removed,  # uint32[B, D]
+    scratch_e,  # VMEM uint32[K, D]
+    scratch_r,  # VMEM uint32[K, D]
+    sem_e,  # DMA sems [K]
+    sem_r,  # DMA sems [K]
+    *,
+    block: int,
+    k: int,
+):
+    del block
+    def row_body(r, _):
+        # launch the K neighbor-row fetches for both planes, then join
+        def start(j, __):
+            idx = nbr_ref[r, j]
+            pltpu.make_async_copy(
+                exists_hbm.at[idx], scratch_e.at[j], sem_e.at[j]
+            ).start()
+            pltpu.make_async_copy(
+                removed_hbm.at[idx], scratch_r.at[j], sem_r.at[j]
+            ).start()
+            return 0
+
+        jax.lax.fori_loop(0, k, start, 0)
+
+        def wait(j, acc):
+            acc_e, acc_r = acc
+            pltpu.make_async_copy(
+                exists_hbm.at[nbr_ref[r, j]], scratch_e.at[j], sem_e.at[j]
+            ).wait()
+            pltpu.make_async_copy(
+                removed_hbm.at[nbr_ref[r, j]], scratch_r.at[j], sem_r.at[j]
+            ).wait()
+            return (acc_e | scratch_e[j], acc_r | scratch_r[j])
+
+        acc_e, acc_r = jax.lax.fori_loop(
+            0, k, wait, (exists_blk[r], removed_blk[r])
+        )
+        out_exists[r, :] = acc_e
+        out_removed[r, :] = acc_r
+        return 0
+
+    jax.lax.fori_loop(0, out_exists.shape[0], row_body, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def pallas_gossip_round(exists, removed, neighbors, block: int = 8, interpret: bool = False):
+    """One pull-gossip round over packed OR-Set planes.
+
+    ``exists``/``removed``: uint32[R, D] with D a multiple of 128 and R a
+    multiple of ``block``; ``neighbors``: int32[R, K]. Returns the joined
+    planes (same shapes)."""
+    r_total, d = exists.shape
+    k = neighbors.shape[1]
+    assert d % LANE == 0, f"lane dim {d} must be a multiple of {LANE}"
+    assert r_total % block == 0, f"{r_total} rows not divisible by block {block}"
+    w = d // LANE
+    # 3D layout: replica axis outside the (8, 128)-tiled trailing pair so
+    # per-row dynamic HBM slices are legal at any index
+    e3 = exists.reshape(r_total, w, LANE)
+    m3 = removed.reshape(r_total, w, LANE)
+
+    kernel = functools.partial(_round_kernel, block=block, k=k)
+    out_e, out_r = pl.pallas_call(
+        kernel,
+        grid=(r_total // block,),
+        in_specs=[
+            pl.BlockSpec((block, k), lambda i: (i, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec(
+                (block, w, LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (block, w, LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (block, w, LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (block, w, LANE), lambda i: (i, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((k, w, LANE), jnp.uint32),
+            pltpu.VMEM((k, w, LANE), jnp.uint32),
+            pltpu.SemaphoreType.DMA((k,)),
+            pltpu.SemaphoreType.DMA((k,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((r_total, w, LANE), jnp.uint32),
+            jax.ShapeDtypeStruct((r_total, w, LANE), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(neighbors, e3, m3, e3, m3)
+    return out_e.reshape(r_total, d), out_r.reshape(r_total, d)
+
+
+def flatten_plane(plane, lane: int = LANE):
+    """``uint32[R, E, W] -> uint32[R, D]`` with D lane-padded."""
+    r = plane.shape[0]
+    flat = plane.reshape(r, -1)
+    d = flat.shape[1]
+    pad = (-d) % lane
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return flat, d
+
+
+def unflatten_plane(flat, shape):
+    r, e, w = shape
+    return flat[:, : e * w].reshape(r, e, w)

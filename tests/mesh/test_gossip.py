@@ -166,6 +166,24 @@ class TestReplicatedRuntime:
         assert rt.divergence(s1) == 0
 
 
+def test_read_until_blocks_for_gossip():
+    # the blocking monotonic read: a replica far from the writer must wait
+    # for the update to gossip over before its threshold fires
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=4)
+    c = store.declare(id="ctr", type="riak_dt_gcounter")
+    rt = ReplicatedRuntime(store, Graph(store), 8, ring(8, 1))
+    rt.update_at(0, c, ("increment", 5), "w")
+    assert rt.read_at(4, c, Threshold(5)) is None  # not arrived yet
+    row = rt.read_until(4, c, Threshold(5), max_rounds=16)
+    assert int(row.counts.sum()) == 5
+    with pytest.raises(TimeoutError):
+        rt.read_until(4, c, Threshold(99), max_rounds=4)
+
+
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
 def test_sharded_gossip_over_device_mesh():
     # the multi-chip path: replica axis split over an 8-device mesh; the

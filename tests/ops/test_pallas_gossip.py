@@ -1,0 +1,72 @@
+"""Pallas gossip kernel: correctness against the XLA gossip_round path,
+in interpret mode on the CPU mesh (compiled execution is exercised on the
+real chip by bench_pallas.py / the driver)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lasp_tpu.lattice.base import replicate
+from lasp_tpu.mesh import gossip_round, random_regular
+from lasp_tpu.ops import PackedORSet, PackedORSetSpec
+from lasp_tpu.ops.pallas_gossip import (
+    flatten_plane,
+    pallas_gossip_round,
+    unflatten_plane,
+)
+
+
+def seeded_states(spec, n):
+    states = replicate(PackedORSet.new(spec), n)
+    r = jnp.arange(n)
+    states = jax.vmap(
+        lambda i, s: PackedORSet.add(spec, s, i % spec.n_elems, i % spec.n_actors)
+    )(r, states)
+    # a few removals so the removed plane is non-trivial
+    states = jax.vmap(
+        lambda i, s: jax.lax.cond(
+            i % 5 == 0,
+            lambda x: PackedORSet.remove(spec, x, i % spec.n_elems),
+            lambda x: x,
+            s,
+        )
+    )(r, states)
+    return states
+
+
+@pytest.mark.parametrize("n,k", [(32, 2), (64, 3)])
+def test_pallas_round_matches_xla(n, k):
+    spec = PackedORSetSpec(n_elems=16, n_actors=8, tokens_per_actor=8)  # W=2
+    states = seeded_states(spec, n)
+    nbrs = jnp.asarray(random_regular(n, k, seed=3))
+
+    ref = gossip_round(PackedORSet, spec, states, nbrs)
+
+    fe, d = flatten_plane(states.exists)
+    fr, _ = flatten_plane(states.removed)
+    oe, orr = pallas_gossip_round(fe, fr, nbrs, block=8, interpret=True)
+    got_e = unflatten_plane(oe, states.exists.shape)
+    got_r = unflatten_plane(orr, states.removed.shape)
+
+    np.testing.assert_array_equal(np.asarray(got_e), np.asarray(ref.exists))
+    np.testing.assert_array_equal(np.asarray(got_r), np.asarray(ref.removed))
+
+
+def test_pallas_rounds_converge():
+    n, k = 64, 3
+    spec = PackedORSetSpec(n_elems=16, n_actors=8, tokens_per_actor=8)
+    states = seeded_states(spec, n)
+    nbrs = jnp.asarray(random_regular(n, k, seed=5))
+    fe, d = flatten_plane(states.exists)
+    fr, _ = flatten_plane(states.removed)
+    for _ in range(16):
+        ne, nr = pallas_gossip_round(fe, fr, nbrs, block=8, interpret=True)
+        if bool(jnp.all(ne == fe)) and bool(jnp.all(nr == fr)):
+            break
+        fe, fr = ne, nr
+    # fixed point = every row equals the global join
+    top_e = jnp.broadcast_to(
+        jax.lax.reduce(fe, jnp.uint32(0), jax.lax.bitwise_or, (0,)), fe.shape
+    )
+    np.testing.assert_array_equal(np.asarray(fe), np.asarray(top_e))
