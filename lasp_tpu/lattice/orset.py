@@ -75,13 +75,31 @@ class ORSet(CrdtType):
 
     # -- updates ------------------------------------------------------------
     @staticmethod
+    def add_exhausted(
+        spec: ORSetSpec, state: ORSetState, elem_idx, actor_idx
+    ) -> jax.Array:
+        """Scalar bool: the actor's token pool for the element is full, so an
+        ``add`` here would be dropped. The host op layers (store updates,
+        ``ReplicatedRuntime.update_batch``) check this and raise a loud
+        ``CapacityError`` — the reference never drops adds
+        (``src/lasp_orset.erl:222-230`` always mints), so a silent drop would
+        be a semantic divergence; pure-device batch kernels that cannot raise
+        surface saturation via ``stats()['full_pools']`` instead."""
+        k = spec.tokens_per_actor
+        pool = jax.lax.dynamic_slice(
+            state.exists[elem_idx], (actor_idx * k,), (k,)
+        )
+        return jnp.all(pool)
+
+    @staticmethod
     def add(spec: ORSetSpec, state: ORSetState, elem_idx, actor_idx) -> ORSetState:
         """``update({add, Elem}, Actor)`` — mint the actor's next token for
         the element (``src/lasp_orset.erl:103-105``). Jittable with traced
         indices. The first *free* slot in the actor's pool is used (robust to
         interleaved ``add_by_token`` writes); if the pool is exhausted the
-        add is dropped (the fixed-shape analogue of unbounded token growth;
-        size pools via ``tokens_per_actor``)."""
+        add is a no-op at this level (fixed shapes cannot grow) — callers on
+        the host path gate on :meth:`add_exhausted` and raise
+        ``CapacityError`` so exhaustion is never silent."""
         k = spec.tokens_per_actor
         base = actor_idx * k
         row = state.exists[elem_idx]
@@ -201,14 +219,27 @@ class ORSet(CrdtType):
 
     @staticmethod
     def stats(spec: ORSetSpec, state: ORSetState) -> dict:
-        """element/adds/removes/waste_pct per ``src/lasp_orset.erl:156-192``."""
+        """element/adds/removes/waste_pct per ``src/lasp_orset.erl:156-192``,
+        plus ``full_pools``: the number of (element, actor) token pools with
+        no free slot — the observable form of pool exhaustion for device-side
+        batch updates that cannot raise (VERDICT: dropped adds must never be
+        invisible). Only meaningful for actor-minted layouts (derived
+        combinator outputs use projected token spaces and report 0)."""
         exists = state.exists
         live = int(jnp.sum(exists & ~state.removed))
         dead = int(jnp.sum(exists & state.removed))
         total = live + dead
+        if spec.token_space is None:
+            pools = exists.reshape(
+                exists.shape[:-1] + (spec.n_actors, spec.tokens_per_actor)
+            )
+            full_pools = int(jnp.sum(jnp.all(pools, axis=-1)))
+        else:
+            full_pools = 0
         return {
             "element_count": int(jnp.sum(jnp.any(exists, axis=-1))),
             "adds_count": live,
             "removes_count": dead,
             "waste_pct": 0 if live == 0 else round(dead / total * 100),
+            "full_pools": full_pools,
         }

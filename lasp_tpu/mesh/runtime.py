@@ -68,7 +68,11 @@ class ReplicatedRuntime:
         (``lasp_core:update`` then ``bind``, :283-312) WITHOUT going through
         ``store.update``: store-level watches must not observe (and consume
         their one firing on) a transient single-replica view the store never
-        holds."""
+        holds.
+
+        Edge tables are traced arguments of the compiled step, so interner
+        growth here does NOT trigger a recompile — only an edge-count or
+        table-shape change does (shapes are fixed by the declared specs)."""
         if var_id not in self.states:
             self._sync_graph()
         var = self.store.variable(var_id)
@@ -83,7 +87,165 @@ class ReplicatedRuntime:
             lambda x, r: x.at[replica].set(r), self.states[var_id], new_row
         )
         self.graph.refresh()
-        self._step = None  # tables may have grown
+
+    def update_batch(self, var_id: str, ops) -> None:
+        """Vectorized client writes: ``ops`` is an iterable of ``(replica,
+        op_tuple, actor)``. The reference coordinates every client op through
+        its own FSM (one process per request, SURVEY §2.6); here a whole
+        batch of ops interns its terms host-side once and lands in O(1)
+        device dispatches — the client-op kernel that makes realistic
+        workloads (millions of writes between gossip rounds) feasible.
+
+        Supports the monotone ops of the set/counter types (add / add_all /
+        increment) plus OR-Set remove/remove_all. Adds and increments are
+        always inflations, so the bind gate (``src/lasp_core.erl:301-311``)
+        is vacuous for them; removes check the not_present precondition
+        against the target row exactly like ``store.update`` does."""
+        ops = list(ops)
+        var = self.store.variable(var_id)
+        if var_id not in self.states:
+            self._sync_graph()
+        tn = var.type_name
+        states = self.states[var_id]
+        if not ops:
+            return
+        if tn == "riak_dt_gcounter":
+            rows, lanes, by = [], [], []
+            for r, op, actor in ops:
+                if op[0] != "increment":
+                    raise ValueError(f"update_batch: unsupported op {op!r}")
+                rows.append(r)
+                lanes.append(var.actors.intern(actor))
+                by.append(op[1] if len(op) > 1 else 1)
+            counts = states.counts.at[
+                np.asarray(rows, dtype=np.int32), np.asarray(lanes, dtype=np.int32)
+            ].add(np.asarray(by, dtype=states.counts.dtype))
+            self.states[var_id] = states._replace(counts=counts)
+        elif tn == "lasp_gset":
+            rows, elems = [], []
+            for r, op, _actor in ops:
+                if op[0] == "add":
+                    rows.append(r)
+                    elems.append(var.elems.intern(op[1]))
+                elif op[0] == "add_all":
+                    for e in op[1]:
+                        rows.append(r)
+                        elems.append(var.elems.intern(e))
+                else:
+                    raise ValueError(f"update_batch: unsupported op {op!r}")
+            if rows:
+                mask = states.mask.at[
+                    np.asarray(rows, dtype=np.int32),
+                    np.asarray(elems, dtype=np.int32),
+                ].set(True)
+                self.states[var_id] = states._replace(mask=mask)
+        elif tn in ("lasp_orset", "lasp_orset_gbtree"):
+            self._orset_batch(var, ops)
+        else:
+            raise ValueError(
+                f"update_batch: unsupported type {tn!r} (use update_at)"
+            )
+        self.graph.refresh()
+
+    def _orset_batch(self, var, ops) -> None:
+        """Batched OR-Set adds/removes with SEQUENTIAL semantics: ops are
+        grouped into consecutive add/remove phases and each phase lands as
+        one scatter, so a remove only tombstones tokens that exist at its
+        position in the list (exactly what per-op ``update_at`` would do).
+        Token slots are allocated as the scalar ``ORSet.add`` does (first
+        free slot in the actor's pool, rescanned per add so interleaved
+        ``add_by_token`` holes are respected), by gathering only the
+        affected rows' pools to the host — O(batch), never O(population)."""
+        from ..store.store import PreconditionError
+        from ..utils.interning import CapacityError
+
+        spec = var.spec
+        k = spec.tokens_per_actor
+        # split into maximal same-verb phases, preserving op order
+        phases: list[tuple[str, list]] = []
+        for r, op, actor in ops:
+            verb = op[0]
+            if verb in ("add", "add_all"):
+                kind = "add"
+                a = var.actors.intern(actor)
+                terms = op[1] if verb == "add_all" else [op[1]]
+                items = [(r, var.elems.intern(e), a * k, e) for e in terms]
+            elif verb in ("remove", "remove_all"):
+                kind = "remove"
+                terms = op[1] if verb == "remove_all" else [op[1]]
+                for e in terms:
+                    if e not in var.elems:
+                        raise PreconditionError(f"not_present: {e!r}")
+                items = [(r, var.elems.index_of(e), e) for e in terms]
+            else:
+                raise ValueError(f"update_batch: unsupported op {op!r}")
+            if phases and phases[-1][0] == kind:
+                phases[-1][1].extend(items)
+            else:
+                phases.append((kind, items))
+
+        states = self.states[var.id]
+        exists, removed = states.exists, states.removed
+        for kind, items in phases:
+            rows = np.asarray([it[0] for it in items], dtype=np.int32)
+            elems = np.asarray([it[1] for it in items], dtype=np.int32)
+            if kind == "add":
+                bases = np.asarray([it[2] for it in items], dtype=np.int32)
+                # gather each add's k-slot pool: [B, k] bools on host
+                pool_idx = bases[:, None] + np.arange(k)[None, :]
+                gathered = np.asarray(
+                    exists[rows[:, None], elems[:, None], pool_idx]
+                )
+                # per-(row, elem, pool) occupancy evolves within the phase:
+                # rescan for the first free slot per add (holes from
+                # interleaved add_by_token stay respected)
+                pool_state: dict[tuple[int, int, int], np.ndarray] = {}
+                tok_rows, tok_elems, tok_slots = [], [], []
+                for i, (r, e, base, term) in enumerate(items):
+                    key = (int(r), int(e), int(base))
+                    pool = pool_state.setdefault(key, gathered[i].copy())
+                    free = np.flatnonzero(~pool)
+                    if len(free) == 0:
+                        # the reference never drops adds (src/lasp_orset.
+                        # erl:222-230); a full pool must be loud, like
+                        # interner overflow
+                        raise CapacityError(
+                            f"{var.id}: token pool exhausted for {term!r} "
+                            f"at replica {key[0]} (tokens_per_actor={k}); "
+                            "raise tokens_per_actor"
+                        )
+                    slot = int(free[0])
+                    pool[slot] = True
+                    tok_rows.append(int(r))
+                    tok_elems.append(int(e))
+                    tok_slots.append(int(base) + slot)
+                idx = (
+                    np.asarray(tok_rows, dtype=np.int32),
+                    np.asarray(tok_elems, dtype=np.int32),
+                    np.asarray(tok_slots, dtype=np.int32),
+                )
+                exists = exists.at[idx].set(True)
+                removed = removed.at[idx].set(False)
+            else:
+                # duplicate (row, elem) within one phase: sequentially the
+                # second remove would see the element already tombstoned
+                seen: set[tuple[int, int]] = set()
+                for r, e, term in items:
+                    if (int(r), int(e)) in seen:
+                        raise PreconditionError(f"not_present: {term!r}")
+                    seen.add((int(r), int(e)))
+                # precondition: live at that row HERE, i.e. after earlier
+                # phases only (src/lasp_orset.erl:222-241)
+                live = np.asarray(
+                    jnp.any(exists[rows, elems] & ~removed[rows, elems], axis=-1)
+                )
+                if not live.all():
+                    bad = items[int(np.flatnonzero(~live)[0])][2]
+                    raise PreconditionError(f"not_present: {bad!r}")
+                removed = removed.at[rows, elems].set(
+                    removed[rows, elems] | exists[rows, elems]
+                )
+        self.states[var.id] = states._replace(exists=exists, removed=removed)
 
     def apply_batch(self, var_id: str, fn) -> None:
         """Device-side batched update: ``fn(states[R, ...]) -> states`` —
@@ -93,14 +255,20 @@ class ReplicatedRuntime:
 
     # -- the step ------------------------------------------------------------
     def _build_step(self):
+        """Compile the bulk-synchronous round. Edge tables are TRACED
+        arguments, not closure constants: client writes grow interner-backed
+        tables every op, and baking them in would force a full XLA recompile
+        per write (table shapes are fixed by the declared specs, so passing
+        them as args never retraces)."""
         graph = self.graph
         edges = bool(graph.edges)
-        tables = tuple(e.device_tables() for e in graph.edges)
         meta = {v: (self.store.variable(v).codec, self.store.variable(v).spec)
                 for v in self.var_ids}
         flow_ids = graph._var_ids
 
-        def step(states, neighbors, edge_mask):
+        # tables is REQUIRED (no default): an old-signature 3-arg call must
+        # fail loudly rather than zip-truncate every edge away silently
+        def step(states, neighbors, edge_mask, tables):
             prev = states
             if edges:
                 flow_states = {v: states[v] for v in flow_ids}
@@ -143,8 +311,11 @@ class ReplicatedRuntime:
             self._sync_graph()
         if self._step is None:
             self._step = self._build_step()
+        tables = tuple(e.device_tables() for e in self.graph.edges)
         with Timer() as t:
-            self.states, residual = self._step(self.states, self.neighbors, edge_mask)
+            self.states, residual = self._step(
+                self.states, self.neighbors, edge_mask, tables
+            )
             residual = int(residual)  # device sync closes the timing window
         self.trace.record_round(residual, t.elapsed)
         return residual

@@ -84,9 +84,19 @@ class PackedORSet:
         )
 
     @staticmethod
+    def add_exhausted(spec, state, elem_idx, actor_idx) -> jax.Array:
+        """Scalar bool: the actor's pool for the element is full (dense
+        ``ORSet.add_exhausted`` contract — host op layers raise on this)."""
+        k = spec.tokens_per_actor
+        offs = actor_idx * k + jnp.arange(k)
+        w, bit = _word_bit(offs)
+        return jnp.all((state.exists[elem_idx, w] & bit) != 0)
+
+    @staticmethod
     def add(spec, state, elem_idx, actor_idx) -> PackedORSetState:
         """Mint the actor's first free slot (dense ``ORSet.add`` contract:
-        pool-exhausted adds drop)."""
+        pool-exhausted adds are a no-op here; host paths gate on
+        ``add_exhausted`` and raise)."""
         k = spec.tokens_per_actor
         base = actor_idx * k
         # extract the actor's k-bit pool spread over words

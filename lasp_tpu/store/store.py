@@ -307,11 +307,15 @@ class Store:
             # is vestigial (n_actors=1, token_space-overridden)
             if verb == "add":
                 a = var.actors.intern(actor)
-                return codec.add(spec, state, var.elems.intern(op[1]), a)
+                e = var.elems.intern(op[1])
+                self._check_pool(var, state, e, a, op[1])
+                return codec.add(spec, state, e, a)
             if verb == "add_all":
                 a = var.actors.intern(actor)
-                for e in op[1]:
-                    state = codec.add(spec, state, var.elems.intern(e), a)
+                for term in op[1]:
+                    e = var.elems.intern(term)
+                    self._check_pool(var, state, e, a, term)
+                    state = codec.add(spec, state, e, a)
                 return state
             if verb == "add_by_token":
                 return codec.add_by_token(
@@ -370,6 +374,20 @@ class Store:
             if verb == "set":
                 return codec.set(spec, state, var.ivar_payloads.intern(op[1]))
         raise ValueError(f"unsupported op {op!r} for type {var.type_name}")
+
+    @staticmethod
+    def _check_pool(var: Variable, state, elem_idx: int, actor_idx: int, term):
+        """Loud token-pool exhaustion: the reference never drops adds
+        (``src/lasp_orset.erl:222-230`` always mints a fresh token), so an
+        exhausted fixed-shape pool raises like interner overflow does."""
+        if bool(var.codec.add_exhausted(var.spec, state, elem_idx, actor_idx)):
+            from ..utils.interning import CapacityError
+
+            raise CapacityError(
+                f"{var.id}: token pool exhausted for element {term!r} "
+                f"(tokens_per_actor={var.spec.tokens_per_actor}); "
+                "raise tokens_per_actor"
+            )
 
     def _apply_map_field(self, var: Variable, state, sub: tuple, actor):
         """One ``{update, Key, Op}`` / ``{remove, Key}`` against a map field

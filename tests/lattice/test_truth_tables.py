@@ -174,6 +174,7 @@ class TestORSet:
             "adds_count": 1,
             "removes_count": 0,
             "waste_pct": 0,
+            "full_pools": 0,
         }
         s3 = ORSet.stats(self.spec, a3)
         assert s3["element_count"] == 1

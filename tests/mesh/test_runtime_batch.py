@@ -1,0 +1,165 @@
+"""Batched client ops + recompile behavior of ReplicatedRuntime.
+
+VERDICT/ADVICE round-1 items: client writes must not re-jit the step
+(edge tables are traced args now), and realistic workloads need a
+vectorized update path instead of per-op host round-trips.
+"""
+
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import Store
+from lasp_tpu.utils.interning import CapacityError
+
+
+def _runtime(n=4, **declare):
+    store = Store(n_actors=8)
+    graph = Graph(store)
+    store.declare(id="s", **declare)
+    return store, graph, ReplicatedRuntime(store, graph, n, ring(n, 1))
+
+
+def test_update_at_does_not_recompile_step():
+    store = Store(n_actors=8)
+    graph = Graph(store)
+    a = store.declare(id="a", type="lasp_orset", n_elems=8)
+    b = store.declare(id="b", type="lasp_orset", n_elems=8)
+    graph.union(a, b, dst="u")
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 1))
+    rt.update_at(0, a, ("add", "x"), "w0")
+    rt.step()
+    assert rt._step is not None
+    compiled = rt._step
+    sizes = compiled._cache_size()
+    # interner growth via more client writes must NOT invalidate or retrace
+    for i in range(5):
+        rt.update_at(i % 4, a, ("add", f"y{i}"), "w0")
+        rt.update_at(i % 4, b, ("add", f"z{i}"), "w1")
+        rt.step()
+    assert rt._step is compiled
+    assert compiled._cache_size() == sizes == 1
+    rt.run_to_convergence()
+    assert rt.coverage_value("u") == {"x", "z0", "z1", "z2", "z3", "z4"} | {
+        f"y{i}" for i in range(5)
+    }
+
+
+@pytest.mark.parametrize("verb", ["add", "add_all"])
+def test_update_batch_orset_matches_sequential(verb):
+    _, _, rt1 = _runtime(type="lasp_orset", n_elems=8)
+    _, _, rt2 = _runtime(type="lasp_orset", n_elems=8)
+    ops = []
+    for i in range(6):
+        if verb == "add":
+            ops.append((i % 4, ("add", f"e{i % 3}"), f"w{i % 2}"))
+        else:
+            ops.append((i % 4, ("add_all", [f"e{i % 3}", f"e{(i + 1) % 3}"]), f"w{i % 2}"))
+    for r, op, actor in ops:
+        rt1.update_at(r, "s", op, actor)
+    rt2.update_batch("s", ops)
+    rt1.run_to_convergence()
+    rt2.run_to_convergence()
+    assert rt1.coverage_value("s") == rt2.coverage_value("s")
+    assert rt1.divergence("s") == rt2.divergence("s") == 0
+
+
+def test_update_batch_orset_remove_and_precondition():
+    _, _, rt = _runtime(type="lasp_orset", n_elems=8)
+    rt.update_batch("s", [(0, ("add_all", ["a", "b"]), "w")])
+    rt.run_to_convergence()
+    rt.update_batch("s", [(2, ("remove", "a"), "w")])
+    rt.run_to_convergence()
+    assert rt.coverage_value("s") == {"b"}
+    from lasp_tpu.store.store import PreconditionError
+
+    with pytest.raises(PreconditionError):
+        rt.update_batch("s", [(1, ("remove", "nope"), "w")])
+    with pytest.raises(PreconditionError):
+        # "a" is tombstoned everywhere after convergence
+        rt.update_batch("s", [(0, ("remove", "a"), "w")])
+
+
+def test_update_batch_gcounter_and_gset():
+    _, _, rt = _runtime(type="riak_dt_gcounter")
+    # an actor's writes land at one replica (per-actor lanes merge by max:
+    # same-lane writes at two replicas would be concurrent and collapse)
+    rt.update_batch(
+        "s",
+        [(0, ("increment",), "c1"), (1, ("increment", 4), "c2"), (0, ("increment",), "c1")],
+    )
+    rt.run_to_convergence()
+    assert rt.coverage_value("s") == 6
+
+    _, _, rt = _runtime(type="lasp_gset", n_elems=8)
+    rt.update_batch(
+        "s", [(0, ("add", "x"), None), (3, ("add_all", ["y", "z"]), None)]
+    )
+    rt.run_to_convergence()
+    assert rt.coverage_value("s") == {"x", "y", "z"}
+
+
+def test_update_batch_remove_then_add_keeps_element():
+    # sequential semantics: a remove BEFORE an add in the same batch must
+    # not tombstone the add's freshly minted token
+    _, _, rt = _runtime(type="lasp_orset", n_elems=8)
+    rt.update_batch("s", [(0, ("add", "e"), "w")])
+    rt.update_batch("s", [(0, ("remove", "e"), "w"), (0, ("add", "e"), "w")])
+    rt.run_to_convergence()
+    assert rt.coverage_value("s") == {"e"}
+    # and a duplicate remove inside one batch is a precondition error,
+    # exactly as two sequential update_at calls would be
+    from lasp_tpu.store.store import PreconditionError
+
+    with pytest.raises(PreconditionError):
+        rt.update_batch(
+            "s", [(0, ("remove", "e"), "w"), (0, ("remove", "e"), "w")]
+        )
+
+
+def test_update_batch_respects_pool_holes():
+    # a hole left by add_by_token must be skipped per-add, not assumed
+    # contiguous: slot 1 pre-taken, two batch adds must land on 0 and 2
+    import numpy as np
+
+    _, _, rt = _runtime(type="lasp_orset", n_elems=4, tokens_per_actor=3)
+    var = rt.store.variable("s")
+    e = var.elems.intern("e")
+    a = var.actors.intern("w")  # base = a * 3
+    states = rt.states["s"]
+    rt.states["s"] = states._replace(
+        exists=states.exists.at[0, e, a * 3 + 1].set(True)
+    )
+    rt.update_batch("s", [(0, ("add", "e"), "w"), (0, ("add", "e"), "w")])
+    pool = np.asarray(rt.states["s"].exists[0, e, a * 3 : a * 3 + 3])
+    assert pool.tolist() == [True, True, True]
+    removed = np.asarray(rt.states["s"].removed[0, e, a * 3 : a * 3 + 3])
+    assert not removed.any()
+
+
+def test_update_batch_empty_is_noop():
+    _, _, rt = _runtime(type="riak_dt_gcounter")
+    rt.update_batch("s", [])
+    _, _, rt = _runtime(type="lasp_gset", n_elems=4)
+    rt.update_batch("s", [(0, ("add_all", []), None)])
+    assert rt.coverage_value("s") == set()
+
+
+def test_token_pool_exhaustion_is_loud():
+    # store path: k+1 sequential adds of the same elem by one actor raise
+    store = Store(n_actors=4)
+    v = store.declare(id="v", type="lasp_orset", n_elems=4, tokens_per_actor=2)
+    store.update(v, ("add", "e"), "w")
+    store.update(v, ("add", "e"), "w")  # idempotent pool fill is fine
+    with pytest.raises(CapacityError):
+        store.update(v, ("add", "e"), "w")
+    # batch path raises too
+    _, _, rt = _runtime(type="lasp_orset", n_elems=4, tokens_per_actor=1)
+    with pytest.raises(CapacityError):
+        rt.update_batch("s", [(0, ("add", "e"), "w"), (0, ("add", "e"), "w")])
+    # device-side saturation is observable via stats
+    from lasp_tpu.lattice import ORSet
+
+    var = store.variable(v)
+    stats = ORSet.stats(var.spec, var.state)
+    assert stats["full_pools"] == 1
