@@ -24,20 +24,43 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..lattice.base import replicate
+from ..ops.flatpack import FlatORSet, FlatORSetSpec
 from ..utils.metrics import StepTrace, Timer
 from .gossip import divergence, gossip_round, join_all
+
+#: store types held flat-bit-packed on the mesh when ``packed=True``
+_PACKABLE = ("lasp_orset", "lasp_orset_gbtree")
 
 
 class ReplicatedRuntime:
     """Simulates ``n_replicas`` copies of a store + dataflow graph under a
-    gossip topology, bulk-synchronously."""
+    gossip topology, bulk-synchronously.
 
-    def __init__(self, store, graph, n_replicas: int, neighbors: np.ndarray):
+    With ``packed=True`` every OR-Set-family variable's replica states are
+    held in the flat bit-packed wire format (``lasp_tpu.ops.flatpack`` — 1
+    bit per (elem, token)), which is what gossip gathers move through HBM
+    and over ICI; the jitted step unpacks around the dataflow sweep and
+    repacks its outputs, so the Store/Graph semantics are byte-identical to
+    the dense mode (tests assert the same fixed points). This is the mode
+    the population-scale BASELINE configs run in.
+    """
+
+    def __init__(
+        self,
+        store,
+        graph,
+        n_replicas: int,
+        neighbors: np.ndarray,
+        packed: bool = False,
+    ):
         self.store = store
         self.graph = graph
         self.n_replicas = n_replicas
         self.neighbors = jnp.asarray(neighbors)
+        self.packed = packed
         self.states: dict = {}
+        self._packed_specs: dict[str, FlatORSetSpec] = {}
+        self._triggers: list = []
         self._step = None
         self._n_edges = -1
         self.trace = StepTrace()
@@ -52,10 +75,53 @@ class ReplicatedRuntime:
         if graph.edges:
             graph._build()
         for v in self.store.ids():
-            if v not in self.states:
+            var = self.store.variable(v)
+            if self.packed and var.type_name in _PACKABLE:
+                if v not in self._packed_specs:
+                    self._packed_specs[v] = FlatORSetSpec(dense=var.spec)
+                if v not in self.states:
+                    self.states[v] = replicate(
+                        FlatORSet.pack(self._packed_specs[v], var.state),
+                        self.n_replicas,
+                    )
+            elif v not in self.states:
                 self.states[v] = replicate(self.store.state(v), self.n_replicas)
         self.var_ids = tuple(self.states)
         self._n_edges = len(graph.edges)
+        self._step = None
+
+    # -- mesh-side codec selection -------------------------------------------
+    def _mesh_meta(self, var_id: str):
+        """(codec, spec) as the MESH sees the variable: flat-packed for
+        OR-Set families in packed mode, the store codec otherwise."""
+        if var_id in self._packed_specs:
+            return FlatORSet, self._packed_specs[var_id]
+        var = self.store.variable(var_id)
+        return var.codec, var.spec
+
+    def _to_dense_row(self, var_id: str, row):
+        if var_id in self._packed_specs:
+            return FlatORSet.unpack(self._packed_specs[var_id], row)
+        return row
+
+    def _from_dense_row(self, var_id: str, row):
+        if var_id in self._packed_specs:
+            return FlatORSet.pack(self._packed_specs[var_id], row)
+        return row
+
+    # -- reactive triggers ----------------------------------------------------
+    def register_trigger(self, fn) -> None:
+        """Register a per-replica reactive rule run inside every step:
+        ``fn(dense_states: dict) -> dict[var_id, candidate_state]``.
+
+        This is the TPU dissolution of the reference's *server process*
+        pattern — a loop doing a blocking threshold read then issuing an
+        update (``riak_test/lasp_advertisement_counter_test.erl:197-235``:
+        read counter >= threshold, then remove the ad). Here the blocking
+        read becomes a per-round predicate evaluated at every replica, and
+        the update lands through the same merge + inflation gate as a bind
+        (``src/lasp_core.erl:301-311``), vmapped over the population."""
+        self._triggers.append(fn)
         self._step = None
 
     # -- client operations ---------------------------------------------------
@@ -76,13 +142,14 @@ class ReplicatedRuntime:
         if var_id not in self.states:
             self._sync_graph()
         var = self.store.variable(var_id)
-        row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
+        wire_row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
+        row = self._to_dense_row(var_id, wire_row)
         candidate = self.store._apply_op(var, row, op, actor)
         merged = var.codec.merge(var.spec, row, candidate)
         if bool(var.codec.is_inflation(var.spec, row, merged)):
-            new_row = merged
+            new_row = self._from_dense_row(var_id, merged)
         else:
-            new_row = row  # non-inflation silently ignored (bind rule)
+            new_row = wire_row  # non-inflation silently ignored (bind rule)
         self.states[var_id] = jax.tree_util.tree_map(
             lambda x, r: x.at[replica].set(r), self.states[var_id], new_row
         )
@@ -155,9 +222,12 @@ class ReplicatedRuntime:
         Token slots are allocated as the scalar ``ORSet.add`` does (first
         free slot in the actor's pool, rescanned per add so interleaved
         ``add_by_token`` holes are respected), by gathering only the
-        affected rows' pools to the host — O(batch), never O(population)."""
+        affected rows' pools to the host — O(batch), never O(population).
+
+        On a mid-batch failure (exhausted pool / not_present), every op
+        BEFORE the failing one persists and the error then raises —
+        exactly the state a per-op loop would leave."""
         from ..store.store import PreconditionError
-        from ..utils.interning import CapacityError
 
         spec = var.spec
         k = spec.tokens_per_actor
@@ -184,6 +254,15 @@ class ReplicatedRuntime:
             else:
                 phases.append((kind, items))
 
+        if var.id in self._packed_specs:
+            self._orset_batch_packed(var, phases)
+            return
+
+        def flush(exists, removed):
+            self.states[var.id] = self.states[var.id]._replace(
+                exists=exists, removed=removed
+            )
+
         states = self.states[var.id]
         exists, removed = states.exists, states.removed
         for kind, items in phases:
@@ -196,56 +275,169 @@ class ReplicatedRuntime:
                 gathered = np.asarray(
                     exists[rows[:, None], elems[:, None], pool_idx]
                 )
-                # per-(row, elem, pool) occupancy evolves within the phase:
-                # rescan for the first free slot per add (holes from
-                # interleaved add_by_token stay respected)
-                pool_state: dict[tuple[int, int, int], np.ndarray] = {}
-                tok_rows, tok_elems, tok_slots = [], [], []
-                for i, (r, e, base, term) in enumerate(items):
-                    key = (int(r), int(e), int(base))
-                    pool = pool_state.setdefault(key, gathered[i].copy())
-                    free = np.flatnonzero(~pool)
-                    if len(free) == 0:
-                        # the reference never drops adds (src/lasp_orset.
-                        # erl:222-230); a full pool must be loud, like
-                        # interner overflow
-                        raise CapacityError(
-                            f"{var.id}: token pool exhausted for {term!r} "
-                            f"at replica {key[0]} (tokens_per_actor={k}); "
-                            "raise tokens_per_actor"
-                        )
-                    slot = int(free[0])
-                    pool[slot] = True
-                    tok_rows.append(int(r))
-                    tok_elems.append(int(e))
-                    tok_slots.append(int(base) + slot)
-                idx = (
-                    np.asarray(tok_rows, dtype=np.int32),
-                    np.asarray(tok_elems, dtype=np.int32),
-                    np.asarray(tok_slots, dtype=np.int32),
-                )
-                exists = exists.at[idx].set(True)
-                removed = removed.at[idx].set(False)
+                allocs, err = self._alloc_pool_slots(var.id, items, gathered, k)
+                if allocs:
+                    idx = (
+                        np.asarray([items[i][0] for i, _ in allocs], dtype=np.int32),
+                        np.asarray([items[i][1] for i, _ in allocs], dtype=np.int32),
+                        np.asarray(
+                            [items[i][2] + s for i, s in allocs], dtype=np.int32
+                        ),
+                    )
+                    exists = exists.at[idx].set(True)
+                    removed = removed.at[idx].set(False)
+                if err is not None:
+                    flush(exists, removed)  # sequential: earlier ops persist
+                    raise err
             else:
-                # duplicate (row, elem) within one phase: sequentially the
-                # second remove would see the element already tombstoned
-                seen: set[tuple[int, int]] = set()
-                for r, e, term in items:
-                    if (int(r), int(e)) in seen:
-                        raise PreconditionError(f"not_present: {term!r}")
-                    seen.add((int(r), int(e)))
-                # precondition: live at that row HERE, i.e. after earlier
-                # phases only (src/lasp_orset.erl:222-241)
                 live = np.asarray(
                     jnp.any(exists[rows, elems] & ~removed[rows, elems], axis=-1)
                 )
-                if not live.all():
-                    bad = items[int(np.flatnonzero(~live)[0])][2]
-                    raise PreconditionError(f"not_present: {bad!r}")
-                removed = removed.at[rows, elems].set(
-                    removed[rows, elems] | exists[rows, elems]
+                n_ok, err = self._check_removes(items, live)
+                if n_ok:
+                    ok_r = rows[:n_ok]
+                    ok_e = elems[:n_ok]
+                    removed = removed.at[ok_r, ok_e].set(
+                        removed[ok_r, ok_e] | exists[ok_r, ok_e]
+                    )
+                if err is not None:
+                    flush(exists, removed)
+                    raise err
+        flush(exists, removed)
+
+    @staticmethod
+    def _alloc_pool_slots(var_id: str, items, pools: np.ndarray, k: int):
+        """First-free-slot allocation over gathered ``[B, k]`` pool
+        occupancy — the ONE implementation of the scalar ``ORSet.add``
+        contract shared by the dense and packed batch paths (per-add rescan,
+        so holes from interleaved ``add_by_token`` are respected; within a
+        batch, a (row, elem, actor) key's occupancy evolves).
+
+        Returns ``(allocs, err)``: ``allocs = [(item_index, slot), ...]``
+        for every add allocated BEFORE the first exhausted pool, and
+        ``err`` a ``CapacityError`` (or None). Callers persist the partial
+        allocation before raising — sequential per-op semantics, and the
+        reference never drops adds (``src/lasp_orset.erl:222-230``), so
+        exhaustion is loud, like interner overflow."""
+        from ..utils.interning import CapacityError
+
+        pool_state: dict[tuple, np.ndarray] = {}
+        allocs: list[tuple[int, int]] = []
+        for i, (r, e, base, term) in enumerate(items):
+            key = (int(r), int(e), int(base))
+            pool = pool_state.setdefault(key, pools[i].copy())
+            free = np.flatnonzero(~pool)
+            if len(free) == 0:
+                return allocs, CapacityError(
+                    f"{var_id}: token pool exhausted for {term!r} at replica "
+                    f"{key[0]} (tokens_per_actor={k}); raise tokens_per_actor"
                 )
-        self.states[var.id] = states._replace(exists=exists, removed=removed)
+            slot = int(free[0])
+            pool[slot] = True
+            allocs.append((i, slot))
+        return allocs, None
+
+    @staticmethod
+    def _check_removes(items, live: np.ndarray):
+        """Sequential remove validation: returns ``(n_ok, err)`` where
+        ``items[:n_ok]`` may be applied and ``err`` is the
+        ``PreconditionError`` the (n_ok+1)-th op would raise (or None).
+        A duplicate (row, elem) in one phase fails at its position — the
+        earlier remove already tombstoned it — matching per-op ``update_at``
+        (not_present rule, ``src/lasp_orset.erl:222-241``)."""
+        from ..store.store import PreconditionError
+
+        seen: set[tuple[int, int]] = set()
+        for i, (r, e, term) in enumerate(items):
+            key = (int(r), int(e))
+            if key in seen or not live[i]:
+                return i, PreconditionError(f"not_present: {term!r}")
+            seen.add(key)
+        return len(items), None
+
+    def _elem_word_masks(self, var_id: str) -> np.ndarray:
+        """uint32[E, W]: per-element word masks of the flat bit layout
+        (bit = e * T + t), cached per variable."""
+        cache = getattr(self, "_elem_masks", None)
+        if cache is None:
+            cache = self._elem_masks = {}
+        if var_id not in cache:
+            pspec = self._packed_specs[var_id]
+            d = pspec.dense
+            masks = np.zeros((d.n_elems, pspec.n_words), dtype=np.uint32)
+            for b in range(pspec.n_bits):
+                masks[b // d.n_tokens, b // 32] |= np.uint32(1) << (b % 32)
+            cache[var_id] = masks
+        return cache[var_id]
+
+    def _orset_batch_packed(self, var, phases) -> None:
+        """Packed-mode twin of the dense phase application: identical
+        sequential semantics (same ``_alloc_pool_slots`` / ``_check_removes``
+        helpers, same persist-then-raise on failure), but gathers/scatters
+        land on the flat bit-packed words (still O(batch) host work)."""
+        pspec = self._packed_specs[var.id]
+        d = pspec.dense
+        k = d.tokens_per_actor
+        elem_masks = self._elem_word_masks(var.id)
+
+        def flush(exists, removed):
+            self.states[var.id] = self.states[var.id]._replace(
+                exists=exists, removed=removed
+            )
+
+        states = self.states[var.id]
+        exists, removed = states.exists, states.removed
+        for kind, items in phases:
+            rows = np.asarray([it[0] for it in items], dtype=np.int32)
+            if kind == "add":
+                elems = np.asarray([it[1] for it in items], dtype=np.int64)
+                bases = np.asarray([it[2] for it in items], dtype=np.int64)
+                # bit positions of each add's k-slot pool: [B, k]
+                bits = elems[:, None] * d.n_tokens + bases[:, None] + np.arange(k)
+                words, shifts = bits // 32, bits % 32
+                gathered = np.asarray(exists[rows[:, None], words])
+                pools = ((gathered >> shifts.astype(np.uint32)) & 1).astype(bool)
+                allocs, err = self._alloc_pool_slots(var.id, items, pools, k)
+                # (row, word) -> mask of freshly minted bits, duplicates
+                # pre-combined so the scatter below is race-free
+                set_masks: dict[tuple[int, int], int] = {}
+                for i, slot in allocs:
+                    b = int(bits[i, slot])
+                    wkey = (int(items[i][0]), b // 32)
+                    set_masks[wkey] = set_masks.get(wkey, 0) | (1 << (b % 32))
+                if set_masks:
+                    rws = np.asarray([w[0] for w in set_masks], dtype=np.int32)
+                    wds = np.asarray([w[1] for w in set_masks], dtype=np.int32)
+                    msk = np.asarray(list(set_masks.values()), dtype=np.uint32)
+                    exists = exists.at[rws, wds].set(exists[rws, wds] | msk)
+                    removed = removed.at[rws, wds].set(removed[rws, wds] & ~msk)
+                if err is not None:
+                    flush(exists, removed)  # sequential: earlier ops persist
+                    raise err
+            else:
+                elems = np.asarray([it[1] for it in items], dtype=np.int32)
+                ex_rows = np.asarray(exists[rows])  # [B, W]
+                rm_rows = np.asarray(removed[rows])
+                live = ((ex_rows & ~rm_rows) & elem_masks[elems]).any(axis=-1)
+                n_ok, err = self._check_removes(items, live)
+                if n_ok:
+                    # combine per-row tombstone masks (duplicate rows fine
+                    # across DIFFERENT elements)
+                    per_row: dict[int, np.ndarray] = {}
+                    for r, e, _term in items[:n_ok]:
+                        m = per_row.setdefault(
+                            int(r), np.zeros(pspec.n_words, np.uint32)
+                        )
+                        m |= elem_masks[int(e)]
+                    urows = np.asarray(list(per_row), dtype=np.int32)
+                    umasks = np.stack([per_row[int(r)] for r in urows])
+                    removed = removed.at[urows].set(
+                        removed[urows] | (exists[urows] & umasks)
+                    )
+                if err is not None:
+                    flush(exists, removed)
+                    raise err
+        flush(exists, removed)
 
     def apply_batch(self, var_id: str, fn) -> None:
         """Device-side batched update: ``fn(states[R, ...]) -> states`` —
@@ -259,26 +451,57 @@ class ReplicatedRuntime:
         arguments, not closure constants: client writes grow interner-backed
         tables every op, and baking them in would force a full XLA recompile
         per write (table shapes are fixed by the declared specs, so passing
-        them as args never retraces)."""
+        them as args never retraces).
+
+        In packed mode the dataflow sweep + triggers run on per-replica
+        DENSE views (unpack -> compute -> repack inside the same jit, where
+        XLA fuses the bit arithmetic into the kernels); gossip and the
+        residual run natively on the packed words — HBM and ICI only ever
+        see 1 bit per token."""
         graph = self.graph
         edges = bool(graph.edges)
-        meta = {v: (self.store.variable(v).codec, self.store.variable(v).spec)
-                for v in self.var_ids}
+        meta = {v: self._mesh_meta(v) for v in self.var_ids}
+        dense_meta = {
+            v: (self.store.variable(v).codec, self.store.variable(v).spec)
+            for v in self.var_ids
+        }
+        packed_specs = dict(self._packed_specs)
         flow_ids = graph._var_ids
+        triggers = tuple(self._triggers)
+
+        def to_dense(v, x):
+            return FlatORSet.unpack(packed_specs[v], x) if v in packed_specs else x
+
+        def to_wire(v, x):
+            return FlatORSet.pack(packed_specs[v], x) if v in packed_specs else x
 
         # tables is REQUIRED (no default): an old-signature 3-arg call must
         # fail loudly rather than zip-truncate every edge away silently
         def step(states, neighbors, edge_mask, tables):
             prev = states
-            if edges:
-                flow_states = {v: states[v] for v in flow_ids}
+            if edges or triggers:
 
-                def local_round(s):
-                    new, _ = graph._round_fn_pure(s, tables)
-                    return new
+                def local_round(s_all):
+                    dense = {v: to_dense(v, x) for v, x in s_all.items()}
+                    if edges:
+                        flow = {v: dense[v] for v in flow_ids}
+                        new, _ = graph._round_fn_pure(flow, tables)
+                        dense.update(new)
+                    for trig in triggers:
+                        for v, cand in trig(dense).items():
+                            codec, spec = dense_meta[v]
+                            merged = codec.merge(spec, dense[v], cand)
+                            ok = codec.is_inflation(spec, dense[v], merged)
+                            # bind rule: non-inflations silently ignored
+                            dense[v] = jax.tree_util.tree_map(
+                                lambda m, c: jnp.where(ok, m, c),
+                                merged,
+                                dense[v],
+                            )
+                    return {v: to_wire(v, x) for v, x in dense.items()}
 
-                swept = jax.vmap(local_round)(flow_states)
-                states = dict(states, **swept)
+                swept = jax.vmap(local_round)(dict(states))
+                states = swept
             out = {}
             residual = jnp.zeros((), dtype=jnp.int32)
             for v in self.var_ids:
@@ -328,30 +551,79 @@ class ReplicatedRuntime:
                 return i + 1
         raise RuntimeError(f"no convergence within {max_rounds} rounds")
 
+    # -- vectorized population seeding ---------------------------------------
+    def intern_terms(self, var_id: str, terms) -> np.ndarray:
+        """Intern a list of terms into the variable's element universe and
+        return their dense indices — the host half of a population-scale
+        seed (run once; the indices then drive device-side scatters)."""
+        var = self.store.variable(var_id)
+        out = np.asarray([var.elems.intern(t) for t in terms], dtype=np.int32)
+        self.graph.refresh()
+        return out
+
+    def intern_actors(self, var_id: str, actors) -> np.ndarray:
+        var = self.store.variable(var_id)
+        return np.asarray([var.actors.intern(a) for a in actors], dtype=np.int32)
+
+    def seed_tokens(self, var_id: str, rows, elems, tokens) -> None:
+        """Device-side bulk add: set token ``tokens[i]`` of element
+        ``elems[i]`` live at replica ``rows[i]`` — millions of client
+        ``add_by_token`` writes in one scatter (the batched client-op path
+        the population-scale configs drive; reference op
+        ``src/lasp_orset.erl:101-102``). Triples must be unique."""
+        rows = jnp.asarray(rows)
+        elems = jnp.asarray(elems)
+        tokens = jnp.asarray(tokens)
+        states = self.states[var_id]
+        if var_id in self._packed_specs:
+            self.states[var_id] = FlatORSet.scatter_tokens(
+                self._packed_specs[var_id], states, rows, elems, tokens
+            )
+        else:
+            self.states[var_id] = states._replace(
+                exists=states.exists.at[rows, elems, tokens].set(True),
+                removed=states.removed.at[rows, elems, tokens].set(False),
+            )
+
+    def seed_increments(self, var_id: str, rows, lanes, by=1) -> None:
+        """Device-side bulk G-Counter increments at ``(rows[i], lanes[i])``
+        — the population-scale client-view writes of the ad-counter configs
+        (``riak_test/lasp_adcounter_test.erl:57-120`` client loop)."""
+        states = self.states[var_id]
+        by = jnp.broadcast_to(jnp.asarray(by, dtype=states.counts.dtype),
+                              jnp.asarray(rows).shape)
+        self.states[var_id] = states._replace(
+            counts=states.counts.at[jnp.asarray(rows), jnp.asarray(lanes)].add(by)
+        )
+
     # -- reads ----------------------------------------------------------------
     def coverage_value(self, var_id: str):
         """Global join + decode — the coverage query
         (``src/lasp_execute_coverage_fsm.erl:78-94``)."""
         var = self.store.variable(var_id)
-        top = join_all(var.codec, var.spec, self.states[var_id])
-        return self.store._decode_value(var, top)
+        codec, spec = self._mesh_meta(var_id)
+        top = join_all(codec, spec, self.states[var_id])
+        return self.store._decode_value(var, self._to_dense_row(var_id, top))
 
     def replica_value(self, var_id: str, replica: int):
         var = self.store.variable(var_id)
         row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
-        return self.store._decode_value(var, row)
+        return self.store._decode_value(var, self._to_dense_row(var_id, row))
 
     def divergence(self, var_id: str) -> int:
-        var = self.store.variable(var_id)
-        return int(divergence(var.codec, var.spec, self.states[var_id]))
+        codec, spec = self._mesh_meta(var_id)
+        return int(divergence(codec, spec, self.states[var_id]))
 
     def read_at(self, replica: int, var_id: str, threshold=None):
         """Non-blocking threshold check against one replica's row — the
-        vnode-local read (``src/lasp_vnode.erl:402-407``). Returns the row
-        state when the threshold is met, else None."""
+        vnode-local read (``src/lasp_vnode.erl:402-407``). Returns the
+        (dense) row state when the threshold is met, else None."""
         var = self.store.variable(var_id)
         thr = self.store._resolve_threshold(var, threshold)
-        row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
+        row = self._to_dense_row(
+            var_id,
+            jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id]),
+        )
         if bool(var.codec.threshold_met(var.spec, row, thr)):
             return row
         return None

@@ -10,9 +10,13 @@ from .packed import (
     pack_orset,
     unpack_orset,
 )
+from .flatpack import FlatORSet, FlatORSetSpec, FlatORSetState
 from .fused import fused_gossip_rounds
 
 __all__ = [
+    "FlatORSet",
+    "FlatORSetSpec",
+    "FlatORSetState",
     "PackedORSet",
     "PackedORSetSpec",
     "PackedORSetState",

@@ -1,0 +1,156 @@
+"""Flat bit-packed OR-Set: the mesh wire format — 1 bit per (elem, token).
+
+``PackedORSet`` (``lasp_tpu.ops.packed``) packs the token axis per element
+into whole uint32 words, which wastes up to 31 bits per element when token
+spaces are tiny — and *tiny token spaces are the norm for dataflow outputs*
+(a product's causal tokens number ``T_l * T_r`` of its inputs, e.g. 2).
+This codec flattens the whole (elem, token) grid into one bit axis
+(``bit = e * T + t``) and packs that, so a 50-element, 2-token product
+state costs 4 words instead of 50 — the densest possible HBM/ICI encoding
+of OR-Set state, and the representation ``ReplicatedRuntime(packed=True)``
+holds replica populations in.
+
+Semantics are IDENTICAL to the dense codec (``src/lasp_orset.erl:128-134``
+merge / :67-73 value): ``pack``/``unpack`` convert losslessly, and all
+non-hot operations (value decode, threshold checks, strict inflation)
+delegate to the dense codec through ``unpack`` — only the hot kernels
+(merge, equal, inflation) run natively on words.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..lattice.orset import ORSet, ORSetSpec, ORSetState
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatORSetSpec:
+    dense: ORSetSpec
+
+    @property
+    def n_bits(self) -> int:
+        return self.dense.n_elems * self.dense.n_tokens
+
+    @property
+    def n_words(self) -> int:
+        return (self.n_bits + 31) // 32
+
+
+class FlatORSetState(NamedTuple):
+    exists: jax.Array  # uint32[W]
+    removed: jax.Array  # uint32[W]
+
+
+def _pack_bits(spec: FlatORSetSpec, plane: jax.Array) -> jax.Array:
+    """bool[..., E, T] -> uint32[..., W]."""
+    flat = plane.reshape(plane.shape[:-2] + (spec.n_bits,))
+    pad = spec.n_words * 32 - spec.n_bits
+    flat = jnp.pad(flat.astype(jnp.uint32), [(0, 0)] * (flat.ndim - 1) + [(0, pad)])
+    flat = flat.reshape(flat.shape[:-1] + (spec.n_words, 32))
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(flat * weights, axis=-1, dtype=jnp.uint32)
+
+
+def _unpack_bits(spec: FlatORSetSpec, words: jax.Array) -> jax.Array:
+    """uint32[..., W] -> bool[..., E, T]."""
+    bits = (words[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & 1
+    flat = bits.reshape(words.shape[:-1] + (spec.n_words * 32,))
+    d = spec.dense
+    return flat[..., : spec.n_bits].astype(bool).reshape(
+        words.shape[:-1] + (d.n_elems, d.n_tokens)
+    )
+
+
+class FlatORSet:
+    name = "lasp_orset_flat"
+
+    @staticmethod
+    def new(spec: FlatORSetSpec) -> FlatORSetState:
+        z = jnp.zeros((spec.n_words,), dtype=jnp.uint32)
+        return FlatORSetState(exists=z, removed=z)
+
+    # -- conversions ---------------------------------------------------------
+    @staticmethod
+    def pack(spec: FlatORSetSpec, dense: ORSetState) -> FlatORSetState:
+        return FlatORSetState(
+            exists=_pack_bits(spec, dense.exists),
+            # canonicalize: tombstone bits only meaningful where minted
+            removed=_pack_bits(spec, dense.removed & dense.exists),
+        )
+
+    @staticmethod
+    def unpack(spec: FlatORSetSpec, state: FlatORSetState) -> ORSetState:
+        return ORSetState(
+            exists=_unpack_bits(spec, state.exists),
+            removed=_unpack_bits(spec, state.removed),
+        )
+
+    # -- hot kernels (native on words) ---------------------------------------
+    @staticmethod
+    def merge(spec, a: FlatORSetState, b: FlatORSetState) -> FlatORSetState:
+        return FlatORSetState(exists=a.exists | b.exists, removed=a.removed | b.removed)
+
+    @staticmethod
+    def equal(spec, a: FlatORSetState, b: FlatORSetState) -> jax.Array:
+        return jnp.all(a.exists == b.exists) & jnp.all(
+            (a.removed & a.exists) == (b.removed & b.exists)
+        )
+
+    @staticmethod
+    def is_inflation(spec, prev, cur) -> jax.Array:
+        return jnp.all((prev.exists & ~cur.exists) == 0)
+
+    @staticmethod
+    def is_strict_inflation(spec, prev, cur) -> jax.Array:
+        return ORSet.is_strict_inflation(
+            spec.dense, FlatORSet.unpack(spec, prev), FlatORSet.unpack(spec, cur)
+        )
+
+    # -- decode (delegates through unpack) -----------------------------------
+    @staticmethod
+    def value(spec, state) -> jax.Array:
+        return ORSet.value(spec.dense, FlatORSet.unpack(spec, state))
+
+    @staticmethod
+    def member_mask(spec, state) -> jax.Array:
+        return ORSet.member_mask(spec.dense, FlatORSet.unpack(spec, state))
+
+    @staticmethod
+    def threshold_met(spec, state, threshold) -> jax.Array:
+        thr = threshold
+        if isinstance(getattr(thr, "state", None), FlatORSetState):
+            thr = thr._replace(state=FlatORSet.unpack(spec, thr.state))
+        return ORSet.threshold_met(spec.dense, FlatORSet.unpack(spec, state), thr)
+
+    @staticmethod
+    def stats(spec, state) -> dict:
+        return ORSet.stats(spec.dense, FlatORSet.unpack(spec, state))
+
+    # -- vectorized seeding (device-side batched client ops) -----------------
+    @staticmethod
+    def scatter_tokens(
+        spec: FlatORSetSpec, states, rows: jax.Array, elems: jax.Array,
+        tokens: jax.Array,
+    ):
+        """OR token bits into a REPLICATED state ``[R, W]`` at ``(rows[i],
+        elems[i], tokens[i])`` — the device-side bulk-add kernel for
+        population-scale seeding (one scatter for millions of client adds,
+        no host loop). The (row, elem, token) triples MUST be unique: with
+        unique bits, scatter-add into a zero buffer is carry-free and equals
+        scatter-OR, which XLA has no native combinator for."""
+        d = spec.dense
+        bit = elems.astype(jnp.uint32) * jnp.uint32(d.n_tokens) + tokens.astype(
+            jnp.uint32
+        )
+        word = (bit // 32).astype(jnp.int32)
+        mask = jnp.uint32(1) << (bit % 32)
+        add_words = jnp.zeros_like(states.exists).at[rows, word].add(mask)
+        return states._replace(
+            exists=states.exists | add_words,
+            removed=states.removed & ~add_words,
+        )

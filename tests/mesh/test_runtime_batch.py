@@ -137,6 +137,30 @@ def test_update_batch_respects_pool_holes():
     assert not removed.any()
 
 
+@pytest.mark.parametrize("packed", [False, True])
+def test_update_batch_failure_persists_earlier_ops(packed):
+    # sequential semantics on failure: ops BEFORE the failing one stick,
+    # exactly as a per-op update_at loop would leave the state
+    from lasp_tpu.store.store import PreconditionError
+
+    store = Store(n_actors=8)
+    graph = Graph(store)
+    store.declare(id="s", type="lasp_orset", n_elems=8, tokens_per_actor=1)
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 1), packed=packed)
+    with pytest.raises(PreconditionError):
+        rt.update_batch(
+            "s", [(0, ("add", "kept"), "w"), (0, ("remove", "kept"), "w"),
+                  (0, ("remove", "kept"), "w")]
+        )
+    # the add AND the first remove landed; only the dup remove failed
+    import jax
+    import numpy as np
+
+    assert rt.replica_value("s", 0) == set()
+    st = rt._to_dense_row("s", jax.tree_util.tree_map(lambda x: x[0], rt.states["s"]))
+    assert np.asarray(st.exists).any() and np.asarray(st.removed & st.exists).any()
+
+
 def test_update_batch_empty_is_noop():
     _, _, rt = _runtime(type="riak_dt_gcounter")
     rt.update_batch("s", [])

@@ -51,7 +51,11 @@ def test_pipeline_small():
 
 def test_adcounter_small():
     out = adcounter_10m(n_replicas=8192, threshold=5)
-    assert out["check"] == "live==(<threshold)"
-    # with 8192 replicas spread over 8 ads x 8 buckets, every ad passes the
-    # threshold and gets disabled
-    assert out["live_ads"] == 0
+    assert out["check"] == "live==(<threshold), active==matching-pairs"
+    assert out["engine"] == "Graph+ReplicatedRuntime(packed)+trigger"
+    # ads 0..9 have L[a] = (a % 8) + 1 active view lanes; with threshold 5
+    # the ads whose totals stay under 5 (L in {1,2,3,4}) survive: ads
+    # 0,1,2,3 and 8,9 -> 6 live ads, each with its matching contract pair
+    assert out["live_ads"] == 6
+    assert out["active_pairs"] == 6
+    assert out["ad_totals"] == [1, 2, 3, 4, 5, 6, 7, 8, 1, 2]
