@@ -1,77 +1,287 @@
-"""Benchmark: OR-Set anti-entropy convergence (BASELINE.md headline).
+"""Benchmark driver: OR-Set anti-entropy headline + the 10M ad-counter
+north-star, capture-proof (round-3 contract).
 
-Workload: the 1M-replica OR-Set anti-entropy config ("random gossip"):
-every replica performs one local add, then pull-gossip rounds run until no
-replica's state changes (the join fixed point). State rides the bit-packed
-OR-Set codec (``lasp_tpu.ops.packed`` — 1 bit/token in HBM) and rounds run
-in fused blocks (``lasp_tpu.ops.fused``) so dispatch does not dominate.
+The PARENT process never imports jax: on this machine any backend query
+can initialize the single-client axon TPU tunnel and hang when it is
+wedged (the r2 failure mode). Instead the parent
+  1. probes TPU availability in bounded subprocesses, retrying with
+     backoff for a few minutes (a wedged tunnel heals on lease expiry),
+  2. runs the measurement in a child interpreter with a hard timeout,
+     terminated gracefully (SIGTERM before SIGKILL — never leave a
+     SIGKILLed TPU process holding the tunnel),
+  3. falls back to a small CPU run when no TPU materializes, and
+  4. ALWAYS prints exactly one JSON line; on total failure the line
+     carries an "error" field so the artifact still parses.
 
-The headline metric is replica-merges/sec/chip (one merge = one pairwise
-OR-Set join); ``vs_baseline`` is the speedup over a host-side NumPy merge
-loop on the SAME logical state shape — the stand-in for the reference's
-sequential per-replica ETS-backend merge path (the reference publishes no
-numbers of its own, SURVEY.md §6).
+Headline (HBM-bound, honest): wide-row packed OR-Set anti-entropy —
+128 elems x 8 words/elem (8 KiB/replica over both planes), random k=3
+gossip, rounds-to-convergence measured untimed first, then EXACTLY that
+many productive rounds timed in fused blocks (no post-convergence no-op
+rounds billed; see ``lasp_tpu.bench_scenarios.orset_anti_entropy``).
+``vs_baseline`` compares against a BATCHED full-population NumPy
+implementation of the same rounds on the same shapes — the honest host
+stand-in for the reference's per-replica ETS merge loop
+(``src/lasp_core.erl:300-301``); the reference itself publishes no
+numbers (SURVEY.md §6).
 
 Prints exactly one JSON line:
-    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...}
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
-import numpy as np
+_PROBE_WINDOW_S = int(os.environ.get("LASP_BENCH_PROBE_WINDOW", "300"))
+_PROBE_TIMEOUT_S = int(os.environ.get("LASP_BENCH_PROBE_TIMEOUT", "90"))
+_TPU_CHILD_TIMEOUT_S = int(os.environ.get("LASP_BENCH_TPU_TIMEOUT", "900"))
+_CPU_CHILD_TIMEOUT_S = int(os.environ.get("LASP_BENCH_CPU_TIMEOUT", "480"))
+
+#: single-chip HBM roofline, GB/s, by device-kind substring
+_ROOFLINE_GBPS = (
+    ("v6", 1638.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
 
 
-def main() -> None:
+def _run(cmd, timeout, env=None):
+    """Run a child with graceful termination on timeout. Returns
+    (rc, stdout, stderr); rc == -1 marks a timeout."""
+    proc = subprocess.Popen(
+        cmd,
+        env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        proc.send_signal(signal.SIGTERM)  # let jax release the TPU lease
+        try:
+            out, err = proc.communicate(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+        return -1, out or "", err or ""
+
+
+def _probe_tpu(deadline: float) -> bool:
+    """Bounded-subprocess TPU availability probe with backoff retries."""
+    code = "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform)"
+    backoffs = [15, 30, 60, 60, 60]
+    attempt = 0
+    while True:
+        budget = min(_PROBE_TIMEOUT_S, max(5, deadline - time.monotonic()))
+        rc, out, err = _run([sys.executable, "-c", code], timeout=budget)
+        if rc == 0 and "PLATFORM=" in out:
+            platform = out.rsplit("PLATFORM=", 1)[1].strip()
+            if platform not in ("cpu",):
+                return True
+            print(f"bench: probe found only platform={platform}", file=sys.stderr)
+            return False
+        print(
+            f"bench: TPU probe attempt {attempt + 1} failed "
+            f"(rc={rc}): {err.strip()[-200:]}",
+            file=sys.stderr,
+        )
+        if attempt >= len(backoffs) or time.monotonic() + backoffs[
+            min(attempt, len(backoffs) - 1)
+        ] > deadline:
+            return False
+        time.sleep(backoffs[min(attempt, len(backoffs) - 1)])
+        attempt += 1
+
+
+def _emit(record: dict) -> None:
+    print(json.dumps(record))
+
+
+def _fail_record(error: str) -> dict:
+    return {
+        "metric": "orset_replica_merges_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "merges/s",
+        "vs_baseline": 0.0,
+        "error": error,
+    }
+
+
+def _extract_json(out: str) -> dict | None:
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    start = time.monotonic()
+    errors: list[str] = []
+
+    tpu_ok = _probe_tpu(start + _PROBE_WINDOW_S)
+    attempts: list[tuple[str, dict, int]] = []
+    if tpu_ok:
+        attempts.append(("tpu", dict(os.environ), _TPU_CHILD_TIMEOUT_S))
+        attempts.append(("tpu-retry", dict(os.environ), _TPU_CHILD_TIMEOUT_S))
+    cpu_env = dict(os.environ)
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    attempts.append(("cpu-fallback", cpu_env, _CPU_CHILD_TIMEOUT_S))
+
+    for i, (label, env, budget) in enumerate(attempts):
+        if label == "tpu-retry":
+            time.sleep(45)  # give a transiently-wedged tunnel a beat
+        rc, out, err = _run(
+            [sys.executable, os.path.abspath(__file__), "--child", label],
+            timeout=budget,
+            env=env,
+        )
+        record = _extract_json(out)
+        if rc == 0 and record is not None:
+            if errors:
+                record.setdefault("detail", {})["earlier_attempts"] = errors
+            if label == "cpu-fallback":
+                record["error"] = (
+                    "TPU unavailable after probe+retries; measured on CPU "
+                    "fallback at reduced scale"
+                    if tpu_ok is False
+                    else "TPU attempts failed; measured on CPU fallback"
+                )
+            _emit(record)
+            return 0
+        errors.append(
+            f"{label}: rc={rc} err_tail={err.strip()[-300:]!r}"
+        )
+        print(f"bench: attempt {label} failed (rc={rc})", file=sys.stderr)
+
+    _emit(_fail_record("; ".join(errors) or "no attempt ran"))
+    return 0  # the artifact must parse; failure is in the record
+
+
+# ---------------------------------------------------------------------------
+# child: the actual measurement (runs with a parent-enforced deadline)
+# ---------------------------------------------------------------------------
+
+def _child(label: str) -> int:
+    import numpy as np
+
     import jax
 
-    from lasp_tpu.bench_scenarios import orset_anti_entropy
+    # sitecustomize pins jax_platforms="axon,cpu" at interpreter startup,
+    # OVERRIDING the env var — a CPU child must re-pin the config itself
+    # before first device use or it will initialize the TPU tunnel anyway
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
-    n_replicas = int(os.environ.get("LASP_BENCH_REPLICAS", 1 << 20))
+    from lasp_tpu.bench_scenarios import adcounter_10m, orset_anti_entropy
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    kind = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
+
+    # -- headline: wide-row packed OR-Set anti-entropy ----------------------
+    wide = dict(n_elems=128, n_actors=64, tokens_per_actor=4)  # 8 KiB/replica
+    n_replicas = int(
+        os.environ.get("LASP_BENCH_REPLICAS", (1 << 18) if on_tpu else (1 << 12))
+    )
     block = int(os.environ.get("LASP_BENCH_BLOCK", 4))
-
-    out = orset_anti_entropy(n_replicas, block=block)
+    out = orset_anti_entropy(n_replicas, block=block, **wide)
     tpu_rate = out["merges_per_sec"]
 
-    # host NumPy baseline: sequential pairwise joins of the same logical
-    # state shape (byte bools, as a host implementation would hold them)
-    e, t = 8, 32  # matches orset_anti_entropy's spec (n_elems, n_tokens)
-    a_e = np.zeros((e, t), dtype=bool)
-    a_r = np.zeros_like(a_e)
-    b_e = np.ones_like(a_e)
-    b_r = np.zeros_like(a_e)
-    n_cpu = 20_000
-    t0 = time.perf_counter()
-    for _ in range(n_cpu):
-        a_e = a_e | b_e
-        a_r = a_r | b_r
-    cpu_elapsed = time.perf_counter() - t0
-    cpu_rate = n_cpu / cpu_elapsed
+    # -- batched NumPy baseline: same shapes, same rounds, full population --
+    from lasp_tpu.mesh.topology import random_regular
 
-    print(
-        json.dumps(
-            {
-                "metric": "orset_replica_merges_per_sec_per_chip",
-                "value": tpu_rate,
-                "unit": "merges/s",
-                "vs_baseline": round(tpu_rate / cpu_rate, 2),
-                "detail": {
-                    "n_replicas": n_replicas,
-                    "fanout": out["fanout"],
-                    "rounds_executed": out["rounds"],
-                    "elapsed_s": out["seconds"],
-                    "encoding": "packed-uint32",
-                    "cpu_baseline_merges_per_sec": round(cpu_rate, 1),
-                    "device": str(jax.devices()[0].platform),
-                },
-            }
+    nb_r = min(n_replicas, 1 << 14)
+    e, w = wide["n_elems"], (wide["n_actors"] * wide["tokens_per_actor"] + 31) // 32
+    rng = np.random.RandomState(7)
+    ex = np.zeros((nb_r, e, w), dtype=np.uint32)
+    rm = np.zeros_like(ex)
+    r = np.arange(nb_r)
+    ex[r, r % e, (r % wide["n_actors"]) // 8] = 1  # one live token each
+    nbrs = random_regular(nb_r, 3, seed=7)
+    np_rounds = max(out["rounds"] // 2, 2)
+    t0 = time.perf_counter()
+    for _ in range(np_rounds):
+        for k in range(nbrs.shape[1]):
+            idx = nbrs[:, k]
+            ex |= ex[idx]
+            rm |= rm[idx]
+    np_secs = time.perf_counter() - t0
+    cpu_rate = nb_r * nbrs.shape[1] * np_rounds / np_secs
+
+    roofline = None
+    if on_tpu:
+        for sub, gbps in _ROOFLINE_GBPS:
+            if sub in str(kind).lower():
+                roofline = gbps
+                break
+
+    detail = {
+        "n_replicas": n_replicas,
+        "fanout": out["fanout"],
+        "rounds_to_convergence": out["rounds"],
+        "elapsed_s": out["seconds"],
+        "encoding": "packed-uint32-wide",
+        "state_bytes_per_replica": out["state_bytes_per_replica"],
+        "achieved_GBps": out["achieved_GBps"],
+        "roofline_GBps": roofline,
+        "roofline_frac": (
+            round(out["achieved_GBps"] / roofline, 3) if roofline else None
+        ),
+        "numpy_baseline_merges_per_sec": round(cpu_rate, 1),
+        "numpy_baseline_replicas": nb_r,
+        "device": str(jax.devices()[0].platform),
+        "device_kind": str(kind),
+        "attempt": label,
+    }
+
+    # -- north-star: 10M-replica engine-path ad counter ---------------------
+    ns_replicas = int(
+        os.environ.get(
+            "LASP_BENCH_NORTHSTAR_REPLICAS",
+            10 * (1 << 20) if on_tpu else (1 << 13),
         )
     )
+    try:
+        ns = adcounter_10m(n_replicas=ns_replicas)
+        detail["adcounter_northstar"] = {
+            "n_replicas": ns_replicas,
+            "rounds": ns["rounds"],
+            "seconds": ns["seconds"],
+            "under_60s": ns["under_60s"],
+            "engine": ns["engine"],
+            "check": ns["check"],
+        }
+    except Exception as exc:  # headline survives a north-star failure
+        detail["adcounter_northstar"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    _emit(
+        {
+            "metric": "orset_replica_merges_per_sec_per_chip",
+            "value": tpu_rate,
+            "unit": "merges/s",
+            "vs_baseline": round(tpu_rate / cpu_rate, 2),
+            "detail": detail,
+        }
+    )
+    return 0
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        sys.exit(_child(sys.argv[2] if len(sys.argv) > 2 else "tpu"))
     sys.exit(main())

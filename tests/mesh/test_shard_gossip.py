@@ -1,0 +1,93 @@
+"""Explicit-collective ring gossip: semantics vs the dense reference path
+and an HLO-level proof that the lowering really uses `collective-permute`
+(VERDICT r2 ask #8 — "lowers to ppermute" must be verified, not claimed).
+Runs on the 8-virtual-CPU-device mesh provisioned by tests/conftest.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from lasp_tpu.lattice import GSet, GSetSpec, replicate
+from lasp_tpu.mesh import gossip_round, ring
+from lasp_tpu.mesh.shard_gossip import (
+    ring_gossip_round_fn,
+    ring_gossip_rounds,
+    ring_gossip_shardmap_dryrun,
+    ring_offsets,
+)
+from lasp_tpu.ops import PackedORSet, PackedORSetSpec
+
+
+def _mesh():
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provision 8 virtual CPU devices"
+    return Mesh(np.array(devs[:8]), ("replicas",))
+
+
+def test_ring_offsets_match_topology():
+    n, k = 32, 3
+    nbrs = ring(n, k)
+    offs = ring_offsets(k)
+    r = np.arange(n)
+    for j, off in enumerate(offs):
+        assert (nbrs[:, j] == (r + off) % n).all()
+
+
+@pytest.mark.parametrize("k", [1, 2, 3])
+def test_ppermute_ring_equals_dense_ring_gset(k):
+    mesh = _mesh()
+    n, e = 64, 16
+    spec = GSetSpec(n_elems=e)
+    rng = np.random.RandomState(4)
+    states = replicate(GSet.new(spec), n)._replace(
+        mask=jnp.asarray(rng.rand(n, e) < 0.1)
+    )
+    sh = NamedSharding(mesh, P("replicas"))
+    sharded = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
+    round_fn = jax.jit(ring_gossip_round_fn(GSet, spec, mesh, k=k))
+    got = round_fn(sharded)
+    ref = gossip_round(GSet, spec, states, jnp.asarray(ring(n, k)))
+    assert jnp.array_equal(got.mask, ref.mask)
+
+
+def test_ppermute_ring_equals_dense_ring_packed_orset_multiround():
+    mesh = _mesh()
+    n = 64
+    spec = PackedORSetSpec(n_elems=8, n_actors=4, tokens_per_actor=2)
+    rng = np.random.RandomState(5)
+    from lasp_tpu.lattice.base import replicate as rep
+
+    states = rep(PackedORSet.new(spec), n)._replace(
+        exists=jnp.asarray(
+            rng.randint(0, 256, size=(n, spec.n_elems, spec.n_words)),
+            dtype=jnp.uint32,
+        )
+    )
+    sh = NamedSharding(mesh, P("replicas"))
+    sharded = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
+    got, changed = ring_gossip_rounds(PackedORSet, spec, sharded, mesh, 3, k=2)
+    ref = states
+    nbrs = jnp.asarray(ring(n, 2))
+    for _ in range(3):
+        ref = gossip_round(PackedORSet, spec, ref, nbrs)
+    assert bool(changed)
+    assert jnp.array_equal(got.exists, ref.exists)
+    assert jnp.array_equal(got.removed, ref.removed)
+
+
+def test_hlo_contains_collective_permute():
+    mesh = _mesh()
+    n, e = 64, 16
+    spec = GSetSpec(n_elems=e)
+    states = replicate(GSet.new(spec), n)
+    sh = NamedSharding(mesh, P("replicas"))
+    sharded = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
+    round_fn = jax.jit(ring_gossip_round_fn(GSet, spec, mesh, k=2))
+    hlo = round_fn.lower(sharded).compile().as_text()
+    assert "collective-permute" in hlo, "ring gossip must lower to ppermute"
+
+
+def test_dryrun_helper_runs():
+    ring_gossip_shardmap_dryrun(_mesh(), 64)
