@@ -207,7 +207,6 @@ def _child(label: str) -> int:
 
     nb_r = min(n_replicas, 1 << 14)
     e, w = wide["n_elems"], (wide["n_actors"] * wide["tokens_per_actor"] + 31) // 32
-    rng = np.random.RandomState(7)
     ex = np.zeros((nb_r, e, w), dtype=np.uint32)
     rm = np.zeros_like(ex)
     r = np.arange(nb_r)

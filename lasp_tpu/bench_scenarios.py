@@ -233,9 +233,10 @@ def orset_anti_entropy(
     )
     states = seed_states()
     jax.block_until_ready(states)
-    # warm both compiled shapes outside the clock
+    # warm the compiled shapes outside the clock
     jax.block_until_ready(timed_full(states, nbrs))
-    jax.block_until_ready(timed_tail(states, nbrs))
+    if tail:
+        jax.block_until_ready(timed_tail(states, nbrs))
     states = seed_states()
     jax.block_until_ready(states)
 

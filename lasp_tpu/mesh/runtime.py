@@ -176,14 +176,34 @@ class ReplicatedRuntime:
         states = self.states[var_id]
         if not ops:
             return
+        try:
+            self._dispatch_batch(var, tn, states, ops)
+        finally:
+            # a mid-batch CapacityError/PreconditionError persists the ops
+            # before the failure (sequential semantics) — their interned
+            # terms must still fold into the edge tables, or a caller that
+            # catches the error sweeps with stale projections
+            self.graph.refresh()
+
+    def _dispatch_batch(self, var, tn, states, ops) -> None:
+        var_id = var.id
         if tn == "riak_dt_gcounter":
             rows, lanes, by = [], [], []
             for r, op, actor in ops:
                 if op[0] != "increment":
                     raise ValueError(f"update_batch: unsupported op {op!r}")
+                amount = op[1] if len(op) > 1 else 1
+                if amount < 1:
+                    # reference riak_dt_gcounter rejects non-positive
+                    # increments; per-op update_at would drop it at the
+                    # inflation gate — batch must not silently deflate
+                    raise ValueError(
+                        f"update_batch: G-Counter increment must be >= 1, "
+                        f"got {amount!r}"
+                    )
                 rows.append(r)
                 lanes.append(var.actors.intern(actor))
-                by.append(op[1] if len(op) > 1 else 1)
+                by.append(amount)
             counts = states.counts.at[
                 np.asarray(rows, dtype=np.int32), np.asarray(lanes, dtype=np.int32)
             ].add(np.asarray(by, dtype=states.counts.dtype))
@@ -212,7 +232,6 @@ class ReplicatedRuntime:
             raise ValueError(
                 f"update_batch: unsupported type {tn!r} (use update_at)"
             )
-        self.graph.refresh()
 
     def _orset_batch(self, var, ops) -> None:
         """Batched OR-Set adds/removes with SEQUENTIAL semantics: ops are
@@ -227,8 +246,6 @@ class ReplicatedRuntime:
         On a mid-batch failure (exhausted pool / not_present), every op
         BEFORE the failing one persists and the error then raises —
         exactly the state a per-op loop would leave."""
-        from ..store.store import PreconditionError
-
         spec = var.spec
         k = spec.tokens_per_actor
         # split into maximal same-verb phases, preserving op order
@@ -243,10 +260,13 @@ class ReplicatedRuntime:
             elif verb in ("remove", "remove_all"):
                 kind = "remove"
                 terms = op[1] if verb == "remove_all" else [op[1]]
-                for e in terms:
-                    if e not in var.elems:
-                        raise PreconditionError(f"not_present: {e!r}")
-                items = [(r, var.elems.index_of(e), e) for e in terms]
+                # an unknown term is not_present, but must fail AT ITS
+                # POSITION in the sequence (earlier ops persist first) —
+                # index -1 marks it; the phase application forces live=False
+                items = [
+                    (r, var.elems.index_of(e) if e in var.elems else -1, e)
+                    for e in terms
+                ]
             else:
                 raise ValueError(f"update_batch: unsupported op {op!r}")
             if phases and phases[-1][0] == kind:
@@ -290,9 +310,12 @@ class ReplicatedRuntime:
                     flush(exists, removed)  # sequential: earlier ops persist
                     raise err
             else:
+                valid = elems >= 0
+                safe = np.where(valid, elems, 0)
                 live = np.asarray(
-                    jnp.any(exists[rows, elems] & ~removed[rows, elems], axis=-1)
+                    jnp.any(exists[rows, safe] & ~removed[rows, safe], axis=-1)
                 )
+                live = live & valid
                 n_ok, err = self._check_removes(items, live)
                 if n_ok:
                     ok_r = rows[:n_ok]
@@ -365,8 +388,12 @@ class ReplicatedRuntime:
             pspec = self._packed_specs[var_id]
             d = pspec.dense
             masks = np.zeros((d.n_elems, pspec.n_words), dtype=np.uint32)
-            for b in range(pspec.n_bits):
-                masks[b // d.n_tokens, b // 32] |= np.uint32(1) << (b % 32)
+            b = np.arange(pspec.n_bits, dtype=np.int64)
+            np.bitwise_or.at(
+                masks,
+                (b // d.n_tokens, b // 32),
+                (np.uint32(1) << (b % 32).astype(np.uint32)),
+            )
             cache[var_id] = masks
         return cache[var_id]
 
@@ -416,9 +443,12 @@ class ReplicatedRuntime:
                     raise err
             else:
                 elems = np.asarray([it[1] for it in items], dtype=np.int32)
+                valid = elems >= 0
+                safe = np.where(valid, elems, 0)
                 ex_rows = np.asarray(exists[rows])  # [B, W]
                 rm_rows = np.asarray(removed[rows])
-                live = ((ex_rows & ~rm_rows) & elem_masks[elems]).any(axis=-1)
+                live = ((ex_rows & ~rm_rows) & elem_masks[safe]).any(axis=-1)
+                live = live & valid
                 n_ok, err = self._check_removes(items, live)
                 if n_ok:
                     # combine per-row tombstone masks (duplicate rows fine
@@ -570,7 +600,27 @@ class ReplicatedRuntime:
         ``elems[i]`` live at replica ``rows[i]`` — millions of client
         ``add_by_token`` writes in one scatter (the batched client-op path
         the population-scale configs drive; reference op
-        ``src/lasp_orset.erl:101-102``). Triples must be unique."""
+        ``src/lasp_orset.erl:101-102``).
+
+        In PACKED mode duplicate (row, elem, token) triples are
+        deduplicated host-side: the packed path's scatter-add emulation of
+        scatter-OR would binary-carry a duplicate into an UNRELATED bit —
+        silent state corruption. The dense ``.at[].set(True)`` path is
+        already idempotent and skips the dedup (bulk calls stay
+        sort-free)."""
+        if var_id in self._packed_specs:
+            d = self.store.variable(var_id).spec
+            rows_np = np.asarray(rows, dtype=np.int64)
+            elems_np = np.asarray(elems, dtype=np.int64)
+            tokens_np = np.asarray(tokens, dtype=np.int64)
+            flat = (rows_np * d.n_elems + elems_np) * d.n_tokens + tokens_np
+            uniq, first = np.unique(flat, return_index=True)
+            if len(uniq) != len(flat):
+                first.sort()
+                rows_np, elems_np, tokens_np = (
+                    rows_np[first], elems_np[first], tokens_np[first]
+                )
+            rows, elems, tokens = rows_np, elems_np, tokens_np
         rows = jnp.asarray(rows)
         elems = jnp.asarray(elems)
         tokens = jnp.asarray(tokens)

@@ -21,11 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-try:  # jax >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
-
+from jax import shard_map as _shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -45,6 +41,11 @@ def _shift_pull(x: jax.Array, off: int, axis_name: str, n_dev: int) -> jax.Array
     """Per-shard block of a global pull-shift: ``result[r] = x[(r+off) % R]``
     for a block-sharded leading axis. Local slice + one ppermute moving the
     ``|off|``-row boundary slab to the adjacent device."""
+    if x.shape[0] < abs(off):
+        raise ValueError(
+            f"ring offset {off} exceeds per-shard block of {x.shape[0]} "
+            f"rows; lower k or use fewer devices"
+        )
     if off > 0:
         # device i needs the first `off` rows of device i+1's block
         head = x[:off]

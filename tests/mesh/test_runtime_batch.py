@@ -187,3 +187,99 @@ def test_token_pool_exhaustion_is_loud():
     var = store.variable(v)
     stats = ORSet.stats(var.spec, var.state)
     assert stats["full_pools"] == 1
+
+
+# -- ADVICE round-2 fixes ----------------------------------------------------
+
+def test_gcounter_batch_rejects_nonpositive_increment():
+    """Reference riak_dt_gcounter rejects non-positive increments; the
+    batched scatter-add must raise instead of silently deflating a lane."""
+    _, _, rt = _runtime(type="riak_dt_gcounter")
+    with pytest.raises(ValueError, match=">= 1"):
+        rt.update_batch("s", [(0, ("increment", 0), "a")])
+    with pytest.raises(ValueError, match=">= 1"):
+        rt.update_batch("s", [(0, ("increment", -3), "a")])
+    rt.update_batch("s", [(0, ("increment", 2), "a")])
+    rt.run_to_convergence()
+    assert rt.coverage_value("s") == 2
+
+
+def test_seed_tokens_duplicate_triples_idempotent_packed_vs_dense():
+    """Duplicate (row, elem, token) triples must be idempotent in BOTH
+    modes: the packed scatter-add emulation of scatter-OR would otherwise
+    carry a duplicate bit into an unrelated token/element."""
+    import numpy as np
+
+    for packed in (False, True):
+        store = Store(n_actors=4)
+        graph = Graph(store)
+        store.declare(id="s", type="lasp_orset", n_elems=4, n_actors=4,
+                      tokens_per_actor=2)
+        rt = ReplicatedRuntime(store, graph, 4, ring(4, 1), packed=packed)
+        rt.intern_terms("s", ["a", "b", "c", "d"])
+        rows = np.array([0, 0, 0, 1, 1])
+        elems = np.array([1, 1, 1, 2, 2])
+        tokens = np.array([3, 3, 3, 5, 5])  # duplicates on purpose
+        rt.seed_tokens("s", rows, elems, tokens)
+        if packed:
+            got = rt.states["s"]
+            from lasp_tpu.ops import FlatORSet
+            dense = FlatORSet.unpack(rt._packed_specs["s"], got)
+        else:
+            dense = rt.states["s"]
+        ex = np.asarray(dense.exists)
+        assert ex[0, 1, 3] and ex[1, 2, 5]
+        assert ex.sum() == 2, f"packed={packed}: duplicate bits leaked"
+
+
+def test_mid_batch_failure_still_refreshes_edge_tables():
+    """A caught mid-batch PreconditionError persists earlier ops; their
+    interned terms must reach the edge tables (graph.refresh in finally),
+    so a subsequent sweep projects them into dataflow outputs."""
+    from lasp_tpu.store.store import PreconditionError
+
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    a = store.declare(id="a", type="lasp_orset", n_elems=8)
+    b = store.declare(id="b", type="lasp_orset", n_elems=8)
+    graph.union(a, b, dst="u")
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 1))
+    with pytest.raises(PreconditionError):
+        rt.update_batch(
+            "a", [(0, ("add", "x"), "w"), (0, ("remove", "ghost"), "w")]
+        )
+    rt.run_to_convergence()
+    assert rt.coverage_value("u") == {"x"}
+
+
+def test_elem_word_masks_vectorized_matches_bit_loop():
+    import numpy as np
+
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    store.declare(id="s", type="lasp_orset", n_elems=5, n_actors=3,
+                  tokens_per_actor=3)
+    rt = ReplicatedRuntime(store, graph, 2, ring(2, 1), packed=True)
+    pspec = rt._packed_specs["s"]
+    d = pspec.dense
+    got = rt._elem_word_masks("s")
+    ref = np.zeros((d.n_elems, pspec.n_words), dtype=np.uint32)
+    for bit in range(pspec.n_bits):
+        ref[bit // d.n_tokens, bit // 32] |= np.uint32(1) << (bit % 32)
+    assert (got == ref).all()
+
+
+def test_remove_of_unknown_term_fails_at_its_position_packed():
+    """Packed twin: earlier adds persist before the unknown-term remove
+    raises, matching per-op sequential semantics."""
+    from lasp_tpu.store.store import PreconditionError
+
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    store.declare(id="s", type="lasp_orset", n_elems=8)
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 1), packed=True)
+    with pytest.raises(PreconditionError, match="ghost"):
+        rt.update_batch(
+            "s", [(1, ("add", "kept"), "w"), (1, ("remove", "ghost"), "w")]
+        )
+    assert rt.replica_value("s", 1) == {"kept"}
