@@ -91,15 +91,16 @@ def cmd_scenario(args) -> int:
 
 
 def cmd_inspect(args) -> int:
-    import pickle
-
     from lasp_tpu.store import HostStore
+    from lasp_tpu.store.checkpoint import loads_manifest
 
     with HostStore(args.path) as hs:
         manifest = hs.get("manifest")
         out = {"stats": hs.stats(), "keys": hs.keys()}
         if manifest is not None:
-            m = pickle.loads(manifest)
+            # restricted unpickler: inspect runs on ARBITRARY paths and a
+            # stock pickle.loads would execute attacker-controlled code
+            m = loads_manifest(manifest)
             out["kind"] = m.get("kind")
             out["vars"] = {
                 vid: entry["type_name"] for vid, entry in m.get("vars", {}).items()
@@ -111,6 +112,16 @@ def cmd_inspect(args) -> int:
 
 
 def main(argv=None) -> int:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # honor the documented JAX_PLATFORMS contract even where a
+        # sitecustomize has re-pinned jax_platforms at interpreter startup
+        # (a no-op on stock environments: the config default already
+        # mirrors the env var)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     p = argparse.ArgumentParser(prog="lasp_tpu", description=__doc__)
     sub = p.add_subparsers(dest="verb", required=True)
 
@@ -121,7 +132,13 @@ def main(argv=None) -> int:
     sim.add_argument("--topology", choices=["ring", "random", "scale_free"],
                      default="random")
     sim.add_argument("--fanout", type=int, default=3)
-    sim.add_argument("--type", default="lasp_orset")
+    sim.add_argument(
+        "--type",
+        default="lasp_orset",
+        # only the set family supports the simulate verb's ("add", item)
+        # write shape; other types would crash mid-simulation
+        choices=["lasp_gset", "lasp_orset", "lasp_orset_gbtree"],
+    )
     sim.add_argument("--elems", type=int, default=64)
     sim.add_argument("--writers", type=int, default=8)
     sim.add_argument("--max-rounds", type=int, default=256)

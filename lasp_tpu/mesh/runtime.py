@@ -62,6 +62,7 @@ class ReplicatedRuntime:
         self._packed_specs: dict[str, FlatORSetSpec] = {}
         self._triggers: list = []
         self._step = None
+        self._fused_steps_cache: dict[int, object] = {}
         self._n_edges = -1
         self.trace = StepTrace()
         self._sync_graph()
@@ -89,6 +90,7 @@ class ReplicatedRuntime:
         self.var_ids = tuple(self.states)
         self._n_edges = len(graph.edges)
         self._step = None
+        self._fused_steps_cache.clear()
 
     # -- mesh-side codec selection -------------------------------------------
     def _mesh_meta(self, var_id: str):
@@ -123,6 +125,7 @@ class ReplicatedRuntime:
         (``src/lasp_core.erl:301-311``), vmapped over the population."""
         self._triggers.append(fn)
         self._step = None
+        self._fused_steps_cache.clear()
 
     # -- client operations ---------------------------------------------------
     def update_at(self, replica: int, var_id: str, op: tuple, actor) -> None:
@@ -573,9 +576,69 @@ class ReplicatedRuntime:
         self.trace.record_round(residual, t.elapsed)
         return residual
 
-    def run_to_convergence(self, max_rounds: int = 10_000, edge_mask=None) -> int:
+    def fused_steps(self, block: int, edge_mask=None) -> int:
+        """Run ``block`` FULL steps (dataflow sweep + triggers + gossip +
+        residual) inside one ``lax.fori_loop`` — one host dispatch and one
+        device sync per block instead of per round. This is the engine-path
+        twin of ``ops.fused.fused_gossip_rounds``: at population scale the
+        per-round dispatch + ``int(residual)`` sync of :meth:`step`
+        dominates wall-clock once the per-round kernels are fast.
+
+        Returns the 0-based index WITHIN the block of the first quiescent
+        round (residual 0), or -1 if every round in the block changed
+        something. Because a quiescent step is a fixed point of the whole
+        step function (join idempotence + the triggers' inflation gate),
+        rounds after the first zero are no-ops — running the remainder of
+        the block is harmless."""
+        if self._n_edges != len(self.graph.edges):
+            self._sync_graph()
+        if self._step is None:
+            self._step = self._build_step()
+            self._fused_steps_cache.clear()
+        fn = self._fused_steps_cache.get(block)
+        if fn is None:
+            step = self._step_pure
+
+            def fused(states, neighbors, mask, tables):
+                def body(i, carry):
+                    s, first_zero = carry
+                    out, residual = step(s, neighbors, mask, tables)
+                    first_zero = jnp.where(
+                        (first_zero < 0) & (residual == 0), i, first_zero
+                    )
+                    return out, first_zero
+
+                return jax.lax.fori_loop(
+                    0, block, body, (states, jnp.int32(-1))
+                )
+
+            fn = jax.jit(fused)
+            self._fused_steps_cache[block] = fn
+        tables = tuple(e.device_tables() for e in self.graph.edges)
+        with Timer() as t:
+            self.states, first_zero = fn(
+                self.states, self.neighbors, edge_mask, tables
+            )
+            first_zero = int(first_zero)  # device sync closes timing window
+        self.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
+        return first_zero
+
+    def run_to_convergence(
+        self, max_rounds: int = 10_000, edge_mask=None, block: int = 1
+    ) -> int:
         """Step until no state changes (the join fixed point); returns
-        rounds taken — the rounds-to-convergence metric (BASELINE.md)."""
+        rounds taken — the rounds-to-convergence metric (BASELINE.md).
+        With ``block > 1`` rounds run in fused blocks (one dispatch per
+        block); the returned round count is still exact — the fused kernel
+        reports the in-block index of the first quiescent round."""
+        if block > 1:
+            rounds = 0
+            while rounds < max_rounds:
+                first_zero = self.fused_steps(block, edge_mask)
+                if first_zero >= 0:
+                    return rounds + first_zero + 1
+                rounds += block
+            raise RuntimeError(f"no convergence within {max_rounds} rounds")
         for i in range(max_rounds):
             if self.step(edge_mask) == 0:
                 return i + 1

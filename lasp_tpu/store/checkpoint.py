@@ -15,6 +15,7 @@ as the reference re-ships program sources at registration time)."""
 
 from __future__ import annotations
 
+import io
 import pickle
 
 import jax
@@ -22,6 +23,32 @@ import numpy as np
 
 from .host_store import HostStore
 from .store import Store, Variable
+
+
+class _ManifestUnpickler(pickle.Unpickler):
+    """Restricted unpickler for checkpoint manifests: a checkpoint file is
+    UNTRUSTED input (``cli.py inspect`` runs on arbitrary paths), and a
+    stock ``pickle.loads`` executes arbitrary ``__reduce__`` payloads.
+    Manifests only ever reference this package's spec/codec classes;
+    everything else — in particular any ``builtins``/``os``/``subprocess``
+    global — is refused before instantiation."""
+
+    _ALLOWED_PREFIXES = ("lasp_tpu.lattice", "lasp_tpu.ops")
+
+    def find_class(self, module, name):
+        if any(
+            module == p or module.startswith(p + ".")
+            for p in self._ALLOWED_PREFIXES
+        ):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"checkpoint manifest may not reference {module}.{name}"
+        )
+
+
+def loads_manifest(raw: bytes) -> dict:
+    """Deserialize a checkpoint manifest with the restricted unpickler."""
+    return _ManifestUnpickler(io.BytesIO(raw)).load()
 
 
 def _leaf_key(var_id: str, i: int) -> str:
@@ -130,7 +157,7 @@ def load_store(path: str) -> Store:
         raw = hs.get("manifest")
         if raw is None:
             raise IOError(f"no checkpoint manifest in {path}")
-        manifest = pickle.loads(raw)
+        manifest = loads_manifest(raw)
         store = Store(n_actors=manifest["n_actors"])
         store.metrics.update(manifest.get("metrics", {}))
         store.mutations = manifest.get("mutations", 0)
@@ -150,6 +177,7 @@ def save_runtime(runtime, path: str) -> None:
             "kind": "runtime",
             "n_actors": runtime.store.n_actors,
             "n_replicas": runtime.n_replicas,
+            "packed": runtime.packed,
             "vars": {},
         }
         for var_id in runtime.var_ids:
@@ -172,7 +200,7 @@ def load_runtime(path: str, graph=None):
     from ..mesh.runtime import ReplicatedRuntime
 
     with HostStore(path) as hs:
-        manifest = pickle.loads(hs.get("manifest"))
+        manifest = loads_manifest(hs.get("manifest"))
         assert manifest["kind"] == "runtime"
         store = Store(n_actors=manifest["n_actors"])
         for var_id, entry in manifest["vars"].items():
@@ -181,7 +209,10 @@ def load_runtime(path: str, graph=None):
         g = graph(store) if callable(graph) else Graph(store)
         dtype, shape = manifest["neighbors"]
         neighbors = np.frombuffer(hs.get("neighbors"), dtype=dtype).reshape(shape)
-        rt = ReplicatedRuntime(store, g, manifest["n_replicas"], neighbors)
+        rt = ReplicatedRuntime(
+            store, g, manifest["n_replicas"], neighbors,
+            packed=manifest.get("packed", False),
+        )
         for var_id, entry in manifest["vars"].items():
             rt.states[var_id] = _get_state(
                 hs, var_id, rt.states[var_id], entry

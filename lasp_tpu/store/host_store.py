@@ -70,6 +70,8 @@ def _load_native():
     lib.lasp_store_keys_len.restype = ctypes.c_uint64
     lib.lasp_store_keys_len.argtypes = [ctypes.c_void_p]
     lib.lasp_store_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.lasp_store_compact.restype = ctypes.c_int
+    lib.lasp_store_compact.argtypes = [ctypes.c_void_p]
     lib.lasp_store_close.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -127,8 +129,28 @@ class HostStore:
                 return []
             buf = ctypes.create_string_buffer(int(n))
             _NATIVE.lasp_store_keys(self._h, buf)
-            return [k.decode() for k in buf.raw[: int(n)].split(b"\n") if k]
+            # length-prefixed wire format (u32 len | key bytes, repeated):
+            # keys may contain ANY byte, including newlines
+            raw = buf.raw[: int(n)]
+            out, off = [], 0
+            while off < len(raw):
+                (klen,) = struct.unpack_from("<I", raw, off)
+                off += 4
+                out.append(raw[off : off + klen].decode())
+                off += klen
+            return out
         return sorted(k.decode() for k in self._py.index)
+
+    def compact(self) -> None:
+        """Rewrite live records into a fresh log, reclaiming superseded and
+        tombstoned bytes (the reference's waste_pct compaction cue,
+        ``src/lasp_orset.erl:178-191``)."""
+        if self.backend == "native":
+            rc = _NATIVE.lasp_store_compact(self._h)
+            if rc != 0:
+                raise IOError(f"laspstore compact failed: {rc}")
+        else:
+            self._py.compact()
 
     def stats(self) -> dict:
         if self.backend == "native":
@@ -162,6 +184,7 @@ class _PyLog:
     """Same on-disk format as native/laspstore.cpp, in Python."""
 
     def __init__(self, path: str):
+        self.path = path
         exists = os.path.exists(path)
         self.f = open(path, "r+b" if exists else "w+b")
         self.index: dict[bytes, tuple[int, int]] = {}
@@ -238,6 +261,46 @@ class _PyLog:
         self.wasted += self.index[key][1]
         del self.index[key]
         return True
+
+    def compact(self):
+        tmp_path = self.path + ".compact"
+        try:
+            with open(tmp_path, "w+b") as out:
+                out.write(struct.pack("<II", _FILE_MAGIC, _VERSION))
+                new_index: dict[bytes, tuple[int, int]] = {}
+                for key, (off, n) in self.index.items():
+                    self.f.seek(off)
+                    value = self.f.read(n)
+                    pos = out.tell()
+                    crc = zlib.crc32(key + value) & 0xFFFFFFFF
+                    out.write(
+                        struct.pack("<IIQ", _REC_MAGIC, len(key), len(value))
+                    )
+                    out.write(key)
+                    out.write(value)
+                    out.write(struct.pack("<I", crc))
+                    new_index[key] = (pos + 16 + len(key), len(value))
+                out.flush()
+        except BaseException:
+            # leave the store fully usable on the old log: appends must
+            # land at end-of-file, and the temp file must not linger
+            self.f.seek(0, os.SEEK_END)
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+            raise
+        # keep the old handle open across the swap: if the reopen fails the
+        # store keeps operating on the old (now unlinked) inode, and the
+        # compacted file on disk holds the same live records
+        self.f.seek(0, os.SEEK_END)
+        os.replace(tmp_path, self.path)
+        new_f = open(self.path, "r+b")
+        new_f.seek(0, os.SEEK_END)
+        self.f.close()
+        self.f = new_f
+        self.index = new_index
+        self.wasted = 0
 
     def close(self):
         self.f.close()

@@ -65,6 +65,7 @@ struct Entry {
 
 struct Store {
   FILE* f = nullptr;
+  std::string path;
   std::map<std::string, Entry> index;
   std::string error;
   uint64_t wasted = 0;  // bytes superseded by later writes (compaction cue)
@@ -129,6 +130,7 @@ extern "C" {
 
 void* lasp_store_open(const char* path) {
   Store* s = new Store();
+  s->path = path;
   s->f = fopen(path, "r+b");
   if (!s->f) {
     s->f = fopen(path, "w+b");
@@ -218,21 +220,88 @@ uint64_t lasp_store_wasted(void* handle) {
   return static_cast<Store*>(handle)->wasted;
 }
 
-// iterate keys: fills out with \n-joined keys (caller sizes via keys_len)
+// iterate keys, length-prefixed (u32 len | key bytes, repeated) so keys
+// may contain any byte — a '\n'-joined listing would corrupt on such keys
 uint64_t lasp_store_keys_len(void* handle) {
   Store* s = static_cast<Store*>(handle);
   uint64_t n = 0;
-  for (auto& kv : s->index) n += kv.first.size() + 1;
+  for (auto& kv : s->index) n += 4 + kv.first.size();
   return n;
 }
 
 void lasp_store_keys(void* handle, char* out) {
   Store* s = static_cast<Store*>(handle);
   for (auto& kv : s->index) {
+    uint32_t len = static_cast<uint32_t>(kv.first.size());
+    memcpy(out, &len, 4);
+    out += 4;
     memcpy(out, kv.first.data(), kv.first.size());
     out += kv.first.size();
-    *out++ = '\n';
   }
+}
+
+// rewrite live records into a fresh log and swap it in: reclaims the
+// `wasted` bytes of superseded/tombstoned records (the compaction the
+// reference's waste_pct stat cues, src/lasp_orset.erl:178-191).
+// Returns 0 on success; on failure the original log is left untouched.
+int lasp_store_compact(void* handle) {
+  Store* s = static_cast<Store*>(handle);
+  std::string tmp = s->path + ".compact";
+  FILE* out = fopen(tmp.c_str(), "w+b");
+  if (!out) return -1;
+  fwrite(&kFileMagic, 4, 1, out);
+  fwrite(&kVersion, 4, 1, out);
+  std::map<std::string, Entry> new_index;
+  std::vector<uint8_t> buf;
+  for (auto& kv : s->index) {
+    buf.resize(kv.second.len);
+    fseek(s->f, static_cast<long>(kv.second.offset), SEEK_SET);
+    if (fread(buf.data(), 1, kv.second.len, s->f) != kv.second.len) {
+      fclose(out);
+      remove(tmp.c_str());
+      fseek(s->f, 0, SEEK_END);  // restore the append-position invariant
+      return -2;
+    }
+    long pos = ftell(out);
+    uint32_t key_len = static_cast<uint32_t>(kv.first.size());
+    uint64_t val_len = kv.second.len;
+    uint32_t state = crc32_update(
+        0xFFFFFFFFu, reinterpret_cast<const uint8_t*>(kv.first.data()), key_len);
+    state = crc32_update(state, buf.data(), val_len);
+    uint32_t crc = ~state;
+    fwrite(&kRecMagic, 4, 1, out);
+    fwrite(&key_len, 4, 1, out);
+    fwrite(&val_len, 8, 1, out);
+    fwrite(kv.first.data(), 1, key_len, out);
+    if (val_len) fwrite(buf.data(), 1, val_len, out);
+    fwrite(&crc, 4, 1, out);
+    new_index[kv.first] = Entry{static_cast<uint64_t>(pos) + 16 + key_len, val_len};
+  }
+  if (fflush(out) != 0) {
+    fclose(out);
+    remove(tmp.c_str());
+    fseek(s->f, 0, SEEK_END);
+    return -3;
+  }
+  fclose(out);
+  // every error path below leaves the handle fully usable on the OLD
+  // file/index (positioned at end for appends); the old FILE* stays open
+  // across the rename — on POSIX it keeps the original (possibly now
+  // unlinked) inode alive, and the compacted file holds the same live
+  // records, so either outcome is consistent
+  fseek(s->f, 0, SEEK_END);
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+    remove(tmp.c_str());
+    return -4;
+  }
+  FILE* nf = fopen(s->path.c_str(), "r+b");
+  if (!nf) return -5;  // keep operating on the old (unlinked) inode
+  fseek(nf, 0, SEEK_END);
+  fclose(s->f);
+  s->f = nf;
+  s->index = std::move(new_index);
+  s->wasted = 0;
+  return 0;
 }
 
 void lasp_store_close(void* handle) {

@@ -204,3 +204,149 @@ def test_runtime_checkpoint_roundtrip(tmp_path):
     rt2.update_at(2, "src", ("add", 5), "a")
     rt2.run_to_convergence(max_rounds=16)
     assert rt2.coverage_value("out") == frozenset({6, 10})
+
+
+# -- round-1 ADVICE tail -----------------------------------------------------
+
+def test_manifest_unpickler_refuses_arbitrary_globals(tmp_path):
+    """A checkpoint is untrusted input: a manifest whose pickle references
+    os.system (or any non-lasp_tpu global) must be refused, not executed."""
+    import pickle
+
+    import pytest
+
+    from lasp_tpu.store import HostStore
+    from lasp_tpu.store.checkpoint import load_store, loads_manifest
+
+    class Evil:
+        def __reduce__(self):
+            import os
+
+            return (os.system, ("true",))
+
+    payload = pickle.dumps({"kind": "store", "vars": {}, "bomb": Evil()})
+    with pytest.raises(pickle.UnpicklingError, match="may not reference"):
+        loads_manifest(payload)
+
+    path = str(tmp_path / "evil.lasp")
+    with HostStore(path) as hs:
+        hs.put("manifest", payload)
+    with pytest.raises(pickle.UnpicklingError):
+        load_store(path)
+
+
+def test_manifest_unpickler_accepts_real_checkpoints(tmp_path):
+    from lasp_tpu.store import Store
+    from lasp_tpu.store.checkpoint import load_store, save_store
+
+    store = Store(n_actors=2)
+    store.declare(id="s", type="lasp_orset", n_elems=4)
+    store.update("s", ("add", "x"), "w")
+    path = str(tmp_path / "ok.lasp")
+    save_store(store, path)
+    assert load_store(path).value("s") == {"x"}
+
+
+def test_host_store_keys_with_newlines_and_any_bytes(tmp_path):
+    from lasp_tpu.store import HostStore
+
+    path = str(tmp_path / "keys.lasp")
+    weird = ["plain", "with\nnewline", "tab\tand\x00nul-ish ☃"]
+    with HostStore(path) as hs:
+        for i, k in enumerate(weird):
+            hs.put(k, f"v{i}".encode())
+        assert sorted(hs.keys()) == sorted(weird)
+        for i, k in enumerate(weird):
+            assert hs.get(k) == f"v{i}".encode()
+
+
+def test_host_store_compact_reclaims_waste(tmp_path):
+    import os
+
+    from lasp_tpu.store import HostStore
+
+    path = str(tmp_path / "c.lasp")
+    with HostStore(path) as hs:
+        for i in range(50):
+            hs.put("hot", b"x" * 1000)  # 49 superseded records
+        hs.put("cold", b"y" * 100)
+        hs.put("gone", b"z" * 500)
+        hs.delete("gone")
+        before = os.path.getsize(path)
+        assert hs.stats()["wasted_bytes"] > 0
+        hs.compact()
+        assert hs.stats()["wasted_bytes"] == 0
+        assert hs.get("hot") == b"x" * 1000
+        assert hs.get("cold") == b"y" * 100
+        assert hs.get("gone") is None
+        # writes after compaction land fine
+        hs.put("new", b"n")
+    after = os.path.getsize(path)
+    assert after < before // 10
+    # reopen: the compacted log scans clean
+    with HostStore(path) as hs:
+        assert sorted(hs.keys()) == ["cold", "hot", "new"]
+        assert hs.get("hot") == b"x" * 1000
+
+
+def test_cli_simulate_rejects_unsupported_type(capsys):
+    import pytest
+
+    from lasp_tpu.cli import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["simulate", "--type", "riak_dt_gcounter", "--replicas", "8"])
+    assert exc.value.code == 2
+
+
+def test_pylog_fallback_compact_and_keys(tmp_path):
+    """The pure-Python fallback log must behave identically to the native
+    engine (same on-disk format, same compaction/keys semantics)."""
+    import os
+
+    from lasp_tpu.store.host_store import _PyLog
+
+    path = str(tmp_path / "py.lasp")
+    log = _PyLog(path)
+    for i in range(30):
+        log.put(b"hot", b"x" * 1000)
+    log.put(b"with\nnewline", b"v")
+    log.put(b"gone", b"z" * 100)
+    log.delete(b"gone")
+    assert log.wasted > 0
+    before = os.path.getsize(path)
+    log.compact()
+    assert log.wasted == 0
+    assert log.get(b"hot") == b"x" * 1000
+    assert log.get(b"with\nnewline") == b"v"
+    assert log.get(b"gone") is None
+    assert os.path.getsize(path) < before // 5
+    log.put(b"new", b"n")
+    log.close()
+    log2 = _PyLog(path)
+    assert sorted(log2.index) == [b"hot", b"new", b"with\nnewline"]
+    assert log2.get(b"new") == b"n"
+    log2.close()
+
+
+def test_runtime_checkpoint_round_trips_packed_mode(tmp_path):
+    """save_runtime must persist the packed flag: restoring a packed
+    runtime into dense templates mis-shapes every OR-Set state."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+    from lasp_tpu.store.checkpoint import load_runtime, save_runtime
+
+    store = Store(n_actors=2)
+    store.declare(id="s", type="lasp_orset", n_elems=4)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 1), packed=True)
+    rt.update_batch("s", [(0, ("add", "x"), "w")])
+    rt.run_to_convergence()
+    path = str(tmp_path / "packed.lasp")
+    save_runtime(rt, path)
+    rt2 = load_runtime(path)
+    assert rt2.packed
+    assert rt2.coverage_value("s") == {"x"}
+    rt2.update_batch("s", [(1, ("add", "y"), "w")])
+    rt2.run_to_convergence()
+    assert rt2.coverage_value("s") == {"x", "y"}
