@@ -160,6 +160,10 @@ class Session:
         """``lasp:process/4`` (``src/lasp.erl:129-150``): notify every
         registered program of an object event (the riak_kv put/delete/
         handoff hook path)."""
-        for program in self.programs.values():
+        # snapshot: a program may register NEW programs while processing
+        # (the index program auto-creates views, src/lasp_riak_index_
+        # program.erl:162-176); like the reference's async create_views,
+        # a view registered by this event first sees the NEXT event
+        for program in list(self.programs.values()):
             program.process(self, object, reason, actor)
         self._maybe_propagate()

@@ -1,0 +1,10 @@
+"""Erlang↔Python bridge (SURVEY.md §7 stage 6 — the north-star
+integration): a protocol server exposing the TPU store to a BEAM node's
+``lasp_backend`` behaviour over ``{packet, 4}`` + External Term Format,
+plus the Python reference client the conformance tests drive. The
+BEAM-side adapter ships as ``erlang/lasp_tpu_backend.erl``."""
+
+from .etf import Atom, decode, encode
+from .server import BridgeClient, BridgeServer
+
+__all__ = ["Atom", "BridgeClient", "BridgeServer", "decode", "encode"]

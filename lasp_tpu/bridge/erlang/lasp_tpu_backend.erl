@@ -1,0 +1,82 @@
+%% lasp_tpu_backend: delegate the lasp storage backend to the TPU store.
+%%
+%% Implements the `lasp_backend' behaviour (reference contract
+%% src/lasp_backend.erl:26-28: start/1, put/3, get/2) against the bridge
+%% server shipped in lasp_tpu.bridge.server, sitting beside
+%% lasp_ets_backend / lasp_eleveldb_backend as a fourth engine. Select it
+%% the way the reference selects engines (the ?BACKEND macro,
+%% include/lasp.hrl:8-23).
+%%
+%% Wire format: {packet, 4} framing, term_to_binary/binary_to_term
+%% payloads — the server speaks External Term Format natively (see
+%% lasp_tpu/bridge/etf.py). Request/response terms are documented in
+%% lasp_tpu/bridge/server.py; this module only needs the three behaviour
+%% calls plus the batched merge used by anti-entropy.
+%%
+%% NOTE: this image ships no BEAM, so this file is compiled and exercised
+%% only on a real Erlang node; the loopback conformance tests in
+%% tests/bridge/ drive the server with byte-identical frames from Python.
+
+-module(lasp_tpu_backend).
+-author("lasp-tpu").
+
+-export([start/1,
+         put/3,
+         get/2,
+         merge_batch/2]).
+
+-define(HOST, case os:getenv("LASP_TPU_BRIDGE_HOST") of
+                  false -> "127.0.0.1";
+                  H -> H
+              end).
+-define(PORT, case os:getenv("LASP_TPU_BRIDGE_PORT") of
+                  false -> 9190;
+                  P -> list_to_integer(P)
+              end).
+
+%% @doc Start the backend: open one connection per store (= per vnode,
+%%      mirroring one ets table per partition) and issue {start, Name}.
+start(Identifier) ->
+    case gen_tcp:connect(?HOST, ?PORT,
+                         [binary, {packet, 4}, {active, false}]) of
+        {ok, Socket} ->
+            case call(Socket, {start, Identifier}) of
+                {ok, _} -> {ok, Socket};
+                _ -> {error, bridge_start_failed}
+            end;
+        {error, Reason} ->
+            {error, Reason}
+    end.
+
+%% @doc Blind KV write (the ets:insert role, src/lasp_ets_backend.erl:
+%%      49-51): the caller (lasp_core) has already merged and gated.
+%%      Variable is the #dv record; we ship its type + portable value.
+put(Socket, Id, {Type, Portable, Caps}) ->
+    case call(Socket, {put, Id, {Type, Portable, Caps}}) of
+        ok -> ok;
+        Other -> {error, Other}
+    end.
+
+%% @doc Fetch a variable; {error, not_found} when absent.
+get(Socket, Id) ->
+    case call(Socket, {get, Id}) of
+        {ok, {Type, Portable}} -> {ok, {Type, Portable}};
+        {error, not_found} -> {error, not_found};
+        Other -> {error, Other}
+    end.
+
+%% @doc Batched anti-entropy: ship many {Id, PortableState} pairs; the
+%%      server merges each through the inflation gate in one round-trip
+%%      (the read-repair finalize of src/lasp_update_fsm.erl:189-216,
+%%      amortized).
+merge_batch(Socket, Items) ->
+    call(Socket, {merge_batch, Items}).
+
+%% internal
+
+call(Socket, Term) ->
+    ok = gen_tcp:send(Socket, term_to_binary(Term)),
+    case gen_tcp:recv(Socket, 0, 60000) of
+        {ok, Bin} -> binary_to_term(Bin);
+        {error, Reason} -> {error, Reason}
+    end.

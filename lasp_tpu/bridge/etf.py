@@ -1,0 +1,233 @@
+"""Erlang External Term Format (ETF) codec — the bridge's wire encoding.
+
+The north-star integration (SURVEY.md §7 stage 6) is an Erlang node
+delegating its ``lasp_backend`` behaviour (``src/lasp_backend.erl:26-28``:
+``start/put/get``) to this framework's store. The cheapest possible BEAM
+side is ``gen_tcp`` with ``{packet, 4}`` framing and
+``term_to_binary``/``binary_to_term`` — which makes the Python side's job
+speaking ETF. This module implements the subset of ETF the bridge
+protocol uses (integers incl. bignums, floats, atoms, binaries, lists,
+tuples, maps), against the published format (external term format tag
+131; tag bytes per the Erlang distribution protocol docs).
+
+Atoms decode to :class:`Atom` (interned-string wrapper) so round-trips
+preserve the atom/binary/string distinction Erlang cares about.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+_VERSION = 131
+_NEW_FLOAT = 70
+_SMALL_INT = 97
+_INT = 98
+_SMALL_BIG = 110
+_LARGE_BIG = 111
+_ATOM_UTF8 = 118
+_SMALL_ATOM_UTF8 = 119
+_ATOM_OLD = 100  # ATOM_EXT (deprecated but still emitted by old nodes)
+_BINARY = 109
+_STRING = 107
+_LIST = 108
+_NIL = 106
+_SMALL_TUPLE = 104
+_LARGE_TUPLE = 105
+_MAP = 116
+
+
+class Atom(str):
+    """An Erlang atom. Subclasses ``str`` so ``Atom("ok") == "ok"`` for
+    ergonomic matching, while ``encode`` emits an atom, not a binary."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Atom({str.__repr__(self)})"
+
+
+#: the protocol's common atoms, pre-made
+OK = Atom("ok")
+ERROR = Atom("error")
+UNDEFINED = Atom("undefined")
+
+
+class ETFDecodeError(ValueError):
+    pass
+
+
+def encode(term: Any) -> bytes:
+    """Python term -> ``term_to_binary`` bytes."""
+    out = bytearray([_VERSION])
+    _enc(term, out)
+    return bytes(out)
+
+
+def _enc(t: Any, out: bytearray) -> None:
+    if isinstance(t, Atom):
+        raw = t.encode("utf-8")
+        if len(raw) < 256:
+            out.append(_SMALL_ATOM_UTF8)
+            out.append(len(raw))
+        else:
+            out.append(_ATOM_UTF8)
+            out += struct.pack(">H", len(raw))
+        out += raw
+    elif isinstance(t, bool):
+        _enc(Atom("true") if t else Atom("false"), out)
+    elif isinstance(t, int):
+        if 0 <= t <= 255:
+            out.append(_SMALL_INT)
+            out.append(t)
+        elif -(1 << 31) <= t < (1 << 31):
+            out.append(_INT)
+            out += struct.pack(">i", t)
+        else:
+            sign = 1 if t < 0 else 0
+            mag = -t if sign else t
+            nbytes = (mag.bit_length() + 7) // 8
+            if nbytes < 256:
+                out.append(_SMALL_BIG)
+                out.append(nbytes)
+            else:
+                out.append(_LARGE_BIG)
+                out += struct.pack(">I", nbytes)
+            out.append(sign)
+            out += mag.to_bytes(nbytes, "little")
+    elif isinstance(t, float):
+        out.append(_NEW_FLOAT)
+        out += struct.pack(">d", t)
+    elif isinstance(t, (bytes, bytearray)):
+        out.append(_BINARY)
+        out += struct.pack(">I", len(t))
+        out += t
+    elif isinstance(t, str):
+        # plain str crosses as a binary (Elixir convention); use Atom for
+        # atoms. The Erlang side reads these with binary pattern matches.
+        _enc(t.encode("utf-8"), out)
+    elif isinstance(t, tuple):
+        if len(t) < 256:
+            out.append(_SMALL_TUPLE)
+            out.append(len(t))
+        else:
+            out.append(_LARGE_TUPLE)
+            out += struct.pack(">I", len(t))
+        for x in t:
+            _enc(x, out)
+    elif isinstance(t, list):
+        if not t:
+            out.append(_NIL)
+        else:
+            out.append(_LIST)
+            out += struct.pack(">I", len(t))
+            for x in t:
+                _enc(x, out)
+            out.append(_NIL)
+    elif isinstance(t, dict):
+        out.append(_MAP)
+        out += struct.pack(">I", len(t))
+        for k, v in t.items():
+            _enc(k, out)
+            _enc(v, out)
+    elif t is None:
+        _enc(UNDEFINED, out)
+    else:
+        raise TypeError(f"cannot encode {type(t).__name__} as ETF: {t!r}")
+
+
+def decode(data: bytes) -> Any:
+    """``term_to_binary`` bytes -> Python term."""
+    if not data or data[0] != _VERSION:
+        raise ETFDecodeError("missing ETF version byte")
+    try:
+        term, off = _dec(data, 1)
+    except (struct.error, IndexError, UnicodeDecodeError) as e:
+        # malformed frames must surface as ETFDecodeError, never leak the
+        # parser's internal exceptions (the server's error-term contract)
+        raise ETFDecodeError(f"malformed term: {e}") from e
+    if off != len(data):
+        raise ETFDecodeError(f"trailing bytes after term ({len(data) - off})")
+    return term
+
+
+def _dec(b: bytes, off: int):
+    try:
+        tag = b[off]
+    except IndexError as e:
+        raise ETFDecodeError("truncated term") from e
+    off += 1
+    if tag == _SMALL_INT:
+        return b[off], off + 1
+    if tag == _INT:
+        return struct.unpack_from(">i", b, off)[0], off + 4
+    if tag in (_SMALL_BIG, _LARGE_BIG):
+        if tag == _SMALL_BIG:
+            n, off = b[off], off + 1
+        else:
+            (n,) = struct.unpack_from(">I", b, off)
+            off += 4
+        sign = b[off]
+        off += 1
+        mag = int.from_bytes(b[off : off + n], "little")
+        return (-mag if sign else mag), off + n
+    if tag == _NEW_FLOAT:
+        return struct.unpack_from(">d", b, off)[0], off + 8
+    if tag in (_SMALL_ATOM_UTF8, _ATOM_UTF8, _ATOM_OLD):
+        if tag == _SMALL_ATOM_UTF8:
+            n, off = b[off], off + 1
+        else:
+            (n,) = struct.unpack_from(">H", b, off)
+            off += 2
+        name = b[off : off + n].decode("utf-8")
+        off += n
+        if name == "undefined":
+            return None, off
+        if name == "true":
+            return True, off
+        if name == "false":
+            return False, off
+        return Atom(name), off
+    if tag == _BINARY:
+        (n,) = struct.unpack_from(">I", b, off)
+        off += 4
+        return b[off : off + n], off + n
+    if tag == _STRING:
+        # an Erlang list of bytes; surfaces as list[int] like LIST would
+        (n,) = struct.unpack_from(">H", b, off)
+        off += 2
+        return list(b[off : off + n]), off + n
+    if tag == _NIL:
+        return [], off
+    if tag == _LIST:
+        (n,) = struct.unpack_from(">I", b, off)
+        off += 4
+        items = []
+        for _ in range(n):
+            x, off = _dec(b, off)
+            items.append(x)
+        tail, off = _dec(b, off)
+        if tail != []:
+            raise ETFDecodeError("improper list")
+        return items, off
+    if tag in (_SMALL_TUPLE, _LARGE_TUPLE):
+        if tag == _SMALL_TUPLE:
+            n, off = b[off], off + 1
+        else:
+            (n,) = struct.unpack_from(">I", b, off)
+            off += 4
+        items = []
+        for _ in range(n):
+            x, off = _dec(b, off)
+            items.append(x)
+        return tuple(items), off
+    if tag == _MAP:
+        (n,) = struct.unpack_from(">I", b, off)
+        off += 4
+        d = {}
+        for _ in range(n):
+            k, off = _dec(b, off)
+            v, off = _dec(b, off)
+            d[k] = v
+        return d, off
+    raise ETFDecodeError(f"unsupported ETF tag {tag}")

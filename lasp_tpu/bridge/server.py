@@ -1,0 +1,405 @@
+"""Bridge server: the BEAM-facing face of the TPU store.
+
+North-star integration (SURVEY.md §7 stage 6; ``BASELINE.json``): an
+Erlang Lasp node swaps its storage backend for this framework by pointing
+the ``lasp_backend`` behaviour (``src/lasp_backend.erl:26-28`` —
+``start/1, put/3, get/2``) at this server. The shipped BEAM-side adapter
+is ``lasp_tpu/bridge/erlang/lasp_tpu_backend.erl``; its entire job is
+``gen_tcp`` with ``{packet, 4}`` framing plus ``term_to_binary`` /
+``binary_to_term``, which is exactly what this server speaks (see
+``lasp_tpu.bridge.etf``).
+
+WHAT IS SIMULATED (this image ships no BEAM): the conformance tests in
+``tests/bridge/`` drive the protocol loopback from a Python
+:class:`BridgeClient` that emits byte-identical frames to the Erlang
+adapter (same framing, same ETF terms). The Erlang file itself cannot be
+compiled here; it is the thin, documented contract for a real node.
+
+Protocol — one request term per frame, one response term per frame:
+
+==================================================  =========================
+request                                             response
+==================================================  =========================
+``{start, Name}``                                   ``{ok, Name}``
+``{declare, Id, Type, CapsMap}``                    ``{ok, Id}``
+``{put, Id, {Type, State, CapsMap}}``               ``ok``           (blind KV write: the reference backend contract, ets:insert semantics)
+``{get, Id}``                                       ``{ok, {Type, State}}`` | ``{error, not_found}``
+``{update, Id, Op, Actor}``                         ``{ok, Value}``
+``{bind, Id, State}``                               ``{ok, Value}``  (merge + inflation gate, src/lasp_core.erl:291-312)
+``{merge_batch, [{Id, State}, ...]}``               ``{ok, Count}``  (the batched anti-entropy RPC)
+``{read, Id}``                                      ``{ok, Value}``
+``{keys}``                                          ``{ok, [Id...]}``
+==================================================  =========================
+
+Portable CRDT state encodings (id/elem/actor terms are arbitrary ETF
+terms; tokens are integers into the declared token space):
+
+- ``lasp_gset``: ``[Elem, ...]``
+- ``lasp_orset`` / ``lasp_orset_gbtree``:
+  ``[{Elem, [{Token, Deleted}, ...]}, ...]``  (the orddict-of-orddicts
+  shape of ``src/lasp_orset.erl:42-45``, tokens dense)
+- ``riak_dt_gcounter``: ``[{Actor, Count}, ...]``
+- ``lasp_ivar``: ``undefined`` | ``{value, Term}``
+
+Every connection owns an isolated :class:`~lasp_tpu.store.Store` (the
+per-vnode store of the reference; one vnode holds one connection).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ..store import Store
+from . import etf
+from .etf import Atom
+
+_HDR = struct.Struct(">I")
+
+#: declare caps accepted over the wire, per type (mirrors store.ALLOWED_CAPS)
+_CAP_KEYS = ("n_elems", "n_actors", "tokens_per_actor")
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = _HDR.unpack(hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _to_key(term: Any) -> Any:
+    """ETF terms used as ids/elems/actors must be hashable: lists (the one
+    unhashable ETF shape) become tuples, recursively."""
+    if isinstance(term, list):
+        return tuple(_to_key(x) for x in term)
+    if isinstance(term, tuple):
+        return tuple(_to_key(x) for x in term)
+    return term
+
+
+# ---------------------------------------------------------------------------
+# portable-state import/export
+# ---------------------------------------------------------------------------
+
+def _export_state(var) -> Any:
+    import jax
+
+    tn = var.type_name
+    state, spec = var.state, var.spec
+    if tn == "lasp_gset":
+        mask = np.asarray(state.mask)
+        return [var.elems.terms()[i] for i in np.flatnonzero(mask)]
+    if tn in ("lasp_orset", "lasp_orset_gbtree"):
+        exists = np.asarray(state.exists)
+        removed = np.asarray(state.removed)
+        out = []
+        for e in np.flatnonzero(exists.any(axis=-1)):
+            toks = [
+                (int(t), bool(removed[e, t]))
+                for t in np.flatnonzero(exists[e])
+            ]
+            out.append((var.elems.terms()[int(e)], toks))
+        return out
+    if tn == "riak_dt_gcounter":
+        counts = np.asarray(state.counts)
+        return [
+            (a, int(counts[i]))
+            for i, a in enumerate(var.actors.terms())
+            if counts[i]
+        ]
+    if tn == "lasp_ivar":
+        if not bool(np.asarray(state.defined)):
+            return None
+        return (Atom("value"), var.ivar_payloads.terms()[int(state.value)])
+    raise ValueError(f"bridge: unsupported type {tn!r}")
+
+
+def _import_state(var, portable: Any):
+    import jax.numpy as jnp
+
+    tn = var.type_name
+    spec = var.spec
+    state = var.codec.new(spec)
+    if tn == "lasp_gset":
+        idx = [var.elems.intern(_to_key(e)) for e in (portable or [])]
+        if idx:
+            state = state._replace(
+                mask=state.mask.at[jnp.asarray(idx)].set(True)
+            )
+        return state
+    if tn in ("lasp_orset", "lasp_orset_gbtree"):
+        ex = np.zeros((spec.n_elems, spec.n_tokens), dtype=bool)
+        rm = np.zeros_like(ex)
+        for elem, toks in portable or []:
+            e = var.elems.intern(_to_key(elem))
+            for tok, deleted in toks:
+                tok = int(tok)
+                if not 0 <= tok < spec.n_tokens:
+                    raise ValueError(
+                        f"token {tok} outside token space {spec.n_tokens}"
+                    )
+                ex[e, tok] = True
+                rm[e, tok] = bool(deleted)
+        return state._replace(exists=jnp.asarray(ex), removed=jnp.asarray(rm))
+    if tn == "riak_dt_gcounter":
+        counts = np.zeros((spec.n_actors,), dtype=np.asarray(state.counts).dtype)
+        for actor, count in portable or []:
+            counts[var.actors.intern(_to_key(actor))] = int(count)
+        return state._replace(counts=jnp.asarray(counts))
+    if tn == "lasp_ivar":
+        if portable is None:
+            return state
+        tag, value = portable
+        return var.codec.set(
+            spec, state, var.ivar_payloads.intern(_to_key(value))
+        )
+    raise ValueError(f"bridge: unsupported type {tn!r}")
+
+
+def _export_value(store: Store, var_id) -> Any:
+    v = store.value(var_id)
+    if isinstance(v, frozenset) or isinstance(v, set):
+        return sorted(v, key=lambda t: etf.encode(t))
+    return v
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class _Conn:
+    """One connection = one vnode's store."""
+
+    def __init__(self, n_actors: int):
+        self.n_actors = n_actors
+        self.store: Optional[Store] = None
+
+    def handle(self, req: Any) -> Any:
+        if not isinstance(req, tuple) or not req:
+            return (etf.ERROR, Atom("badarg"), b"request must be a tuple")
+        verb = req[0]
+        if verb == "start":
+            self.store = Store(n_actors=self.n_actors)
+            return (etf.OK, req[1] if len(req) > 1 else Atom("store"))
+        if self.store is None:
+            return (etf.ERROR, Atom("not_started"), b"send {start, Name} first")
+        try:
+            return self._dispatch(verb, req)
+        except KeyError as e:
+            return (etf.ERROR, Atom("not_found"), repr(e).encode())
+        except Exception as e:  # surface as an error term, keep serving
+            return (etf.ERROR, Atom(type(e).__name__), str(e).encode())
+
+    def _dispatch(self, verb: str, req: tuple) -> Any:
+        store = self.store
+        if verb == "declare":
+            _, var_id, type_atom, caps = req
+            var_id = _to_key(var_id)
+            kwargs = {
+                str(k): int(v)
+                for k, v in (caps or {}).items()
+                if str(k) in _CAP_KEYS
+            }
+            if var_id not in store.ids():
+                store.declare(id=var_id, type=str(type_atom), **kwargs)
+            return (etf.OK, var_id)
+        if verb == "put":
+            _, var_id, payload = req
+            var_id = _to_key(var_id)
+            type_atom, portable, caps = payload
+            kwargs = {
+                str(k): int(v)
+                for k, v in (caps or {}).items()
+                if str(k) in _CAP_KEYS
+            }
+            if var_id not in store.ids():
+                store.declare(id=var_id, type=str(type_atom), **kwargs)
+            var = store.variable(var_id)
+            # the backend contract is a blind KV write (ets:insert role,
+            # src/lasp_ets_backend.erl:49-51): the CALLER did the merge
+            var.state = _import_state(var, portable)
+            return etf.OK
+        if verb == "get":
+            _, var_id = req
+            var_id = _to_key(var_id)
+            if var_id not in store.ids():
+                return (etf.ERROR, Atom("not_found"))
+            var = store.variable(var_id)
+            return (etf.OK, (Atom(var.type_name), _export_state(var)))
+        if verb == "update":
+            _, var_id, op, actor = req
+            var_id = _to_key(var_id)
+            op = tuple(
+                [str(op[0])] + [_to_key(x) for x in op[1:]]
+            ) if isinstance(op, tuple) else (str(op),)
+            store.update(var_id, op, _to_key(actor))
+            return (etf.OK, _export_value(store, var_id))
+        if verb == "bind":
+            _, var_id, portable = req
+            var_id = _to_key(var_id)
+            var = store.variable(var_id)
+            # merge + inflation gate (src/lasp_core.erl:291-312)
+            store.bind(var_id, _import_state(var, portable))
+            return (etf.OK, _export_value(store, var_id))
+        if verb == "merge_batch":
+            _, items = req
+            for var_id, portable in items:
+                var_id = _to_key(var_id)
+                var = store.variable(var_id)
+                store.bind(var_id, _import_state(var, portable))
+            return (etf.OK, len(items))
+        if verb == "read":
+            _, var_id = req
+            return (etf.OK, _export_value(store, _to_key(var_id)))
+        if verb == "keys":
+            return (etf.OK, [k for k in self.store.ids()])
+        return (etf.ERROR, Atom("badarg"), f"unknown verb {verb}".encode())
+
+
+class BridgeServer:
+    """Loopback TCP server speaking the bridge protocol. ``port=0`` picks
+    a free port (read it from :attr:`port` after :meth:`start`)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 n_actors: int = 16):
+        self.host = host
+        self.port = port
+        self.n_actors = n_actors
+        self._sock: Optional[socket.socket] = None
+        self._threads: list[threading.Thread] = []
+        self._stop = threading.Event()
+
+    def start(self) -> int:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((self.host, self.port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self.port
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            # daemon threads, never joined: retaining them would leak one
+            # Thread object per connection on a long-lived server
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        state = _Conn(self.n_actors)
+        with sock:
+            while not self._stop.is_set():
+                try:
+                    frame = _recv_frame(sock)
+                except OSError:
+                    break
+                if frame is None:
+                    break
+                try:
+                    req = etf.decode(frame)
+                    resp = state.handle(req)
+                except etf.ETFDecodeError as e:
+                    resp = (etf.ERROR, Atom("etf_decode"), str(e).encode())
+                try:
+                    _send_frame(sock, etf.encode(resp))
+                except OSError:
+                    break
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class BridgeClient:
+    """Python reference client — emits byte-identical frames to the
+    Erlang adapter (``lasp_tpu_backend.erl``). Used by the conformance
+    tests; also handy as an ops tool against a live server."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+
+    def call(self, term: Any) -> Any:
+        _send_frame(self._sock, etf.encode(term))
+        frame = _recv_frame(self._sock)
+        if frame is None:
+            raise ConnectionError("bridge server closed the connection")
+        return etf.decode(frame)
+
+    # convenience verbs mirroring lasp_tpu_backend.erl
+    def start(self, name: str = "store"):
+        return self.call((Atom("start"), Atom(name)))
+
+    def declare(self, var_id, type_name: str, **caps):
+        return self.call(
+            (Atom("declare"), var_id, Atom(type_name),
+             {Atom(k): v for k, v in caps.items()})
+        )
+
+    def put(self, var_id, type_name: str, state, **caps):
+        return self.call(
+            (Atom("put"), var_id,
+             (Atom(type_name), state, {Atom(k): v for k, v in caps.items()}))
+        )
+
+    def get(self, var_id):
+        return self.call((Atom("get"), var_id))
+
+    def update(self, var_id, op: tuple, actor):
+        return self.call((Atom("update"), var_id, tuple(op), actor))
+
+    def bind(self, var_id, state):
+        return self.call((Atom("bind"), var_id, state))
+
+    def merge_batch(self, items):
+        return self.call((Atom("merge_batch"), list(items)))
+
+    def read(self, var_id):
+        return self.call((Atom("read"), var_id))
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -634,10 +634,11 @@ class ReplicatedRuntime:
         if block > 1:
             rounds = 0
             while rounds < max_rounds:
-                first_zero = self.fused_steps(block, edge_mask)
+                b = min(block, max_rounds - rounds)  # never overshoot
+                first_zero = self.fused_steps(b, edge_mask)
                 if first_zero >= 0:
                     return rounds + first_zero + 1
-                rounds += block
+                rounds += b
             raise RuntimeError(f"no convergence within {max_rounds} rounds")
         for i in range(max_rounds):
             if self.step(edge_mask) == 0:
@@ -756,6 +757,64 @@ class ReplicatedRuntime:
         raise TimeoutError(
             f"threshold not met at replica {replica} within {max_rounds} rounds"
         )
+
+    # -- elastic membership ---------------------------------------------------
+    def resize(self, new_n: int, new_neighbors, graceful: bool = True) -> None:
+        """Grow or shrink the replica population mid-run — the rebuild of
+        riak_core staged membership (``src/lasp_console.erl:31-94``:
+        staged_join / leave / down + plan/commit).
+
+        Join (``new_n > n_replicas``): new rows start at the lattice BOTTOM
+        and catch up by gossip over the new topology — exactly how a fresh
+        vnode is reconstructed by read-repair in the reference (handoff is
+        stubbed there, ``src/lasp_vnode.erl:454-472``).
+
+        Leave (``new_n < n_replicas``): with ``graceful=True`` the join of
+        the departing rows is merged into surviving row 0 first (the
+        staged-leave handoff: no acknowledged write may be lost even if it
+        never gossiped), then the rows are dropped. ``graceful=False``
+        models crash/``down``: departing state is simply lost unless it
+        already gossiped — the reference's failure semantics.
+
+        The topology must be re-supplied (``new_neighbors: int[new_n, K]``)
+        because neighbor indices are population-relative. The compiled step
+        is invalidated (shapes changed); the next step re-jits."""
+        new_neighbors = np.asarray(new_neighbors)
+        if new_neighbors.ndim != 2 or new_neighbors.shape[0] != new_n:
+            raise ValueError(
+                f"new_neighbors must be [new_n={new_n}, K], "
+                f"got {new_neighbors.shape}"
+            )
+        if new_neighbors.size and (
+            new_neighbors.min() < 0 or new_neighbors.max() >= new_n
+        ):
+            raise ValueError("new_neighbors indices out of range")
+        old_n = self.n_replicas
+        for v in self.var_ids:
+            codec, spec = self._mesh_meta(v)
+            st = self.states[v]
+            if new_n < old_n:
+                head = jax.tree_util.tree_map(lambda x: x[:new_n], st)
+                if graceful:
+                    tail = jax.tree_util.tree_map(lambda x: x[new_n:], st)
+                    handoff = join_all(codec, spec, tail)
+                    row0 = jax.tree_util.tree_map(lambda x: x[0], head)
+                    merged = codec.merge(spec, row0, handoff)
+                    head = jax.tree_util.tree_map(
+                        lambda x, r: x.at[0].set(r), head, merged
+                    )
+                self.states[v] = head
+            elif new_n > old_n:
+                # _mesh_meta already resolves packed vars to (FlatORSet,
+                # packed_spec), so codec.new is the right bottom either way
+                fresh = replicate(codec.new(spec), new_n - old_n)
+                self.states[v] = jax.tree_util.tree_map(
+                    lambda a, b: jnp.concatenate([a, b], axis=0), st, fresh
+                )
+        self.n_replicas = new_n
+        self.neighbors = jnp.asarray(new_neighbors)
+        self._step = None
+        self._fused_steps_cache.clear()
 
     # -- sharding -------------------------------------------------------------
     def shard(self, mesh: jax.sharding.Mesh, axis: str = "replicas") -> None:

@@ -9,5 +9,13 @@ Python class traced into the session's jitted rounds — no deployment step.
 
 from .base import Program
 from .examples import ExampleKeylistProgram, ExampleProgram
+from .riak_index import RiakIndexProgram, RiakObject, view_name
 
-__all__ = ["Program", "ExampleProgram", "ExampleKeylistProgram"]
+__all__ = [
+    "Program",
+    "ExampleProgram",
+    "ExampleKeylistProgram",
+    "RiakIndexProgram",
+    "RiakObject",
+    "view_name",
+]

@@ -1,0 +1,179 @@
+"""Materialized 2i index views — the reference's one non-trivial program.
+
+Rebuild of ``src/lasp_riak_index_program.erl`` (:59-176) and the
+``lasp_transform`` parameterization machinery (``src/lasp_transform.erl:
+32-128``): a riak_kv-style secondary-index materialized view over an
+OR-Set, fed object-change notifications.
+
+Semantics (reference lines in parentheses):
+
+- on ``put``: remove any stale entries for the object's key (:67-68,
+  remove-then-add), then add ``(key, metadata)`` keyed by a token DERIVED
+  FROM THE COORDINATOR'S VCLOCK (:146-149) — the same logical write mints
+  the same token on every replica, so cross-replica merges of the view
+  are idempotent;
+- a *total* index (no index name) indexes every object (:71-74); a
+  *subset view* indexes only objects whose index specs carry a matching
+  ``(add, name, value)`` entry (:75-89);
+- the top-level index auto-registers one parameterized sub-view per index
+  spec it observes (:92-98, ``create_views`` :162-176);
+- on ``delete``: remove the key's entries (:102-104); ``handoff`` is a
+  no-op (:105-107 is a TODO in the reference too);
+- ``execute`` streams the set; ``value`` projects keys only (:117-121).
+
+Where the reference needs a parse_transform + per-vnode recompilation to
+stamp ``(module, index_name, index_value)`` into a copy of the source
+(``src/lasp_transform.erl:111-128``, applied at ``src/lasp_vnode.erl:
+294-331``) — because BEAM parameterizes code by generating modules — the
+TPU build parameterizes by CONSTRUCTION: a view is an instance of this
+class with ``index_name``/``index_value`` set, registered under the same
+derived name the reference would generate. No runtime compiler, same
+many-instances-of-one-source capability.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import Any, Optional
+
+from .base import Program
+
+#: the reference's base module name; derived view names append -name-value
+#: exactly like create_views' list_to_atom (:164-166)
+BASE_NAME = "lasp_riak_index_program"
+
+
+@dataclasses.dataclass(frozen=True)
+class RiakObject:
+    """The slice of a riak_object the program reads (:60-63): key, the
+    coordinator's vclock, opaque metadata, and 2i index specs — an
+    iterable of ``(op, index_name, index_value)`` tuples."""
+
+    key: Any
+    vclock: Any
+    metadata: Any = None
+    index_specs: tuple = ()
+
+
+def view_name(index_name: str, index_value: str) -> str:
+    return f"{BASE_NAME}-{index_name}-{index_value}"
+
+
+class RiakIndexProgram(Program):
+    type_name = "lasp_orset_gbtree"
+
+    def __init__(
+        self,
+        index_name: Optional[str] = None,
+        index_value: Optional[str] = None,
+        n_elems: int = 64,
+        token_space: int = 64,
+        auto_views: bool = True,
+    ):
+        self.index_name = index_name
+        self.index_value = index_value
+        self.n_elems = n_elems
+        self.token_space = token_space
+        self.auto_views = auto_views
+        self.id: Optional[str] = None
+
+    @property
+    def name(self) -> str:
+        if self.index_name is None:
+            return BASE_NAME
+        return view_name(self.index_name, self.index_value)
+
+    def init(self, session) -> None:
+        # one accumulator OR-Set per instance, named like the generated
+        # module (the normalize_to_binary'd Id of :53-55)
+        self.id = session.declare(
+            type=self.type_name,
+            id=self.name,
+            n_elems=self.n_elems,
+            n_actors=1,
+            tokens_per_actor=self.token_space,
+        )
+
+    # -- event hook ----------------------------------------------------------
+    def process(self, session, object, reason, actor) -> None:
+        obj = object if isinstance(object, RiakObject) else RiakObject(*object)
+        # only additive specs create/select views (:168-173)
+        specs = [s for s in obj.index_specs if s[0] == "add"]
+        if reason == "put":
+            self._remove_entries_for_key(session, obj.key, actor)
+            if self.index_name is None:
+                self._add_entry(session, obj, actor)
+            else:
+                for _op, name, value in specs:
+                    if name == self.index_name and value == self.index_value:
+                        self._add_entry(session, obj, actor)
+            if self.index_name is None and self.auto_views:
+                self._create_views(session, specs)
+        elif reason == "delete":
+            self._remove_entries_for_key(session, obj.key, actor)
+        # handoff: deliberate no-op (:105-107)
+
+    # -- results -------------------------------------------------------------
+    def execute(self, session):
+        """Live ``(key, metadata)`` entries. Stored elements additionally
+        carry the full vclock digest (see :meth:`_add_entry`); it is an
+        internal identity component, stripped here."""
+        return {(key, metadata) for key, metadata, _digest in
+                session.value(self.id)}
+
+    def value(self, output):
+        """Keys only, not metadata (:119-121)."""
+        return {key for key, _metadata in output}
+
+    # -- internals -----------------------------------------------------------
+    def _remove_entries_for_key(self, session, key, actor) -> None:
+        """Remove every (key, *) entry currently in the view (:127-139)."""
+        stale = [e for e in session.value(self.id) if e[0] == key]
+        if stale:
+            session.store.update(self.id, ("remove_all", stale), actor)
+
+    def _add_entry(self, session, obj: RiakObject, actor) -> None:
+        """Entry keyed by the hashed coordinator vclock (:141-149), so the
+        same logical write is idempotent across replicas while distinct
+        writes never collide.
+
+        The reference uses the raw 16-byte md5 as the OR-Set token; a
+        dense token space is bounded, so folding the digest to
+        ``% token_space`` alone would let two DIFFERENT vclocks collide
+        (~1/token_space per delete/re-put cycle) — and a collision with a
+        tombstoned token is silently suppressed by the merge gate
+        (``src/lasp_orset.erl:128-134``), dropping an acknowledged write.
+        Instead the FULL 128-bit digest rides in the element identity
+        ``(key, metadata, digest)``: distinct writes occupy distinct
+        element rows (fresh token planes, no cross-write collisions), and
+        a byte-identical replay lands on the same element + token —
+        idempotent, and still tombstone-suppressed after a delete, exactly
+        like the reference."""
+        digest = hashlib.md5(repr(obj.vclock).encode()).digest()
+        token = int.from_bytes(digest[:8], "little") % self.token_space
+        session.store.update(
+            self.id,
+            (
+                "add_by_token",
+                token,
+                (obj.key, obj.metadata, int.from_bytes(digest, "little")),
+            ),
+            actor,
+        )
+
+    def _create_views(self, session, specs) -> None:
+        """Register one parameterized sub-view per observed index spec
+        (:162-176). ``session.register`` is idempotent, mirroring the
+        reference's fire-and-forget spawn ("if this fails ... it will be
+        generated on the next write")."""
+        for _op, name, value in specs:
+            session.register(
+                view_name(name, value),
+                RiakIndexProgram,
+                index_name=name,
+                index_value=value,
+                n_elems=self.n_elems,
+                token_space=self.token_space,
+                auto_views=False,
+            )

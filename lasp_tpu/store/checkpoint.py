@@ -191,11 +191,19 @@ def save_runtime(runtime, path: str) -> None:
         hs.put("manifest", pickle.dumps(manifest))
 
 
-def load_runtime(path: str, graph=None):
+def load_runtime(path: str, graph=None, n_replicas=None, neighbors=None):
     """Rebuild a ReplicatedRuntime (store + replica states + topology).
     Dataflow edges are code, not data — pass a freshly built ``graph``
     (against the RETURNED runtime's store) via the callback form:
-    ``load_runtime(path, graph=lambda store: build_graph(store))``."""
+    ``load_runtime(path, graph=lambda store: build_graph(store))``.
+
+    Elastic restore: pass ``n_replicas`` (and a matching ``neighbors``
+    topology) to restore onto a DIFFERENT population size — the runtime is
+    rebuilt at the snapshot's size, then :meth:`ReplicatedRuntime.resize`
+    grows (fresh rows at bottom, caught up by gossip) or gracefully
+    shrinks (departing rows' join handed to a survivor) to the target.
+    Reference role: rejoining/resizing a cluster around persisted vnode
+    data (``src/lasp_console.erl:31-94`` + ``src/lasp_vnode.erl:220-237``)."""
     from ..dataflow.engine import Graph
     from ..mesh.runtime import ReplicatedRuntime
 
@@ -208,13 +216,27 @@ def load_runtime(path: str, graph=None):
             _restore_interners(store.variable(var_id), entry)
         g = graph(store) if callable(graph) else Graph(store)
         dtype, shape = manifest["neighbors"]
-        neighbors = np.frombuffer(hs.get("neighbors"), dtype=dtype).reshape(shape)
+        saved_neighbors = np.frombuffer(
+            hs.get("neighbors"), dtype=dtype
+        ).reshape(shape)
         rt = ReplicatedRuntime(
-            store, g, manifest["n_replicas"], neighbors,
+            store, g, manifest["n_replicas"], saved_neighbors,
             packed=manifest.get("packed", False),
         )
         for var_id, entry in manifest["vars"].items():
             rt.states[var_id] = _get_state(
                 hs, var_id, rt.states[var_id], entry
             )
+        if n_replicas is not None and n_replicas != manifest["n_replicas"]:
+            if neighbors is None:
+                raise ValueError(
+                    "restoring onto a different n_replicas requires a "
+                    "matching neighbors topology"
+                )
+            rt.resize(n_replicas, neighbors)
+        elif neighbors is not None:
+            # same-population topology swap: resize validates shape and
+            # index ranges (an out-of-range neighbor would otherwise clamp
+            # silently inside the jitted gather)
+            rt.resize(rt.n_replicas, neighbors)
         return rt

@@ -36,7 +36,24 @@ _TOMBSTONE = 0xFFFFFFFFFFFFFFFF
 def _load_native():
     if not os.path.exists(_SO_PATH):
         return None
-    lib = ctypes.CDLL(_SO_PATH)
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+        return _bind_native(lib)
+    except (OSError, AttributeError) as e:
+        # a stale .so (e.g. built before lasp_store_compact existed) must
+        # degrade to the Python fallback, not break `import lasp_tpu`
+        import warnings
+
+        warnings.warn(
+            f"liblaspstore.so unusable ({e}); rebuild with `make -C native`."
+            " Falling back to the Python log engine.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return None
+
+
+def _bind_native(lib):
     lib.lasp_store_open.restype = ctypes.c_void_p
     lib.lasp_store_open.argtypes = [ctypes.c_char_p]
     lib.lasp_store_put.restype = ctypes.c_int

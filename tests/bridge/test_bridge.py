@@ -1,0 +1,131 @@
+"""Bridge protocol conformance: loopback declare/update/bind/read
+round-trips over a real TCP socket (VERDICT r2 ask #6 done-condition),
+from a client emitting the exact frames lasp_tpu_backend.erl would send
+({packet,4} + term_to_binary)."""
+
+import pytest
+
+from lasp_tpu.bridge import Atom, BridgeClient, BridgeServer
+
+
+@pytest.fixture()
+def client():
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            assert c.start("vnode_0") == (Atom("ok"), Atom("vnode_0"))
+            yield c
+
+
+def test_requires_start_first():
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            resp = c.get(b"x")
+            assert resp[0] == Atom("error") and resp[1] == Atom("not_started")
+
+
+def test_declare_update_read_round_trip(client):
+    assert client.declare(b"s", "lasp_orset", n_elems=8) == (Atom("ok"), b"s")
+    ok, val = client.update(b"s", (Atom("add"), b"x"), b"actor1")
+    assert ok == Atom("ok") and val == [b"x"]
+    ok, val = client.update(b"s", (Atom("add_all"), [b"y", b"z"]), b"actor1")
+    assert ok == Atom("ok") and set(val) == {b"x", b"y", b"z"}
+    ok, val = client.update(b"s", (Atom("remove"), b"y"), b"actor1")
+    assert set(val) == {b"x", b"z"}
+    assert client.read(b"s") == (Atom("ok"), [b"x", b"z"])
+
+
+def test_get_put_backend_contract(client):
+    """start/put/get — the literal lasp_backend behaviour round trip."""
+    client.declare(b"c", "riak_dt_gcounter", n_actors=4)
+    client.update(b"c", (Atom("increment"), 3), b"a1")
+    client.update(b"c", (Atom("increment"),), b"a2")
+    ok, (type_atom, portable) = client.get(b"c")
+    assert ok == Atom("ok") and type_atom == Atom("riak_dt_gcounter")
+    assert sorted(portable) == [(b"a1", 3), (b"a2", 1)]
+    # blind put of an externally-merged state (the ets:insert role)
+    assert client.put(
+        b"c2", "riak_dt_gcounter", [(b"a1", 7), (b"a3", 2)], n_actors=4
+    ) == Atom("ok")
+    assert client.read(b"c2") == (Atom("ok"), 9)
+    assert client.get(b"missing") == (Atom("error"), Atom("not_found"))
+
+
+def test_bind_merges_through_inflation_gate(client):
+    client.declare(b"s", "lasp_orset", n_elems=8, n_actors=2,
+                   tokens_per_actor=4)
+    client.update(b"s", (Atom("add"), b"x"), b"w1")
+    # a remote replica's state: x tombstoned under token 0, plus new elem y
+    remote = [(b"x", [(0, True)]), (b"y", [(4, False)])]
+    ok, val = client.bind(b"s", remote)
+    assert ok == Atom("ok")
+    assert val == [b"y"]  # x's only token tombstoned; y joined in
+    # binding an OLD state is a non-inflation no-op (bind rule)
+    ok, val = client.bind(b"s", [(b"x", [(0, False)])])
+    assert val == [b"y"]
+
+
+def test_merge_batch_anti_entropy(client):
+    client.declare(b"a", "lasp_orset", n_elems=8)
+    client.declare(b"b", "lasp_gset", n_elems=8)
+    client.update(b"a", (Atom("add"), b"local"), b"w")
+    resp = client.merge_batch([
+        (b"a", [(b"remote", [(0, False)])]),
+        (b"b", [b"g1", b"g2"]),
+    ])
+    assert resp == (Atom("ok"), 2)
+    assert client.read(b"a") == (Atom("ok"), [b"local", b"remote"])
+    assert client.read(b"b") == (Atom("ok"), [b"g1", b"g2"])
+
+
+def test_ivar_bridge(client):
+    client.declare(b"v", "lasp_ivar")
+    client.update(b"v", (Atom("set"), b"payload"), b"w")
+    ok, (type_atom, portable) = client.get(b"v")
+    assert portable == (Atom("value"), b"payload")
+
+
+def test_errors_are_terms_not_disconnects(client):
+    client.declare(b"s", "lasp_orset", n_elems=4)
+    resp = client.update(b"s", (Atom("remove"), b"ghost"), b"w")
+    assert resp[0] == Atom("error") and resp[1] == Atom("PreconditionError")
+    # the connection is still serviceable after an error
+    assert client.read(b"s") == (Atom("ok"), [])
+    resp = client.call((Atom("bogus_verb"), 1))
+    assert resp[0] == Atom("error")
+
+
+def test_connections_are_isolated_stores():
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c1, BridgeClient(
+            "127.0.0.1", server.port
+        ) as c2:
+            c1.start("vnode_1")
+            c2.start("vnode_2")
+            c1.declare(b"s", "lasp_gset", n_elems=4)
+            c1.update(b"s", (Atom("add"), b"only-1"), b"w")
+            c2.declare(b"s", "lasp_gset", n_elems=4)
+            assert c2.read(b"s") == (Atom("ok"), [])
+            assert c1.read(b"s") == (Atom("ok"), [b"only-1"])
+
+
+def test_malformed_frames_get_error_terms_not_disconnects():
+    """Truncated/garbage ETF must come back as an error term on a live
+    connection, never kill the server thread."""
+    from lasp_tpu.bridge.server import _recv_frame, _send_frame
+
+    with BridgeServer() as server:
+        import socket
+
+        with socket.create_connection(("127.0.0.1", server.port), 5) as s:
+            for bad in (b"\x83\x62\x00",          # truncated INT_EXT
+                        b"\x83\x77\x02\xff\xfe",  # invalid-UTF8 atom
+                        b"junk"):                  # no version byte
+                _send_frame(s, bad)
+                resp = _recv_frame(s)
+                assert resp is not None
+                from lasp_tpu.bridge import etf
+                term = etf.decode(resp)
+                assert term[0] == Atom("error") and term[1] == Atom("etf_decode")
+            # connection still serviceable
+            _send_frame(s, etf.encode((Atom("start"), Atom("v"))))
+            assert etf.decode(_recv_frame(s)) == (Atom("ok"), Atom("v"))

@@ -1,0 +1,86 @@
+"""ETF codec conformance: byte vectors are checked against the published
+External Term Format (the exact bytes term_to_binary/1 produces on a BEAM
+for these terms), so the Python side is wire-compatible with
+binary_to_term without an Erlang node in the image."""
+
+import pytest
+
+from lasp_tpu.bridge import etf
+from lasp_tpu.bridge.etf import Atom
+
+
+# (term, term_to_binary bytes) — vectors derived from the ETF spec:
+# 131 version; 97 SMALL_INTEGER; 98 INTEGER; 119 SMALL_ATOM_UTF8;
+# 109 BINARY; 104 SMALL_TUPLE; 108 LIST; 106 NIL; 110 SMALL_BIG; 70 FLOAT
+VECTORS = [
+    (0, bytes([131, 97, 0])),
+    (255, bytes([131, 97, 255])),
+    (256, bytes([131, 98, 0, 0, 1, 0])),
+    (-1, bytes([131, 98, 255, 255, 255, 255])),
+    (Atom("ok"), bytes([131, 119, 2]) + b"ok"),
+    (b"hi", bytes([131, 109, 0, 0, 0, 2]) + b"hi"),
+    ((Atom("ok"), 1), bytes([131, 104, 2, 119, 2]) + b"ok" + bytes([97, 1])),
+    ([], bytes([131, 106])),
+    (
+        [1, 2],
+        bytes([131, 108, 0, 0, 0, 2, 97, 1, 97, 2, 106]),
+    ),
+    # 2^40 = little-endian big of 6 bytes: 0,0,0,0,0,1
+    (1 << 40, bytes([131, 110, 6, 0, 0, 0, 0, 0, 0, 1])),
+    (-(1 << 40), bytes([131, 110, 6, 1, 0, 0, 0, 0, 0, 1])),
+    (1.5, bytes([131, 70, 63, 248, 0, 0, 0, 0, 0, 0])),
+]
+
+
+@pytest.mark.parametrize("term,blob", VECTORS)
+def test_encode_matches_term_to_binary(term, blob):
+    assert etf.encode(term) == blob
+
+
+@pytest.mark.parametrize("term,blob", VECTORS)
+def test_decode_matches_binary_to_term(term, blob):
+    assert etf.decode(blob) == term
+
+
+def test_atom_special_values_decode_to_python():
+    assert etf.decode(etf.encode(Atom("undefined"))) is None
+    assert etf.decode(etf.encode(True)) is True
+    assert etf.decode(etf.encode(False)) is False
+
+
+def test_str_crosses_as_binary():
+    assert etf.decode(etf.encode("hello")) == b"hello"
+
+
+def test_nested_round_trip():
+    term = (
+        Atom("update"),
+        b"views",
+        (Atom("increment"), 3),
+        [(b"k", [(1, False), (2, True)]), (b"j", [])],
+        {Atom("n_elems"): 64},
+    )
+    out = etf.decode(etf.encode(term))
+    assert out == term
+
+
+def test_old_atom_ext_decodes():
+    # ATOM_EXT (100): u16 length + latin1 name — old nodes still emit it
+    blob = bytes([131, 100, 0, 2]) + b"ok"
+    assert etf.decode(blob) == Atom("ok")
+
+
+def test_string_ext_decodes_as_int_list():
+    # STRING_EXT (107): how term_to_binary encodes [104, 105]
+    blob = bytes([131, 107, 0, 2]) + b"hi"
+    assert etf.decode(blob) == [104, 105]
+
+
+def test_improper_and_truncated_raise():
+    with pytest.raises(etf.ETFDecodeError):
+        etf.decode(b"")
+    with pytest.raises(etf.ETFDecodeError):
+        etf.decode(bytes([131, 104, 2, 97, 1]))  # tuple arity 2, one elem
+    with pytest.raises(etf.ETFDecodeError):
+        # LIST with a non-nil tail (improper list)
+        etf.decode(bytes([131, 108, 0, 0, 0, 1, 97, 1, 97, 2]))
