@@ -237,6 +237,8 @@ def _child(label: str) -> int:
         "encoding": "packed-uint32-wide",
         "state_bytes_per_replica": out["state_bytes_per_replica"],
         "achieved_GBps": out["achieved_GBps"],
+        "gossip_impl": out["gossip_impl"],
+        "impl_block_seconds": out["impl_block_seconds"],
         "roofline_GBps": roofline,
         "roofline_frac": (
             round(out["achieved_GBps"] / roofline, 3) if roofline else None

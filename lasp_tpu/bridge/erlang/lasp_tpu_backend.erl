@@ -77,6 +77,11 @@ merge_batch(Socket, Items) ->
 call(Socket, Term) ->
     ok = gen_tcp:send(Socket, term_to_binary(Term)),
     case gen_tcp:recv(Socket, 0, 60000) of
-        {ok, Bin} -> binary_to_term(Bin);
-        {error, Reason} -> {error, Reason}
+        {ok, Bin} ->
+            binary_to_term(Bin);
+        {error, Reason} ->
+            %% a timed-out reply would stay queued and desynchronize every
+            %% later call by one frame — close so the caller reconnects
+            gen_tcp:close(Socket),
+            {error, Reason}
     end.

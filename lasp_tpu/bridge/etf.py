@@ -179,7 +179,9 @@ def _dec(b: bytes, off: int):
         else:
             (n,) = struct.unpack_from(">H", b, off)
             off += 2
-        name = b[off : off + n].decode("utf-8")
+        # ATOM_EXT (deprecated) is defined as Latin-1; the UTF8 tags as UTF-8
+        enc = "latin-1" if tag == _ATOM_OLD else "utf-8"
+        name = b[off : off + n].decode(enc)
         off += n
         if name == "undefined":
             return None, off

@@ -85,13 +85,33 @@ def _send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 
+class _Tag(str):
+    """Container-shape marker for interned terms. Checked by IDENTITY, so
+    no wire term can ever collide with it."""
+
+
+_LIST = _Tag("list")
+_TUPLE = _Tag("tuple")
+
+
 def _to_key(term: Any) -> Any:
-    """ETF terms used as ids/elems/actors must be hashable: lists (the one
-    unhashable ETF shape) become tuples, recursively."""
+    """ETF terms used as ids/elems/actors must be hashable AND shape-
+    faithful: lists (unhashable) and tuples both become tuples, tagged so
+    ``[1,2]`` and ``{1,2}`` stay DISTINCT keys and can round-trip back to
+    their original shapes via :func:`_from_key`."""
     if isinstance(term, list):
-        return tuple(_to_key(x) for x in term)
+        return (_LIST,) + tuple(_to_key(x) for x in term)
     if isinstance(term, tuple):
-        return tuple(_to_key(x) for x in term)
+        return (_TUPLE,) + tuple(_to_key(x) for x in term)
+    return term
+
+
+def _from_key(term: Any) -> Any:
+    if isinstance(term, tuple) and term:
+        if term[0] is _LIST:
+            return [_from_key(x) for x in term[1:]]
+        if term[0] is _TUPLE:
+            return tuple(_from_key(x) for x in term[1:])
     return term
 
 
@@ -100,13 +120,11 @@ def _to_key(term: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 def _export_state(var) -> Any:
-    import jax
-
     tn = var.type_name
-    state, spec = var.state, var.spec
+    state = var.state
     if tn == "lasp_gset":
         mask = np.asarray(state.mask)
-        return [var.elems.terms()[i] for i in np.flatnonzero(mask)]
+        return [_from_key(var.elems.terms()[i]) for i in np.flatnonzero(mask)]
     if tn in ("lasp_orset", "lasp_orset_gbtree"):
         exists = np.asarray(state.exists)
         removed = np.asarray(state.removed)
@@ -116,19 +134,22 @@ def _export_state(var) -> Any:
                 (int(t), bool(removed[e, t]))
                 for t in np.flatnonzero(exists[e])
             ]
-            out.append((var.elems.terms()[int(e)], toks))
+            out.append((_from_key(var.elems.terms()[int(e)]), toks))
         return out
     if tn == "riak_dt_gcounter":
         counts = np.asarray(state.counts)
         return [
-            (a, int(counts[i]))
+            (_from_key(a), int(counts[i]))
             for i, a in enumerate(var.actors.terms())
             if counts[i]
         ]
     if tn == "lasp_ivar":
         if not bool(np.asarray(state.defined)):
             return None
-        return (Atom("value"), var.ivar_payloads.terms()[int(state.value)])
+        return (
+            Atom("value"),
+            _from_key(var.ivar_payloads.terms()[int(state.value)]),
+        )
     raise ValueError(f"bridge: unsupported type {tn!r}")
 
 
@@ -176,9 +197,10 @@ def _import_state(var, portable: Any):
 
 def _export_value(store: Store, var_id) -> Any:
     v = store.value(var_id)
-    if isinstance(v, frozenset) or isinstance(v, set):
-        return sorted(v, key=lambda t: etf.encode(t))
-    return v
+    if isinstance(v, (frozenset, set)):
+        members = [_from_key(t) for t in v]
+        return sorted(members, key=etf.encode)
+    return _from_key(v)
 
 
 # ---------------------------------------------------------------------------
@@ -211,8 +233,8 @@ class _Conn:
     def _dispatch(self, verb: str, req: tuple) -> Any:
         store = self.store
         if verb == "declare":
-            _, var_id, type_atom, caps = req
-            var_id = _to_key(var_id)
+            _, raw_id, type_atom, caps = req
+            var_id = _to_key(raw_id)
             kwargs = {
                 str(k): int(v)
                 for k, v in (caps or {}).items()
@@ -220,7 +242,7 @@ class _Conn:
             }
             if var_id not in store.ids():
                 store.declare(id=var_id, type=str(type_atom), **kwargs)
-            return (etf.OK, var_id)
+            return (etf.OK, raw_id)  # echo the id exactly as sent
         if verb == "put":
             _, var_id, payload = req
             var_id = _to_key(var_id)
@@ -247,10 +269,16 @@ class _Conn:
         if verb == "update":
             _, var_id, op, actor = req
             var_id = _to_key(var_id)
-            op = tuple(
-                [str(op[0])] + [_to_key(x) for x in op[1:]]
-            ) if isinstance(op, tuple) else (str(op),)
-            store.update(var_id, op, _to_key(actor))
+            if not isinstance(op, tuple):
+                op = (op,)
+            verb_s = str(op[0])
+            if verb_s in ("add_all", "remove_all"):
+                # the list here is op SYNTAX (a collection of terms), not
+                # itself a term — convert its items, not the container
+                args = ([_to_key(x) for x in op[1]],)
+            else:
+                args = tuple(_to_key(x) for x in op[1:])
+            store.update(var_id, (verb_s,) + args, _to_key(actor))
             return (etf.OK, _export_value(store, var_id))
         if verb == "bind":
             _, var_id, portable = req
@@ -270,7 +298,7 @@ class _Conn:
             _, var_id = req
             return (etf.OK, _export_value(store, _to_key(var_id)))
         if verb == "keys":
-            return (etf.OK, [k for k in self.store.ids()])
+            return (etf.OK, [_from_key(k) for k in self.store.ids()])
         return (etf.ERROR, Atom("badarg"), f"unknown verb {verb}".encode())
 
 
@@ -286,6 +314,8 @@ class BridgeServer:
         self._sock: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
 
     def start(self) -> int:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -307,6 +337,8 @@ class BridgeServer:
                 continue
             except OSError:
                 break
+            with self._conns_lock:
+                self._conns.add(conn)
             # daemon threads, never joined: retaining them would leak one
             # Thread object per connection on a long-lived server
             threading.Thread(
@@ -315,29 +347,46 @@ class BridgeServer:
 
     def _serve_conn(self, sock: socket.socket) -> None:
         state = _Conn(self.n_actors)
-        with sock:
-            while not self._stop.is_set():
-                try:
-                    frame = _recv_frame(sock)
-                except OSError:
-                    break
-                if frame is None:
-                    break
-                try:
-                    req = etf.decode(frame)
-                    resp = state.handle(req)
-                except etf.ETFDecodeError as e:
-                    resp = (etf.ERROR, Atom("etf_decode"), str(e).encode())
-                try:
-                    _send_frame(sock, etf.encode(resp))
-                except OSError:
-                    break
+        try:
+            with sock:
+                while not self._stop.is_set():
+                    try:
+                        frame = _recv_frame(sock)
+                    except OSError:
+                        break
+                    if frame is None:
+                        break
+                    try:
+                        req = etf.decode(frame)
+                        resp = state.handle(req)
+                    except etf.ETFDecodeError as e:
+                        resp = (etf.ERROR, Atom("etf_decode"), str(e).encode())
+                    try:
+                        _send_frame(sock, etf.encode(resp))
+                    except OSError:
+                        break
+        finally:
+            with self._conns_lock:
+                self._conns.discard(sock)
 
     def stop(self) -> None:
         self._stop.set()
         if self._sock is not None:
             try:
                 self._sock.close()
+            except OSError:
+                pass
+        # wake connection threads blocked in recv: a "stopped" server must
+        # not keep answering existing clients
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
             except OSError:
                 pass
 

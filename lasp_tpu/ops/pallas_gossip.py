@@ -17,8 +17,15 @@ OR-Set planes are joined in one kernel launch since they share the
 neighbor gather.
 
 Correctness is pinned against :func:`lasp_tpu.mesh.gossip.gossip_round` in
-interpret mode on CPU and compiled on TPU; ``bench_pallas.py`` compares
-against the XLA path (results recorded in the docstring of that script).
+interpret mode on CPU and compiled on TPU.
+
+SHIPPING PATH + MEASURED GATE: ``bench_scenarios.orset_anti_entropy``
+(the bench.py headline and the ``orset_100k`` scenario) autotunes between
+this kernel and the XLA gather+join per run — it times one fused block of
+each on the actual hardware and ships the winner; both timings are
+recorded in the result (``impl_block_seconds``) and surface in the driver
+benchmark artifact. ``bench_pallas.py`` remains the standalone sweep over
+row-width configs.
 """
 
 from __future__ import annotations

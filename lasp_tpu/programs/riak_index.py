@@ -133,6 +133,46 @@ class RiakIndexProgram(Program):
         if stale:
             session.store.update(self.id, ("remove_all", stale), actor)
 
+    def compact(self, session) -> int:
+        """Reclaim element slots held by fully-tombstoned entries.
+
+        Every distinct write interns a fresh ``(key, metadata, digest)``
+        element, and remove-stale only tombstones tokens — so the element
+        universe fills with dead entries over the view's lifetime (the
+        ``waste_pct`` the reference reports but never reclaims,
+        ``src/lasp_orset.erl:178-191``). Dropping an element row is safe
+        HERE because the view variable is program-private and
+        single-store: no remote replica state can reintroduce the dropped
+        tombstones. (The one observable difference: a byte-identical
+        replay of a write whose entry was deleted AND compacted re-indexes
+        the key; without compaction the tombstone suppresses it.) Live
+        rows are kept verbatim, including their tombstoned tokens.
+
+        Returns the number of slots reclaimed."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..utils.interning import Interner
+
+        var = session.store.variable(self.id)
+        exists = np.asarray(var.state.exists)
+        removed = np.asarray(var.state.removed)
+        live = (exists & ~removed).any(axis=-1)
+        old_terms = var.elems.terms()
+        fresh = Interner(var.spec.n_elems, kind=var.elems.kind)
+        new_ex = np.zeros_like(exists)
+        new_rm = np.zeros_like(removed)
+        for old_idx in np.flatnonzero(live):
+            ni = fresh.intern(old_terms[int(old_idx)])
+            new_ex[ni] = exists[old_idx]
+            new_rm[ni] = removed[old_idx]
+        reclaimed = len(old_terms) - len(fresh)
+        var.elems = fresh
+        var.state = var.state._replace(
+            exists=jnp.asarray(new_ex), removed=jnp.asarray(new_rm)
+        )
+        return reclaimed
+
     def _add_entry(self, session, obj: RiakObject, actor) -> None:
         """Entry keyed by the hashed coordinator vclock (:141-149), so the
         same logical write is idempotent across replicas while distinct
@@ -150,17 +190,24 @@ class RiakIndexProgram(Program):
         a byte-identical replay lands on the same element + token —
         idempotent, and still tombstone-suppressed after a delete, exactly
         like the reference."""
+        from ..utils.interning import CapacityError
+
         digest = hashlib.md5(repr(obj.vclock).encode()).digest()
         token = int.from_bytes(digest[:8], "little") % self.token_space
-        session.store.update(
-            self.id,
-            (
-                "add_by_token",
-                token,
-                (obj.key, obj.metadata, int.from_bytes(digest, "little")),
-            ),
-            actor,
+        op = (
+            "add_by_token",
+            token,
+            (obj.key, obj.metadata, int.from_bytes(digest, "little")),
         )
+        try:
+            session.store.update(self.id, op, actor)
+        except CapacityError:
+            # dead entries exhaust the universe over the view's lifetime;
+            # reclaim them and retry — only a genuinely-full LIVE view
+            # stays loud
+            if self.compact(session) == 0:
+                raise
+            session.store.update(self.id, op, actor)
 
     def _create_views(self, session, specs) -> None:
         """Register one parameterized sub-view per observed index spec

@@ -121,3 +121,37 @@ def test_token_collision_cannot_drop_new_writes():
     sess.process(RiakObject(key="k1", vclock=("a", 2)), "delete", "idx")
     _put(sess, "k1", vclock=("a", 3), metadata="m")  # token 0 again
     assert sess.execute("lasp_riak_index_program") == {"k1"}
+
+
+def test_lifetime_writes_autocompact_past_capacity():
+    """A view outlives n_elems distinct writes: dead entries are compacted
+    away automatically; the live result stays correct throughout."""
+    sess = Session(n_actors=4)
+    sess.register(
+        "lasp_riak_index_program", RiakIndexProgram, n_elems=4, token_space=4
+    )
+    for v in range(20):  # 20 distinct vclocks through a 4-element universe
+        _put(sess, "k1", vclock=("a", v), metadata=f"m{v}")
+    prog = sess.programs["lasp_riak_index_program"]
+    assert prog.execute(sess) == {("k1", "m19")}
+    # interleaved keys + deletes keep working too
+    _put(sess, "k2", vclock=("b", 1), metadata="x")
+    sess.process(RiakObject(key="k1", vclock=("a", 99)), "delete", "idx")
+    for v in range(6):
+        _put(sess, "k3", vclock=("c", v), metadata=f"y{v}")
+    assert sess.execute("lasp_riak_index_program") == {"k2", "k3"}
+
+
+def test_compact_raises_when_live_entries_fill_universe():
+    import pytest as _pytest
+
+    from lasp_tpu.utils.interning import CapacityError
+
+    sess = Session(n_actors=4)
+    sess.register(
+        "lasp_riak_index_program", RiakIndexProgram, n_elems=3, token_space=4
+    )
+    for i in range(3):
+        _put(sess, f"k{i}", vclock=(f"a{i}", 1), metadata="m")
+    with _pytest.raises(CapacityError):
+        _put(sess, "k-one-too-many", vclock=("z", 1), metadata="m")

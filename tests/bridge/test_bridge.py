@@ -129,3 +129,42 @@ def test_malformed_frames_get_error_terms_not_disconnects():
             # connection still serviceable
             _send_frame(s, etf.encode((Atom("start"), Atom("v"))))
             assert etf.decode(_recv_frame(s)) == (Atom("ok"), Atom("v"))
+
+
+def test_list_and_tuple_ids_are_distinct_and_round_trip():
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("v")
+            assert c.declare([1, 2], "lasp_gset", n_elems=4) == (
+                Atom("ok"), [1, 2]
+            )
+            assert c.declare((1, 2), "riak_dt_gcounter", n_actors=2) == (
+                Atom("ok"), (1, 2)
+            )
+            c.update([1, 2], (Atom("add"), b"e"), b"w")
+            c.update((1, 2), (Atom("increment"), 5), b"w")
+            assert c.read([1, 2]) == (Atom("ok"), [b"e"])
+            assert c.read((1, 2)) == (Atom("ok"), 5)
+            ok, keys = c.call((Atom("keys"),))
+            assert ok == Atom("ok")
+            assert [1, 2] in keys and (1, 2) in keys
+            # container-valued ELEMENTS round-trip shape-faithfully too
+            c.declare(b"s", "lasp_gset", n_elems=4)
+            c.update(b"s", (Atom("add"), [b"x", 1]), b"w")
+            c.update(b"s", (Atom("add"), (b"x", 1)), b"w")
+            ok, val = c.read(b"s")
+            assert [b"x", 1] in val and (b"x", 1) in val and len(val) == 2
+
+
+def test_stop_disconnects_live_clients():
+    server = BridgeServer()
+    server.start()
+    c = BridgeClient("127.0.0.1", server.port)
+    c.start("v")
+    server.stop()
+    import pytest as _pytest
+
+    with _pytest.raises((ConnectionError, OSError)):
+        for _ in range(3):  # first call may see the buffered close late
+            c.call((Atom("keys"),))
+    c.close()
