@@ -189,17 +189,18 @@ def _child(label: str) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from lasp_tpu.bench_scenarios import adcounter_10m, orset_anti_entropy
+    from lasp_tpu.config import get_config
 
+    cfg = get_config()
     on_tpu = jax.devices()[0].platform != "cpu"
     kind = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
 
     # -- headline: wide-row packed OR-Set anti-entropy ----------------------
     wide = dict(n_elems=128, n_actors=64, tokens_per_actor=4)  # 8 KiB/replica
-    n_replicas = int(
-        os.environ.get("LASP_BENCH_REPLICAS", (1 << 18) if on_tpu else (1 << 12))
+    n_replicas = cfg.bench_replicas or ((1 << 18) if on_tpu else (1 << 12))
+    out = orset_anti_entropy(
+        n_replicas, block=cfg.bench_block, gossip_impl=cfg.gossip_impl, **wide
     )
-    block = int(os.environ.get("LASP_BENCH_BLOCK", 4))
-    out = orset_anti_entropy(n_replicas, block=block, **wide)
     tpu_rate = out["merges_per_sec"]
 
     # -- batched NumPy baseline: same shapes, same rounds, full population --
@@ -251,11 +252,8 @@ def _child(label: str) -> int:
     }
 
     # -- north-star: 10M-replica engine-path ad counter ---------------------
-    ns_replicas = int(
-        os.environ.get(
-            "LASP_BENCH_NORTHSTAR_REPLICAS",
-            10 * (1 << 20) if on_tpu else (1 << 13),
-        )
+    ns_replicas = cfg.bench_northstar_replicas or (
+        10 * (1 << 20) if on_tpu else (1 << 13)
     )
     try:
         ns = adcounter_10m(n_replicas=ns_replicas)

@@ -396,14 +396,17 @@ def pipeline_1m(n_replicas: int = 1 << 20) -> dict:
     )
     # warm-up (compiles both the single step and the fused block outside
     # the timed loop); the rounds it consumes are counted in the total
+    from lasp_tpu.config import get_config
+
+    blk = get_config().fused_block
     rt.step()
-    fz = rt.fused_steps(4)
-    warm_rounds = 1 + (4 if fz < 0 else fz + 1)
+    fz = rt.fused_steps(blk)
+    warm_rounds = 1 + (blk if fz < 0 else fz + 1)
 
     def run():
         if fz >= 0:
             return None, 0  # converged during warm-up (toy scales only)
-        return None, rt.run_to_convergence(block=4)
+        return None, rt.run_to_convergence(block=blk)
 
     (_, rounds), secs = _timed(run)
     got = rt.coverage_value("folded")
@@ -520,17 +523,23 @@ def adcounter_10m(n_replicas: int = 10 * (1 << 20), threshold: int = 5) -> dict:
             out[vid] = st._replace(removed=st.removed | (st.exists & mask[:, None]))
         return out
 
-    rt.register_trigger(server)
+    # declared touch set: the union pipeline's packed sets stay dense only
+    # where needed; the trigger reads the view counters and writes the
+    # publishers' sets
+    rt.register_trigger(server, touches=[ads_a, ads_b, *views])
     # warm-up compiles the single step and the fused block outside the
     # timed loop; its rounds are counted in the reported total
+    from lasp_tpu.config import get_config
+
+    blk = get_config().fused_block
     rt.step()
-    fz = rt.fused_steps(4)
-    warm_rounds = 1 + (4 if fz < 0 else fz + 1)
+    fz = rt.fused_steps(blk)
+    warm_rounds = 1 + (blk if fz < 0 else fz + 1)
 
     def run():
         if fz >= 0:
             return None, 0  # converged during warm-up (toy scales only)
-        return None, rt.run_to_convergence(block=4)
+        return None, rt.run_to_convergence(block=blk)
 
     (_, rounds), secs = _timed(run)
 
@@ -552,7 +561,7 @@ def adcounter_10m(n_replicas: int = 10 * (1 << 20), threshold: int = 5) -> dict:
         "scenario": f"adcounter_{n_replicas}",
         "rounds": warm_rounds + rounds,
         "seconds": round(secs, 4),
-        "fused_block": 4,
+        "fused_block": blk,
         "ad_totals": totals,
         "live_ads": len(live),
         "active_pairs": len(active),

@@ -46,7 +46,11 @@ def cmd_simulate(args) -> int:
     for w in range(args.writers):
         replica = (w * args.replicas) // args.writers
         rt.update_at(replica, var, ("add", f"item{w}"), f"writer{w}")
-    rounds = rt.run_to_convergence(max_rounds=args.max_rounds)
+    from lasp_tpu.config import get_config
+
+    rounds = rt.run_to_convergence(
+        max_rounds=args.max_rounds, block=get_config().fused_block
+    )
     out = {
         "replicas": args.replicas,
         "topology": args.topology,
@@ -127,11 +131,14 @@ def main(argv=None) -> int:
 
     sub.add_parser("status", help="devices + version (ringready analogue)")
 
+    from lasp_tpu.config import get_config
+
+    cfg = get_config()
     sim = sub.add_parser("simulate", help="run a gossip population to fixpoint")
     sim.add_argument("--replicas", type=int, default=1024)
     sim.add_argument("--topology", choices=["ring", "random", "scale_free"],
                      default="random")
-    sim.add_argument("--fanout", type=int, default=3)
+    sim.add_argument("--fanout", type=int, default=cfg.fanout)
     sim.add_argument(
         "--type",
         default="lasp_orset",

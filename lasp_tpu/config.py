@@ -1,0 +1,104 @@
+"""Unified runtime configuration (SURVEY.md §5 "Config / flag system").
+
+The reference scatters configuration across compile-time macros
+(``include/lasp.hrl:8-43``: backend selection, N/R/W quorums, timeouts),
+cuttlefish schemas (``priv/lasp.schema:4-8``), and templated app/vm args
+(``rel/files/app.config``, ``rel/vars.config``). The TPU build replaces
+all three with ONE typed, frozen dataclass: defaults in code, overrides
+from ``LASP_*`` environment variables (the release-template role), and
+explicit construction for programmatic use.
+
+Every field maps to the env var ``LASP_<FIELDNAME upper>``; unknown
+``LASP_*`` variables are rejected loudly (a typo'd knob must not be
+silently ignored — the same policy as the store's ``ALLOWED_CAPS``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class LaspConfig:
+    # -- capacity defaults (the include/lasp.hrl compile-time macro role) --
+    #: default per-variable writer universe (Store(n_actors=...));
+    #: element/token capacities stay per-declare arguments on purpose —
+    #: they size each variable's universe, not the process
+    n_actors: int = 16
+
+    # -- gossip / engine ----------------------------------------------------
+    #: pull-gossip fan-in for cli simulate / scenario topologies
+    fanout: int = 3
+    #: rounds per fused dispatch for the engine-scale scenarios and cli
+    fused_block: int = 4
+    #: headline gossip kernel: auto | xla | pallas
+    gossip_impl: str = "auto"
+
+    # -- benchmark knobs (bench.py / cli bench) ------------------------------
+    bench_replicas: Optional[int] = None  # None = bench picks per platform
+    bench_northstar_replicas: Optional[int] = None
+    bench_block: int = 4
+
+    # -- mesh ---------------------------------------------------------------
+    #: extent of the tensor-parallel "state" axis in build_mesh
+    mesh_state_axis: int = 1
+
+    @classmethod
+    def field_env_name(cls, field_name: str) -> str:
+        return f"LASP_{field_name.upper()}"
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None) -> "LaspConfig":
+        """Defaults overridden by ``LASP_*`` env vars. Unknown ``LASP_*``
+        names raise (except the driver/runner-owned ``LASP_BENCH_*`` and
+        ``LASP_DRYRUN_*`` timeout knobs, which bench.py/__graft_entry__
+        own directly)."""
+        env = os.environ if env is None else env
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        by_env = {cls.field_env_name(n): n for n in fields}
+        overrides = {}
+        passthrough_prefixes = (
+            "LASP_BENCH_PROBE",
+            "LASP_BENCH_TPU_TIMEOUT",
+            "LASP_BENCH_CPU_TIMEOUT",
+            "LASP_DRYRUN",
+        )
+        for key, raw in env.items():
+            if not key.startswith("LASP_"):
+                continue
+            if any(key.startswith(p) for p in passthrough_prefixes):
+                continue
+            if key not in by_env:
+                known = ", ".join(sorted(by_env))
+                raise ValueError(
+                    f"unknown config variable {key} (known: {known})"
+                )
+            name = by_env[key]
+            ftype = fields[name].type
+            if ftype in ("int", "Optional[int]", int):
+                overrides[name] = int(raw)
+            else:
+                overrides[name] = raw
+        return cls(**overrides)
+
+    def validate(self) -> "LaspConfig":
+        if self.gossip_impl not in ("auto", "xla", "pallas"):
+            raise ValueError(f"gossip_impl: {self.gossip_impl!r}")
+        for name in ("n_actors", "fanout", "fused_block", "mesh_state_axis",
+                     "bench_block"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1")
+        return self
+
+
+def get_config() -> LaspConfig:
+    """The process-wide config, resolved from the environment once."""
+    global _CONFIG
+    if _CONFIG is None:
+        _CONFIG = LaspConfig.from_env().validate()
+    return _CONFIG
+
+
+_CONFIG: Optional[LaspConfig] = None

@@ -1,0 +1,131 @@
+"""mesh_comm: device-mesh construction + multi-host/multi-slice wiring.
+
+The reference's communication backend is disterl carrying riak_core's
+vnode command protocol, ring gossip, and metadata broadcast (SURVEY.md
+§2.5 / §5 "Distributed communication backend"; ``src/lasp_vnode.erl:
+106-207``). The TPU equivalence table maps that onto XLA collectives:
+
+- point-to-point vnode commands  -> ICI collective step (``ppermute`` ring
+  path in :mod:`.shard_gossip`; XLA-inserted gathers otherwise)
+- read-repair / quorum merge     -> ``all_reduce`` with the lattice join
+  (:func:`lasp_tpu.mesh.gossip.join_all` under a sharded axis)
+- metadata broadcast             -> replicated small state
+- cross-node scale (disterl TCP) -> this module: ``jax.distributed`` over
+  DCN, with slice-aware hybrid meshes so gossip neighbors land on ICI and
+  only the coarse axis crosses DCN.
+
+Single-host and virtual-device (CPU) environments run the same code: the
+helpers degrade to a flat local mesh, which is how the test suite and the
+driver's dry-run exercise this path without a pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host runtime (the disterl node-joining role,
+    ``rel/files/vm.args:2-5`` node naming). Arguments default from the
+    standard env (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``); a single-process environment (nothing set,
+    ``num_processes in (None, 1)``) is a no-op returning False, so the
+    same program runs unmodified on one chip, one host, or a DCN-spanned
+    pod. Returns True when the distributed runtime was initialized."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None:
+        env = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env) if env else None
+    if process_id is None:
+        env = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env) if env else None
+    if not coordinator_address or not num_processes or num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def _slice_index(device) -> int:
+    return getattr(device, "slice_index", 0) or 0
+
+
+def n_slices(devices: Optional[Sequence] = None) -> int:
+    devices = list(devices) if devices is not None else jax.devices()
+    return len({_slice_index(d) for d in devices})
+
+
+def build_mesh(
+    replicas: int = -1,
+    state: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the framework's canonical mesh: axes ``("slices", "replicas",
+    "state")``.
+
+    - ``replicas`` — data-parallel sharding of the simulated replica
+      population (ring partitioning + N-way replication of the reference);
+      ``-1`` takes whatever devices remain.
+    - ``state`` — sharding of wide per-variable token/actor axes (the
+      tensor-parallel analogue).
+    - ``slices`` — the DCN axis: one entry per TPU slice, OUTERMOST, so
+      gossip gathers along ``replicas``/``state`` ride the ICI and only
+      coarse population partitioning crosses DCN (SURVEY §2.5: "partition
+      the replica graph between slices with boundary exchange"). On a
+      single slice (or CPU) its extent is 1 and the mesh is ICI-only.
+    """
+    if state is None:
+        from ..config import get_config
+
+        state = get_config().mesh_state_axis
+    devices = list(devices) if devices is not None else jax.devices()
+    slices: dict[int, list] = {}
+    for d in devices:
+        slices.setdefault(_slice_index(d), []).append(d)
+    ns = len(slices)
+    per_slice = min(len(v) for v in slices.values())
+    if state < 1 or per_slice % state:
+        raise ValueError(
+            f"state axis {state} does not divide the {per_slice} devices "
+            f"per slice"
+        )
+    max_replicas = per_slice // state
+    if replicas == -1:
+        replicas = max_replicas
+    if replicas * state > per_slice:
+        raise ValueError(
+            f"replicas*state = {replicas * state} exceeds {per_slice} "
+            f"devices per slice"
+        )
+    grid = np.empty((ns, replicas, state), dtype=object)
+    for si, key in enumerate(sorted(slices)):
+        grid[si] = np.asarray(
+            slices[key][: replicas * state], dtype=object
+        ).reshape(replicas, state)
+    return Mesh(grid, ("slices", "replicas", "state"))
+
+
+def population_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard a ``[R, ...]`` replica population over BOTH the DCN slice axis
+    and the intra-slice replicas axis (coarse split across slices, fine
+    split inside each slice)."""
+    return NamedSharding(mesh, P(("slices", "replicas")))
+
+
+def neighbor_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(("slices", "replicas"), None))

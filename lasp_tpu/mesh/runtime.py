@@ -112,7 +112,7 @@ class ReplicatedRuntime:
         return row
 
     # -- reactive triggers ----------------------------------------------------
-    def register_trigger(self, fn) -> None:
+    def register_trigger(self, fn, touches=None) -> None:
         """Register a per-replica reactive rule run inside every step:
         ``fn(dense_states: dict) -> dict[var_id, candidate_state]``.
 
@@ -122,8 +122,17 @@ class ReplicatedRuntime:
         read counter >= threshold, then remove the ad). Here the blocking
         read becomes a per-round predicate evaluated at every replica, and
         the update lands through the same merge + inflation gate as a bind
-        (``src/lasp_core.erl:301-311``), vmapped over the population."""
-        self._triggers.append(fn)
+        (``src/lasp_core.erl:301-311``), vmapped over the population.
+
+        ``touches`` (optional) lists every var_id the trigger reads OR
+        writes. In packed mode the step unpacks a variable's wire words to
+        dense planes only when the dataflow graph or some trigger needs it
+        — declaring the touch set lets unrelated wide variables ride
+        through gossip fully packed. ``None`` (the default) means "may
+        touch anything" and forces every variable dense."""
+        self._triggers.append(
+            (fn, frozenset(touches) if touches is not None else None)
+        )
         self._step = None
         self._fused_steps_cache.clear()
 
@@ -490,7 +499,10 @@ class ReplicatedRuntime:
         DENSE views (unpack -> compute -> repack inside the same jit, where
         XLA fuses the bit arithmetic into the kernels); gossip and the
         residual run natively on the packed words — HBM and ICI only ever
-        see 1 bit per token."""
+        see 1 bit per token. Only variables the graph or some trigger
+        actually touches are unpacked (triggers declare touch sets via
+        ``register_trigger(..., touches=...)``); untouched packed
+        variables ride through the whole step in wire form."""
         graph = self.graph
         edges = bool(graph.edges)
         meta = {v: self._mesh_meta(v) for v in self.var_ids}
@@ -501,6 +513,14 @@ class ReplicatedRuntime:
         packed_specs = dict(self._packed_specs)
         flow_ids = graph._var_ids
         triggers = tuple(self._triggers)
+        # which variables need dense views inside the local round
+        if any(touch is None for _fn, touch in triggers):
+            needed = frozenset(self.var_ids)
+        else:
+            needed = frozenset(flow_ids) | frozenset(
+                v for _fn, touch in triggers for v in touch
+            )
+            needed &= frozenset(self.var_ids)
 
         def to_dense(v, x):
             return FlatORSet.unpack(packed_specs[v], x) if v in packed_specs else x
@@ -515,13 +535,22 @@ class ReplicatedRuntime:
             if edges or triggers:
 
                 def local_round(s_all):
-                    dense = {v: to_dense(v, x) for v, x in s_all.items()}
+                    dense = {
+                        v: to_dense(v, x)
+                        for v, x in s_all.items()
+                        if v in needed
+                    }
                     if edges:
                         flow = {v: dense[v] for v in flow_ids}
                         new, _ = graph._round_fn_pure(flow, tables)
                         dense.update(new)
-                    for trig in triggers:
+                    for trig, touch in triggers:
                         for v, cand in trig(dense).items():
+                            if v not in dense:
+                                raise KeyError(
+                                    f"trigger wrote {v!r} outside its "
+                                    f"declared touches"
+                                )
                             codec, spec = dense_meta[v]
                             merged = codec.merge(spec, dense[v], cand)
                             ok = codec.is_inflation(spec, dense[v], merged)
@@ -531,7 +560,9 @@ class ReplicatedRuntime:
                                 merged,
                                 dense[v],
                             )
-                    return {v: to_wire(v, x) for v, x in dense.items()}
+                    out_row = dict(s_all)
+                    out_row.update({v: to_wire(v, x) for v, x in dense.items()})
+                    return out_row
 
                 swept = jax.vmap(local_round)(dict(states))
                 states = swept
@@ -757,6 +788,65 @@ class ReplicatedRuntime:
         raise TimeoutError(
             f"threshold not met at replica {replica} within {max_rounds} rounds"
         )
+
+    # -- compaction ------------------------------------------------------------
+    def compact_orset(self, var_id: str) -> int:
+        """Reclaim element slots of fully-tombstoned OR-Set entries across
+        the WHOLE replica population — the reclamation the reference's
+        ``waste_pct`` stat cues but never performs
+        (``src/lasp_orset.erl:178-191``).
+
+        Requires divergence 0: while replicas diverge, a tombstone dropped
+        at one replica could be resurrected by a peer whose row still
+        carries the live token. At the join fixed point every row is
+        identical, so a uniform reindex preserves equivalence exactly.
+        Returns slots reclaimed."""
+        if self.divergence(var_id) != 0:
+            raise RuntimeError(
+                f"compact_orset({var_id!r}): population not converged; "
+                "run_to_convergence first (a dropped tombstone could be "
+                "resurrected by a divergent peer)"
+            )
+        for _fn, touch in self._triggers:
+            if touch is None or var_id in touch:
+                raise RuntimeError(
+                    f"compact_orset({var_id!r}): a registered trigger "
+                    "touches this variable — trigger closures typically "
+                    "hold element indices baked in the OLD order "
+                    "(intern_terms results), which compaction reassigns"
+                )
+        var = self.store.variable(var_id)
+        dense = self._to_dense_states(var_id)
+        # the replica population is the authority: liveness comes from a
+        # converged row (all rows identical at divergence 0)
+        row0 = jax.tree_util.tree_map(lambda x: x[0], dense)
+        order, fresh = self.store.compact_plan(var_id, state=row0)
+        reclaimed = len(var.elems) - len(fresh)
+        if not reclaimed:
+            return 0
+        # reindex the store's single-replica state and every replica row
+        var.state = self.store.reindex_orset_state(var.state, order)
+        dense = self.store.reindex_orset_state(dense, order)
+        self.states[var_id] = (
+            jax.vmap(lambda r: FlatORSet.pack(self._packed_specs[var_id], r))(
+                dense
+            )
+            if var_id in self._packed_specs
+            else dense
+        )
+        var.elems = fresh
+        # projection tables derive from element order; rebuild them (shapes
+        # are spec-fixed, so the compiled step does NOT retrace)
+        self.graph.refresh()
+        return reclaimed
+
+    def _to_dense_states(self, var_id: str):
+        if var_id in self._packed_specs:
+            pspec = self._packed_specs[var_id]
+            return jax.vmap(lambda r: FlatORSet.unpack(pspec, r))(
+                self.states[var_id]
+            )
+        return self.states[var_id]
 
     # -- elastic membership ---------------------------------------------------
     def resize(self, new_n: int, new_neighbors, graceful: bool = True) -> None:

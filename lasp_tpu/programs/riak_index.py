@@ -149,29 +149,7 @@ class RiakIndexProgram(Program):
         rows are kept verbatim, including their tombstoned tokens.
 
         Returns the number of slots reclaimed."""
-        import jax.numpy as jnp
-        import numpy as np
-
-        from ..utils.interning import Interner
-
-        var = session.store.variable(self.id)
-        exists = np.asarray(var.state.exists)
-        removed = np.asarray(var.state.removed)
-        live = (exists & ~removed).any(axis=-1)
-        old_terms = var.elems.terms()
-        fresh = Interner(var.spec.n_elems, kind=var.elems.kind)
-        new_ex = np.zeros_like(exists)
-        new_rm = np.zeros_like(removed)
-        for old_idx in np.flatnonzero(live):
-            ni = fresh.intern(old_terms[int(old_idx)])
-            new_ex[ni] = exists[old_idx]
-            new_rm[ni] = removed[old_idx]
-        reclaimed = len(old_terms) - len(fresh)
-        var.elems = fresh
-        var.state = var.state._replace(
-            exists=jnp.asarray(new_ex), removed=jnp.asarray(new_rm)
-        )
-        return reclaimed
+        return session.store.compact_orset(self.id)
 
     def _add_entry(self, session, obj: RiakObject, actor) -> None:
         """Entry keyed by the hashed coordinator vclock (:141-149), so the

@@ -168,9 +168,14 @@ class Store:
     """One local store of named lattice variables (the ``store()`` that every
     ``lasp_core`` function threads through)."""
 
-    def __init__(self, n_actors: int = 16):
+    def __init__(self, n_actors: Optional[int] = None):
+        from ..config import get_config
+
         self._vars: dict[str, Variable] = {}
-        self.n_actors = n_actors  # default per-variable writer capacity
+        # default per-variable writer capacity (LASP_N_ACTORS overridable)
+        self.n_actors = (
+            n_actors if n_actors is not None else get_config().n_actors
+        )
         self._id_counter = itertools.count()
         self.metrics = {"binds": 0, "inflations": 0, "ignored_binds": 0, "reads": 0}
         #: bumped on every effective write; lets the dataflow engine skip
@@ -623,3 +628,78 @@ class Store:
 
     def state(self, id: str):
         return self._vars[id].state
+
+    # -- compaction ----------------------------------------------------------
+    def compact_plan(self, id: str, state=None):
+        """Liveness plan for OR-Set tombstone compaction: ``(order,
+        fresh_interner)`` where ``order`` lists the surviving old element
+        indices in their new positions. ``state`` overrides which dense
+        state is authoritative for liveness (the mesh layer passes a
+        converged replica row; default is this store's own state). Refuses
+        variables whose semantics compaction could break (non-OR-Set
+        types; parked watches hold threshold states indexed by the OLD
+        element order).
+
+        Dropping a fully-tombstoned element row forgets its tombstones,
+        which is sound exactly when no OTHER state can reintroduce those
+        tokens — single-store always, replicated only at divergence 0 (the
+        runtime layer checks that). This is the reclamation the reference's
+        ``waste_pct`` stat cues but never performs
+        (``src/lasp_orset.erl:178-191``)."""
+        import numpy as np
+
+        var = self._vars[id]
+        if var.type_name not in ("lasp_orset", "lasp_orset_gbtree"):
+            raise TypeError(f"compact: {var.type_name} has no tombstones")
+        if var.waiting or var.lazy:
+            raise RuntimeError(
+                f"cannot compact {id}: watches hold old-order thresholds"
+            )
+        if state is None:
+            state = var.state
+        exists = np.asarray(state.exists)
+        removed = np.asarray(state.removed)
+        live = (exists & ~removed).any(axis=-1)
+        order = np.flatnonzero(live)
+        fresh = Interner(var.spec.n_elems, kind=var.elems.kind)
+        terms = var.elems.terms()
+        for i in order:
+            fresh.intern(terms[int(i)])
+        return order, fresh
+
+    @staticmethod
+    def reindex_orset_state(state, order):
+        """Rebuild OR-Set planes with surviving elements moved to the
+        front (live rows kept VERBATIM, including their tombstoned
+        tokens); freed rows are zeroed. Works on any leading batch axes."""
+        import jax
+        import jax.numpy as jnp
+
+        idx = jnp.asarray(order, dtype=jnp.int32)
+        k = len(order)
+
+        def rebuild(plane):
+            fresh = jnp.zeros_like(plane)
+            if k:
+                gathered = jnp.take(plane, idx, axis=-2)
+                fresh = jax.lax.dynamic_update_slice_in_dim(
+                    fresh, gathered, 0, axis=-2
+                )
+            return fresh
+
+        return state._replace(
+            exists=rebuild(state.exists), removed=rebuild(state.removed)
+        )
+
+    def compact_orset(self, id: str) -> int:
+        """Reclaim element slots of fully-tombstoned OR-Set entries in this
+        single-replica store. Returns slots reclaimed. Callers holding a
+        dataflow graph must ``refresh()`` it afterwards (projection tables
+        derive from the element order)."""
+        var = self._vars[id]
+        order, fresh = self.compact_plan(id)
+        reclaimed = len(var.elems) - len(fresh)
+        if reclaimed:
+            var.state = self.reindex_orset_state(var.state, order)
+            var.elems = fresh
+        return reclaimed

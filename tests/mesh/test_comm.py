@@ -1,0 +1,62 @@
+"""mesh_comm: mesh construction, slice-aware layout, single-process
+degradation, and an end-to-end sharded gossip run on the built mesh
+(SURVEY §2.5 communication-backend equivalence; VERDICT r2 item 32)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lasp_tpu.lattice import GSet, GSetSpec, replicate
+from lasp_tpu.mesh import gossip_round, random_regular
+from lasp_tpu.mesh.comm import (
+    build_mesh,
+    init_distributed,
+    n_slices,
+    neighbor_sharding,
+    population_sharding,
+)
+
+
+def test_init_distributed_noop_without_cluster(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert init_distributed() is False
+    assert init_distributed(num_processes=1) is False
+
+
+def test_build_mesh_flat_single_slice():
+    mesh = build_mesh()
+    assert mesh.axis_names == ("slices", "replicas", "state")
+    assert mesh.shape["slices"] == n_slices() == 1
+    assert mesh.shape["replicas"] == 8  # the conftest's 8 virtual devices
+    assert mesh.shape["state"] == 1
+
+
+def test_build_mesh_state_axis_and_validation():
+    mesh = build_mesh(state=2)
+    assert mesh.shape["replicas"] == 4 and mesh.shape["state"] == 2
+    with pytest.raises(ValueError, match="does not divide"):
+        build_mesh(state=3)
+    with pytest.raises(ValueError, match="exceeds"):
+        build_mesh(replicas=8, state=2)
+
+
+def test_sharded_gossip_converges_on_built_mesh():
+    mesh = build_mesh()
+    n, e = 64, 16
+    spec = GSetSpec(n_elems=e)
+    rng = np.random.RandomState(6)
+    states = replicate(GSet.new(spec), n)._replace(
+        mask=jnp.asarray(rng.rand(n, e) < 0.08)
+    )
+    nbrs = jnp.asarray(random_regular(n, 3, seed=6))
+    sh = population_sharding(mesh)
+    sharded = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
+    nbrs_sh = jax.device_put(nbrs, neighbor_sharding(mesh))
+    step = jax.jit(lambda s, nb: gossip_round(GSet, spec, s, nb))
+    out = sharded
+    for _ in range(8):
+        out = step(out, nbrs_sh)
+    expect = np.asarray(states.mask).any(axis=0)
+    assert (np.asarray(out.mask) == expect[None, :]).all()

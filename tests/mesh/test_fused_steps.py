@@ -109,3 +109,117 @@ def test_edge_failure_mask_respected_in_fused_path():
     rt.run_to_convergence(block=4, edge_mask=alive)
     assert rt.coverage_value("s") == {"e"}
     assert rt.divergence("s") == 0
+
+
+def test_trigger_touch_sets_keep_untouched_vars_packed():
+    """A trigger with a declared touch set must behave identically to an
+    undeclared one, and writing outside the declared set fails loudly."""
+    import jax.numpy as jnp
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.store import Store
+
+    def build(touches):
+        store = Store(n_actors=2)
+        graph = Graph(store)
+        store.declare(id="watched", type="riak_dt_gcounter")
+        store.declare(id="target", type="lasp_orset", n_elems=4, n_actors=1,
+                      tokens_per_actor=1)
+        store.declare(id="bystander", type="lasp_orset", n_elems=4)
+        rt = ReplicatedRuntime(store, graph, 8, ring(8, 2), packed=True)
+        rt.update_batch("target", [(0, ("add", "ad"), "p")])
+        rt.update_batch("bystander", [(3, ("add", "b"), "p")])
+        rt.update_batch("watched", [(1, ("increment", 2), "c")])
+        idx = rt.intern_terms("target", ["ad"])
+
+        def trig(dense):
+            over = jnp.sum(dense["watched"].counts, dtype=jnp.int32) >= 2
+            st = dense["target"]
+            mask = jnp.zeros((4,), bool).at[jnp.asarray(idx)].set(over)
+            return {"target": st._replace(
+                removed=st.removed | (st.exists & mask[:, None]))}
+
+        rt.register_trigger(trig, touches=touches)
+        rt.run_to_convergence(block=4)
+        return rt
+
+    declared = build(["watched", "target"])
+    universal = build(None)
+    for v in ("watched", "target", "bystander"):
+        assert declared.coverage_value(v) == universal.coverage_value(v)
+        assert declared.divergence(v) == 0
+    assert declared.coverage_value("target") == frozenset()
+    assert declared.coverage_value("bystander") == {"b"}
+
+    # writes outside the declared set are a loud trace-time error
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="a", type="lasp_gset", n_elems=2)
+    store.declare(id="b", type="lasp_gset", n_elems=2)
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 1))
+
+    def rogue(dense):
+        return {"b": dense["a"]}  # "b" never declared
+
+    rt.register_trigger(rogue, touches=["a"])
+    with pytest.raises(KeyError, match="outside its declared touches"):
+        rt.step()
+
+
+def test_runtime_compact_orset_reclaims_after_convergence():
+    from lasp_tpu.store import Store
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.utils.interning import CapacityError
+
+    for packed in (False, True):
+        store = Store(n_actors=2)
+        graph = Graph(store)
+        store.declare(id="s", type="lasp_orset", n_elems=4, n_actors=2,
+                      tokens_per_actor=2)
+        rt = ReplicatedRuntime(store, graph, 8, ring(8, 2), packed=packed)
+        rt.update_batch("s", [(0, ("add", f"e{i}"), "w") for i in range(4)])
+        rt.run_to_convergence()
+        rt.update_batch("s", [(0, ("remove_all", ["e0", "e1", "e2"]), "w")])
+        # not converged yet: compaction must refuse
+        with pytest.raises(RuntimeError, match="not converged"):
+            rt.compact_orset("s")
+        rt.run_to_convergence()
+        assert rt.compact_orset("s") == 3
+        assert rt.coverage_value("s") == {"e3"}
+        assert rt.divergence("s") == 0
+        # reclaimed slots are usable again (would CapacityError before)
+        rt.update_batch("s", [(2, ("add_all", ["f1", "f2", "f3"]), "w")])
+        rt.run_to_convergence()
+        assert rt.coverage_value("s") == {"e3", "f1", "f2", "f3"}, f"packed={packed}"
+
+
+def test_store_compact_orset_single_replica():
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    store.declare(id="s", type="lasp_orset", n_elems=3)
+    for e in ("a", "b", "c"):
+        store.update("s", ("add", e), "w")
+    store.update("s", ("remove_all", ["a", "b"]), "w")
+    assert store.compact_orset("s") == 2
+    assert store.value("s") == {"c"}
+    store.update("s", ("add", "d"), "w")  # reclaimed slot
+    store.update("s", ("add", "e"), "w")
+    assert store.value("s") == {"c", "d", "e"}
+
+
+def test_compact_refuses_trigger_touched_variable():
+    """Trigger closures hold element indices baked in the old order
+    (intern_terms results) — compaction must refuse, loudly."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="s", type="lasp_orset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 4, ring(4, 1))
+    rt.update_batch("s", [(0, ("add", "e"), "w")])
+    rt.register_trigger(lambda dense: {}, touches=["s"])
+    rt.run_to_convergence(block=4)
+    with pytest.raises(RuntimeError, match="trigger"):
+        rt.compact_orset("s")

@@ -1,0 +1,57 @@
+"""Unified config (SURVEY §5 config/flag system; VERDICT item 31):
+typed defaults, LASP_* env overrides, loud rejection of typos."""
+
+import pytest
+
+from lasp_tpu.config import LaspConfig
+
+
+def test_defaults_validate():
+    cfg = LaspConfig().validate()
+    assert cfg.n_actors == 16 and cfg.gossip_impl == "auto"
+
+
+def test_env_overrides_and_types():
+    cfg = LaspConfig.from_env(
+        {
+            "LASP_N_ACTORS": "32",
+            "LASP_GOSSIP_IMPL": "xla",
+            "LASP_BENCH_REPLICAS": "4096",
+            "UNRELATED": "x",
+        }
+    ).validate()
+    assert cfg.n_actors == 32
+    assert cfg.gossip_impl == "xla"
+    assert cfg.bench_replicas == 4096
+
+
+def test_unknown_lasp_var_rejected():
+    with pytest.raises(ValueError, match="unknown config variable"):
+        LaspConfig.from_env({"LASP_N_ACTRS": "8"})  # typo must be loud
+
+
+def test_driver_owned_knobs_pass_through():
+    cfg = LaspConfig.from_env(
+        {"LASP_BENCH_PROBE_WINDOW": "10", "LASP_DRYRUN_TIMEOUT": "60"}
+    )
+    assert cfg == LaspConfig()
+
+
+def test_validation_rejects_bad_values():
+    with pytest.raises(ValueError, match="gossip_impl"):
+        LaspConfig(gossip_impl="mosaic").validate()
+    with pytest.raises(ValueError, match="fanout"):
+        LaspConfig(fanout=0).validate()
+
+
+def test_store_uses_config_default(monkeypatch):
+    import lasp_tpu.config as config_mod
+    from lasp_tpu.store import Store
+
+    monkeypatch.setattr(config_mod, "_CONFIG", None)
+    monkeypatch.setenv("LASP_N_ACTORS", "5")
+    try:
+        assert Store().n_actors == 5
+        assert Store(n_actors=9).n_actors == 9
+    finally:
+        monkeypatch.setattr(config_mod, "_CONFIG", None)
