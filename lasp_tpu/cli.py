@@ -43,9 +43,14 @@ def cmd_simulate(args) -> int:
     rt = ReplicatedRuntime(
         store, Graph(store), args.replicas, topo(args.replicas, args.fanout)
     )
-    for w in range(args.writers):
-        replica = (w * args.replicas) // args.writers
-        rt.update_at(replica, var, ("add", f"item{w}"), f"writer{w}")
+    # one batched dispatch for all client writes, not a per-op host loop
+    rt.update_batch(
+        var,
+        [
+            ((w * args.replicas) // args.writers, ("add", f"item{w}"), f"writer{w}")
+            for w in range(args.writers)
+        ],
+    )
     from lasp_tpu.config import get_config
 
     rounds = rt.run_to_convergence(
