@@ -11,18 +11,25 @@ Layer map (mirrors SURVEY.md §1, redesigned per §7):
 - ``lasp_tpu.api``     — the public Lasp verb set (L4)
 - ``lasp_tpu.programs``— distributed incremental programs (L5)
 - ``lasp_tpu.ops``     — Pallas/packed kernels for the hot merge path
-- ``lasp_tpu.utils``   — config, metrics, interning
+- ``lasp_tpu.bridge``  — Erlang↔Python backend bridge (north-star, §7.6)
+- ``lasp_tpu.config``  — unified typed configuration (LASP_* env overrides)
+- ``lasp_tpu.utils``   — metrics, interning
 """
 
 __version__ = "0.1.0"
 
-from . import api, dataflow, lattice, mesh, ops, programs, store
+from . import api, bridge, config, dataflow, lattice, mesh, ops, programs, store
 from .api import Session
+from .config import LaspConfig, get_config
 
 __all__ = [
+    "LaspConfig",
     "Session",
     "api",
+    "bridge",
+    "config",
     "dataflow",
+    "get_config",
     "lattice",
     "mesh",
     "ops",

@@ -150,7 +150,14 @@ class ReplicatedRuntime:
 
         Edge tables are traced arguments of the compiled step, so interner
         growth here does NOT trigger a recompile — only an edge-count or
-        table-shape change does (shapes are fixed by the declared specs)."""
+        table-shape change does (shapes are fixed by the declared specs).
+
+        Actor discipline for vclock types (riak_dt_orswot / riak_dt_map):
+        an actor is a WRITER IDENTITY — two replicas minting dots under
+        the same actor produce colliding counters that the vclock
+        domination rule reads as observed-and-removed (silent element
+        loss). Use one actor per writing replica, exactly as riak_dt
+        requires of the reference."""
         if var_id not in self.states:
             self._sync_graph()
         var = self.store.variable(var_id)
