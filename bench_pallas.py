@@ -22,6 +22,17 @@ def main():
     from lasp_tpu.ops import PackedORSet, PackedORSetSpec
     from lasp_tpu.ops.pallas_gossip import flatten_plane, pallas_gossip_round
 
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        # Mosaic only compiles on TPU; anywhere else we would crash in
+        # lowering (GPU) or time the interpret-mode emulator (CPU)
+        print(
+            json.dumps(
+                {"error": "bench_pallas needs a TPU; platform is "
+                          f"{jax.devices()[0].platform!r}"}
+            )
+        )
+        return
+
     configs = [
         # (replicas, n_elems, words-per-elem tag via tokens)
         (1 << 15, 128, 32),   # wide rows: 128 elems x 8 words = 4KB/row

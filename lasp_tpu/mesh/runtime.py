@@ -195,14 +195,83 @@ class ReplicatedRuntime:
         states = self.states[var_id]
         if not ops:
             return
+        # interner overflow must follow the same per-op prefix semantics as
+        # pool/precondition failures: find the longest op prefix whose NEW
+        # terms/actors fit, apply only that, then raise
+        n_fit, cap_err = self._capacity_prefix(var, tn, ops)
+        if cap_err is not None:
+            ops = ops[:n_fit]
         try:
-            self._dispatch_batch(var, tn, states, ops)
+            if ops:
+                self._dispatch_batch(var, tn, states, ops)
         finally:
             # a mid-batch CapacityError/PreconditionError persists the ops
             # before the failure (sequential semantics) — their interned
             # terms must still fold into the edge tables, or a caller that
             # catches the error sweeps with stale projections
             self.graph.refresh()
+        if cap_err is not None:
+            raise cap_err
+
+    @staticmethod
+    def _capacity_prefix(var, tn, ops):
+        """``(n_ops, err)``: the longest op prefix whose term/actor
+        interning fits the declared universes, and the ``CapacityError``
+        the first overflowing op would raise (or None). Walked BEFORE any
+        interning so a mid-batch overflow leaves exactly the per-op-loop
+        state: earlier ops applied, the overflowing op untouched."""
+        from ..utils.interning import CapacityError
+
+        free_e = (
+            var.elems.capacity - len(var.elems) if var.elems is not None else None
+        )
+        free_a = (
+            var.actors.capacity - len(var.actors)
+            if var.actors is not None
+            else None
+        )
+        seen_e: set = set()
+        seen_a: set = set()
+        for k, (_r, op, actor) in enumerate(ops):
+            verb = op[0]
+            need_e: list = []
+            need_a: list = []
+            if tn == "riak_dt_gcounter":
+                if actor not in var.actors and actor not in seen_a:
+                    need_a = [actor]
+            elif verb in ("add", "add_all"):
+                terms = op[1] if verb == "add_all" else [op[1]]
+                need_e = [
+                    t
+                    for t in dict.fromkeys(terms)
+                    if t not in var.elems and t not in seen_e
+                ]
+                if (
+                    tn != "lasp_gset"
+                    and var.actors is not None
+                    and actor not in var.actors
+                    and actor not in seen_a
+                ):
+                    need_a = [actor]
+            if free_e is not None and need_e and len(need_e) > free_e:
+                return k, CapacityError(
+                    f"{var.elems.kind} universe full ({var.elems.capacity}); "
+                    f"cannot intern {need_e[free_e]!r} — declare the variable "
+                    f"with a larger capacity"
+                )
+            if free_a is not None and need_a and len(need_a) > free_a:
+                return k, CapacityError(
+                    f"{var.actors.kind} universe full ({var.actors.capacity});"
+                    f" cannot intern {need_a[free_a]!r} — declare the variable"
+                    f" with a larger capacity"
+                )
+            if free_e is not None:
+                free_e -= len(need_e)
+            if free_a is not None:
+                free_a -= len(need_a)
+            seen_e.update(need_e)
+            seen_a.update(need_a)
+        return len(ops), None
 
     def _dispatch_batch(self, var, tn, states, ops) -> None:
         var_id = var.id
@@ -247,6 +316,8 @@ class ReplicatedRuntime:
                 self.states[var_id] = states._replace(mask=mask)
         elif tn in ("lasp_orset", "lasp_orset_gbtree"):
             self._orset_batch(var, ops)
+        elif tn == "riak_dt_orswot":
+            self._orswot_batch(var, ops)
         else:
             raise ValueError(
                 f"update_batch: unsupported type {tn!r} (use update_at)"
@@ -263,19 +334,23 @@ class ReplicatedRuntime:
         affected rows' pools to the host — O(batch), never O(population).
 
         On a mid-batch failure (exhausted pool / not_present), every op
-        BEFORE the failing one persists and the error then raises —
-        exactly the state a per-op loop would leave."""
+        BEFORE the failing one persists, the failing op applies NOTHING of
+        itself (not even earlier terms of its own add_all/remove_all — the
+        per-op path's ``_apply_op`` raises before the merge, so the whole
+        op is atomic), and the error then raises — exactly the state a
+        per-op loop would leave."""
         spec = var.spec
         k = spec.tokens_per_actor
-        # split into maximal same-verb phases, preserving op order
+        # split into maximal same-verb phases, preserving op order; every
+        # item carries its op index (the per-op atomicity boundary)
         phases: list[tuple[str, list]] = []
-        for r, op, actor in ops:
+        for opk, (r, op, actor) in enumerate(ops):
             verb = op[0]
             if verb in ("add", "add_all"):
                 kind = "add"
                 a = var.actors.intern(actor)
                 terms = op[1] if verb == "add_all" else [op[1]]
-                items = [(r, var.elems.intern(e), a * k, e) for e in terms]
+                items = [(r, var.elems.intern(e), a * k, e, opk) for e in terms]
             elif verb in ("remove", "remove_all"):
                 kind = "remove"
                 terms = op[1] if verb == "remove_all" else [op[1]]
@@ -283,7 +358,7 @@ class ReplicatedRuntime:
                 # POSITION in the sequence (earlier ops persist first) —
                 # index -1 marks it; the phase application forces live=False
                 items = [
-                    (r, var.elems.index_of(e) if e in var.elems else -1, e)
+                    (r, var.elems.index_of(e) if e in var.elems else -1, e, opk)
                     for e in terms
                 ]
             else:
@@ -315,6 +390,9 @@ class ReplicatedRuntime:
                     exists[rows[:, None], elems[:, None], pool_idx]
                 )
                 allocs, err = self._alloc_pool_slots(var.id, items, gathered, k)
+                # allocs is a 1:1 prefix of items, so the same per-op trim
+                # as the remove phases applies (failing op discarded whole)
+                allocs = allocs[: self._atomic_prefix(items, len(allocs), err)]
                 if allocs:
                     idx = (
                         np.asarray([items[i][0] for i, _ in allocs], dtype=np.int32),
@@ -336,9 +414,10 @@ class ReplicatedRuntime:
                 )
                 live = live & valid
                 n_ok, err = self._check_removes(items, live)
-                if n_ok:
-                    ok_r = rows[:n_ok]
-                    ok_e = elems[:n_ok]
+                ok_count = self._atomic_prefix(items, n_ok, err)
+                if ok_count:
+                    ok_r = rows[:ok_count]
+                    ok_e = elems[:ok_count]  # all >= 0: they passed the check
                     removed = removed.at[ok_r, ok_e].set(
                         removed[ok_r, ok_e] | exists[ok_r, ok_e]
                     )
@@ -346,6 +425,19 @@ class ReplicatedRuntime:
                     flush(exists, removed)
                     raise err
         flush(exists, removed)
+
+    @staticmethod
+    def _atomic_prefix(items, n_ok: int, err) -> int:
+        """Shrink a validated item prefix to whole ops: when item ``n_ok``
+        fails, its OWN op's earlier items must be discarded too (per-op
+        atomicity; items carry their op index last). The ONE trim rule for
+        the add and remove phases of both the dense and packed paths."""
+        if err is None:
+            return n_ok
+        fail_op = items[n_ok][-1]
+        while n_ok and items[n_ok - 1][-1] == fail_op:
+            n_ok -= 1
+        return n_ok
 
     @staticmethod
     def _alloc_pool_slots(var_id: str, items, pools: np.ndarray, k: int):
@@ -365,7 +457,8 @@ class ReplicatedRuntime:
 
         pool_state: dict[tuple, np.ndarray] = {}
         allocs: list[tuple[int, int]] = []
-        for i, (r, e, base, term) in enumerate(items):
+        for i, item in enumerate(items):
+            r, e, base, term = item[:4]
             key = (int(r), int(e), int(base))
             pool = pool_state.setdefault(key, pools[i].copy())
             free = np.flatnonzero(~pool)
@@ -390,12 +483,125 @@ class ReplicatedRuntime:
         from ..store.store import PreconditionError
 
         seen: set[tuple[int, int]] = set()
-        for i, (r, e, term) in enumerate(items):
+        for i, item in enumerate(items):
+            r, e, term = item[:3]
             key = (int(r), int(e))
             if key in seen or not live[i]:
                 return i, PreconditionError(f"not_present: {term!r}")
             seen.add(key)
         return len(items), None
+
+    def _orswot_batch(self, var, ops) -> None:
+        """Batched OR-SWOT adds/removes with SEQUENTIAL, PER-OP-ATOMIC
+        semantics, host-simulated then applied in O(batch) device scatters.
+
+        The riak_dt_orswot rules per op: ``add`` bumps the (replica,
+        actor) clock and REPLACES the element's dots with the fresh single
+        dot; ``remove`` requires presence (not_present otherwise). A
+        failing op applies NOTHING of itself — not even earlier terms of
+        its own add_all/remove_all — while every op before it persists:
+        exactly the state the per-op ``update_at`` loop leaves (its
+        ``_apply_op`` raises before the merge). Presence evolves WITHIN
+        the batch (an add earlier in the list satisfies a later remove's
+        precondition), so the simulation walks ops in order over a host
+        overlay of only the touched (replica, element) entries."""
+        from ..store.store import PreconditionError
+
+        states = self.states[var.id]
+        # normalize to flat (kind, replica, elem_idx, actor_idx, term,
+        # op_index) items; op_index delimits per-op atomicity
+        flat: list[tuple] = []
+        for k, (r, op, actor) in enumerate(ops):
+            verb = op[0]
+            if verb in ("add", "add_all"):
+                a = var.actors.intern(actor)
+                terms = op[1] if verb == "add_all" else [op[1]]
+                flat.extend(
+                    ("add", r, var.elems.intern(e), a, e, k) for e in terms
+                )
+            elif verb in ("remove", "remove_all"):
+                terms = op[1] if verb == "remove_all" else [op[1]]
+                flat.extend(
+                    (
+                        "remove",
+                        r,
+                        var.elems.index_of(e) if e in var.elems else -1,
+                        -1,
+                        e,
+                        k,
+                    )
+                    for e in terms
+                )
+            else:
+                raise ValueError(f"update_batch: unsupported op {op!r}")
+        if not flat:
+            return
+        # gather the touched entries' dots + clocks in two vectorized pulls
+        pairs = sorted({(int(r), int(e)) for _k, r, e, *_ in flat if e >= 0})
+        actors = sorted({(int(r), int(a)) for _k, r, _e, a, *_ in flat if a >= 0})
+        pr = np.asarray([p[0] for p in pairs], dtype=np.int32)
+        pe = np.asarray([p[1] for p in pairs], dtype=np.int32)
+
+        def fresh_overlays():
+            dot_rows = {
+                p: np.array(d)
+                for p, d in zip(pairs, np.asarray(states.dots[pr, pe]))
+            } if pairs else {}
+            if actors:
+                cr = np.asarray([a[0] for a in actors], dtype=np.int32)
+                ca = np.asarray([a[1] for a in actors], dtype=np.int32)
+                gathered = np.asarray(states.clock[cr, ca])
+                clocks = {a: int(c) for a, c in zip(actors, gathered)}
+            else:
+                clocks = {}
+            return dot_rows, clocks
+
+        def apply_one(item, dot_rows, clocks):
+            """One item against the overlays; returns the PreconditionError
+            a failing remove would raise (or None). The ONE copy of the
+            mint-dot / zero-dots semantics for both passes."""
+            kind, r, e, a, term, _k = item
+            if kind == "add":
+                key = (int(r), int(a))
+                clocks[key] += 1
+                row = np.zeros_like(dot_rows[(int(r), int(e))])
+                row[int(a)] = clocks[key]
+                dot_rows[(int(r), int(e))] = row
+                return None
+            if e < 0 or not (dot_rows[(int(r), int(e))] > 0).any():
+                return PreconditionError(f"not_present: {term!r}")
+            dot_rows[(int(r), int(e))][:] = 0
+            return None
+
+        # pass 1: simulate to find the first failing OP (if any)
+        dot_rows, clocks = fresh_overlays()
+        fail_op = None
+        err = None
+        for item in flat:
+            err = apply_one(item, dot_rows, clocks)
+            if err is not None:
+                fail_op = item[5]
+                break
+        if err is not None:
+            # pass 2: replay ONLY the ops before the failing op (per-op
+            # atomicity: the failing op's earlier terms are discarded too)
+            dot_rows, clocks = fresh_overlays()
+            for item in flat:
+                if item[5] >= fail_op:
+                    break
+                apply_one(item, dot_rows, clocks)
+        dots, clock = states.dots, states.clock
+        if dot_rows:
+            vals = np.stack([dot_rows[p] for p in pairs])
+            dots = dots.at[pr, pe].set(vals.astype(np.asarray(dots).dtype))
+        if clocks:
+            cr = np.asarray([k[0] for k in clocks], dtype=np.int32)
+            ca = np.asarray([k[1] for k in clocks], dtype=np.int32)
+            cv = np.asarray(list(clocks.values()))
+            clock = clock.at[cr, ca].set(cv.astype(np.asarray(clock).dtype))
+        self.states[var.id] = states._replace(clock=clock, dots=dots)
+        if err is not None:
+            raise err
 
     def _elem_word_masks(self, var_id: str) -> np.ndarray:
         """uint32[E, W]: per-element word masks of the flat bit layout
@@ -444,6 +650,8 @@ class ReplicatedRuntime:
                 gathered = np.asarray(exists[rows[:, None], words])
                 pools = ((gathered >> shifts.astype(np.uint32)) & 1).astype(bool)
                 allocs, err = self._alloc_pool_slots(var.id, items, pools, k)
+                # same per-op trim as the dense path (allocs ≡ item prefix)
+                allocs = allocs[: self._atomic_prefix(items, len(allocs), err)]
                 # (row, word) -> mask of freshly minted bits, duplicates
                 # pre-combined so the scatter below is race-free
                 set_masks: dict[tuple[int, int], int] = {}
@@ -469,11 +677,12 @@ class ReplicatedRuntime:
                 live = ((ex_rows & ~rm_rows) & elem_masks[safe]).any(axis=-1)
                 live = live & valid
                 n_ok, err = self._check_removes(items, live)
-                if n_ok:
+                ok_count = self._atomic_prefix(items, n_ok, err)
+                if ok_count:
                     # combine per-row tombstone masks (duplicate rows fine
                     # across DIFFERENT elements)
                     per_row: dict[int, np.ndarray] = {}
-                    for r, e, _term in items[:n_ok]:
+                    for r, e, _term, _opk in items[:ok_count]:
                         m = per_row.setdefault(
                             int(r), np.zeros(pspec.n_words, np.uint32)
                         )

@@ -5,6 +5,7 @@ VERDICT/ADVICE round-1 items: client writes must not re-jit the step
 vectorized update path instead of per-op host round-trips.
 """
 
+import numpy as np
 import pytest
 
 from lasp_tpu.dataflow import Graph
@@ -283,3 +284,167 @@ def test_remove_of_unknown_term_fails_at_its_position_packed():
             "s", [(1, ("add", "kept"), "w"), (1, ("remove", "ghost"), "w")]
         )
     assert rt.replica_value("s", 1) == {"kept"}
+
+
+# -- batched OR-SWOT ----------------------------------------------------------
+
+def test_update_batch_orswot_matches_sequential():
+    def build():
+        store = Store(n_actors=8)
+        graph = Graph(store)
+        store.declare(id="s", type="riak_dt_orswot", n_elems=8, n_actors=8)
+        return ReplicatedRuntime(store, graph, 4, ring(4, 1))
+
+    ops = [
+        (0, ("add", "x"), "w0"),
+        (0, ("add_all", ["y", "z"]), "w0"),
+        (1, ("add", "x"), "w1"),
+        (0, ("remove", "y"), "w0"),
+        (0, ("add", "y"), "w2"),       # re-add after remove, fresh dot
+        (2, ("add", "q"), "w3"),
+        (2, ("remove", "q"), "w3"),    # add earlier in batch enables remove
+    ]
+    rt1, rt2 = build(), build()
+    for r, op, actor in ops:
+        rt1.update_at(r, "s", op, actor)
+    rt2.update_batch("s", ops)
+    import jax
+
+    for r in range(4):
+        s1 = jax.tree_util.tree_map(lambda x: x[r], rt1.states["s"])
+        s2 = jax.tree_util.tree_map(lambda x: x[r], rt2.states["s"])
+        assert (np.asarray(s1.clock) == np.asarray(s2.clock)).all(), r
+        assert (np.asarray(s1.dots) == np.asarray(s2.dots)).all(), r
+    rt2.run_to_convergence()
+    assert rt2.coverage_value("s") == {"x", "y", "z"}
+
+
+def test_update_batch_orswot_midbatch_precondition():
+    from lasp_tpu.store.store import PreconditionError
+
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    store.declare(id="s", type="riak_dt_orswot", n_elems=4, n_actors=4)
+    rt = ReplicatedRuntime(store, graph, 2, ring(2, 1))
+    with pytest.raises(PreconditionError, match="ghost"):
+        rt.update_batch(
+            "s",
+            [(0, ("add", "kept"), "w"),
+             (0, ("remove", "ghost"), "w"),
+             (0, ("add", "never-applied"), "w")],
+        )
+    assert rt.replica_value("s", 0) == {"kept"}
+    # removing an element another replica added (not yet gossiped) also
+    # fails the local precondition
+    with pytest.raises(PreconditionError):
+        rt.update_batch("s", [(1, ("remove", "kept"), "w")])
+    rt.run_to_convergence()
+    assert rt.coverage_value("s") == {"kept"}
+
+
+# -- per-op atomicity + capacity-prefix parity with update_at ----------------
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_failing_multiterm_op_is_atomic_like_update_at(packed):
+    """A failing remove_all applies NOTHING of itself (update_at raises
+    before merging the candidate), while prior ops persist."""
+    def build():
+        store = Store(n_actors=4)
+        graph = Graph(store)
+        store.declare(id="s", type="lasp_orset", n_elems=8)
+        return ReplicatedRuntime(store, graph, 2, ring(2, 1), packed=packed)
+
+    from lasp_tpu.store.store import PreconditionError
+
+    ops = [
+        (0, ("add_all", ["a", "b"]), "w0"),
+        (0, ("remove_all", ["a", "ghost"]), "w0"),
+    ]
+    rt1, rt2 = build(), build()
+    with pytest.raises(PreconditionError):
+        for r, op, actor in ops:
+            rt1.update_at(r, "s", op, actor)
+    with pytest.raises(PreconditionError):
+        rt2.update_batch("s", ops)
+    assert rt1.replica_value("s", 0) == rt2.replica_value("s", 0) == {"a", "b"}
+
+
+def test_failing_multiterm_orswot_op_is_atomic():
+    from lasp_tpu.store.store import PreconditionError
+
+    def build():
+        store = Store(n_actors=4)
+        graph = Graph(store)
+        store.declare(id="s", type="riak_dt_orswot", n_elems=8, n_actors=4)
+        return ReplicatedRuntime(store, graph, 2, ring(2, 1))
+
+    ops = [
+        (0, ("add_all", ["a", "b"]), "w0"),
+        (0, ("remove_all", ["a", "ghost"]), "w0"),
+    ]
+    rt1, rt2 = build(), build()
+    with pytest.raises(PreconditionError):
+        for r, op, actor in ops:
+            rt1.update_at(r, "s", op, actor)
+    with pytest.raises(PreconditionError):
+        rt2.update_batch("s", ops)
+    import jax
+
+    s1 = jax.tree_util.tree_map(lambda x: x[0], rt1.states["s"])
+    s2 = jax.tree_util.tree_map(lambda x: x[0], rt2.states["s"])
+    assert (np.asarray(s1.dots) == np.asarray(s2.dots)).all()
+    assert (np.asarray(s1.clock) == np.asarray(s2.clock)).all()
+    assert rt2.replica_value("s", 0) == {"a", "b"}
+
+
+def test_interner_overflow_mid_batch_applies_op_prefix():
+    """CapacityError from term interning follows the same per-op prefix
+    rule: earlier ops persist, the overflowing op applies nothing."""
+    from lasp_tpu.utils.interning import CapacityError
+
+    def build():
+        store = Store(n_actors=4)
+        graph = Graph(store)
+        store.declare(id="s", type="lasp_orset", n_elems=3)
+        return ReplicatedRuntime(store, graph, 2, ring(2, 1))
+
+    ops = [
+        (0, ("add", "e1"), "w"),
+        (1, ("add_all", ["e2", "e3"]), "w"),
+        (0, ("add_all", ["e2", "e4"]), "w"),  # e4 overflows n_elems=3
+        (0, ("add", "never"), "w"),
+    ]
+    rt1, rt2 = build(), build()
+    with pytest.raises(CapacityError):
+        for r, op, actor in ops:
+            rt1.update_at(r, "s", op, actor)
+    with pytest.raises(CapacityError):
+        rt2.update_batch("s", ops)
+    for r in range(2):
+        assert rt1.replica_value("s", r) == rt2.replica_value("s", r), r
+    assert rt2.replica_value("s", 0) == {"e1"}
+    assert rt2.replica_value("s", 1) == {"e2", "e3"}
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_add_all_exhausting_pool_is_atomic(packed):
+    """An add_all whose LATER term exhausts the token pool must discard
+    its own earlier allocations too (update_at applies ops atomically)."""
+    def build():
+        store = Store(n_actors=2)
+        graph = Graph(store)
+        store.declare(id="s", type="lasp_orset", n_elems=8, n_actors=2,
+                      tokens_per_actor=1)
+        return ReplicatedRuntime(store, graph, 2, ring(2, 1), packed=packed)
+
+    ops = [
+        (0, ("add", "x"), "w"),
+        (0, ("add_all", ["y", "x"]), "w"),  # second add of x: pool of 1 full
+    ]
+    rt1, rt2 = build(), build()
+    with pytest.raises(CapacityError):
+        for r, op, actor in ops:
+            rt1.update_at(r, "s", op, actor)
+    with pytest.raises(CapacityError):
+        rt2.update_batch("s", ops)
+    assert rt1.replica_value("s", 0) == rt2.replica_value("s", 0) == {"x"}
