@@ -187,7 +187,21 @@ class ReplicatedRuntime:
         always inflations, so the bind gate (``src/lasp_core.erl:301-311``)
         is vacuous for them; removes check the not_present precondition
         against the target row exactly like ``store.update`` does."""
-        ops = list(ops)
+        # materialize multi-term payloads ONCE: the capacity walk and the
+        # dispatch both iterate them, and a one-shot iterator would arrive
+        # at the dispatch already drained (silent data loss)
+        ops = [
+            (
+                r,
+                (op[0], list(op[1]), *op[2:])
+                if isinstance(op, tuple)
+                and len(op) > 1
+                and op[0] in ("add_all", "remove_all")
+                else op,
+                actor,
+            )
+            for r, op, actor in ops
+        ]
         var = self.store.variable(var_id)
         if var_id not in self.states:
             self._sync_graph()
@@ -503,93 +517,60 @@ class ReplicatedRuntime:
         exactly the state the per-op ``update_at`` loop leaves (its
         ``_apply_op`` raises before the merge). Presence evolves WITHIN
         the batch (an add earlier in the list satisfies a later remove's
-        precondition), so the simulation walks ops in order over a host
-        overlay of only the touched (replica, element) entries."""
-        from ..store.store import PreconditionError
-
+        precondition), so a TERM-LEVEL precheck walks ops in order first —
+        before ANY interning, so a failing batch leaves the interners
+        exactly as the per-op loop would (ops past the failure never
+        consume element/actor slots) — and the surviving op prefix is then
+        applied over a host overlay of only the touched entries."""
+        fail_op, err = self._orswot_precheck(var, ops)
+        if err is not None:
+            ops = ops[:fail_op]
+        if not ops:
+            if err is not None:
+                raise err
+            return
         states = self.states[var.id]
-        # normalize to flat (kind, replica, elem_idx, actor_idx, term,
-        # op_index) items; op_index delimits per-op atomicity
+        # normalize to flat (kind, replica, elem_idx, actor_idx, term)
+        # items — every op in the prefix is now known to succeed
         flat: list[tuple] = []
-        for k, (r, op, actor) in enumerate(ops):
+        for r, op, actor in ops:
             verb = op[0]
             if verb in ("add", "add_all"):
                 a = var.actors.intern(actor)
                 terms = op[1] if verb == "add_all" else [op[1]]
-                flat.extend(
-                    ("add", r, var.elems.intern(e), a, e, k) for e in terms
-                )
-            elif verb in ("remove", "remove_all"):
+                flat.extend(("add", r, var.elems.intern(e), a) for e in terms)
+            else:
                 terms = op[1] if verb == "remove_all" else [op[1]]
                 flat.extend(
-                    (
-                        "remove",
-                        r,
-                        var.elems.index_of(e) if e in var.elems else -1,
-                        -1,
-                        e,
-                        k,
-                    )
-                    for e in terms
+                    ("remove", r, var.elems.index_of(e), -1) for e in terms
                 )
-            else:
-                raise ValueError(f"update_batch: unsupported op {op!r}")
-        if not flat:
-            return
         # gather the touched entries' dots + clocks in two vectorized pulls
-        pairs = sorted({(int(r), int(e)) for _k, r, e, *_ in flat if e >= 0})
-        actors = sorted({(int(r), int(a)) for _k, r, _e, a, *_ in flat if a >= 0})
+        pairs = sorted({(int(r), int(e)) for _k, r, e, _a in flat})
+        actors = sorted({(int(r), int(a)) for _k, r, _e, a in flat if a >= 0})
         pr = np.asarray([p[0] for p in pairs], dtype=np.int32)
         pe = np.asarray([p[1] for p in pairs], dtype=np.int32)
-
-        def fresh_overlays():
-            dot_rows = {
-                p: np.array(d)
-                for p, d in zip(pairs, np.asarray(states.dots[pr, pe]))
-            } if pairs else {}
-            if actors:
-                cr = np.asarray([a[0] for a in actors], dtype=np.int32)
-                ca = np.asarray([a[1] for a in actors], dtype=np.int32)
-                gathered = np.asarray(states.clock[cr, ca])
-                clocks = {a: int(c) for a, c in zip(actors, gathered)}
-            else:
-                clocks = {}
-            return dot_rows, clocks
-
-        def apply_one(item, dot_rows, clocks):
-            """One item against the overlays; returns the PreconditionError
-            a failing remove would raise (or None). The ONE copy of the
-            mint-dot / zero-dots semantics for both passes."""
-            kind, r, e, a, term, _k = item
+        dot_rows = {
+            p: np.array(d)
+            for p, d in zip(pairs, np.asarray(states.dots[pr, pe]))
+        } if pairs else {}
+        if actors:
+            cr = np.asarray([a[0] for a in actors], dtype=np.int32)
+            ca = np.asarray([a[1] for a in actors], dtype=np.int32)
+            clocks = {
+                a: int(c)
+                for a, c in zip(actors, np.asarray(states.clock[cr, ca]))
+            }
+        else:
+            clocks = {}
+        for kind, r, e, a in flat:
             if kind == "add":
                 key = (int(r), int(a))
                 clocks[key] += 1
                 row = np.zeros_like(dot_rows[(int(r), int(e))])
                 row[int(a)] = clocks[key]
                 dot_rows[(int(r), int(e))] = row
-                return None
-            if e < 0 or not (dot_rows[(int(r), int(e))] > 0).any():
-                return PreconditionError(f"not_present: {term!r}")
-            dot_rows[(int(r), int(e))][:] = 0
-            return None
-
-        # pass 1: simulate to find the first failing OP (if any)
-        dot_rows, clocks = fresh_overlays()
-        fail_op = None
-        err = None
-        for item in flat:
-            err = apply_one(item, dot_rows, clocks)
-            if err is not None:
-                fail_op = item[5]
-                break
-        if err is not None:
-            # pass 2: replay ONLY the ops before the failing op (per-op
-            # atomicity: the failing op's earlier terms are discarded too)
-            dot_rows, clocks = fresh_overlays()
-            for item in flat:
-                if item[5] >= fail_op:
-                    break
-                apply_one(item, dot_rows, clocks)
+            else:
+                dot_rows[(int(r), int(e))][:] = 0
         dots, clock = states.dots, states.clock
         if dot_rows:
             vals = np.stack([dot_rows[p] for p in pairs])
@@ -602,6 +583,47 @@ class ReplicatedRuntime:
         self.states[var.id] = states._replace(clock=clock, dots=dots)
         if err is not None:
             raise err
+
+    def _orswot_precheck(self, var, ops):
+        """``(fail_op, err)``: walk the ops at TERM level (no interning, no
+        state mutation) simulating element presence, and report the first
+        op whose remove would fail not_present. Initial presence for
+        already-interned terms comes from one vectorized gather; unknown
+        terms are absent by definition."""
+        from ..store.store import PreconditionError
+
+        states = self.states[var.id]
+        # initial presence for every (replica, known-term) a remove touches
+        probe: list[tuple] = []
+        for r, op, _actor in ops:
+            if op[0] in ("remove", "remove_all"):
+                terms = op[1] if op[0] == "remove_all" else [op[1]]
+                probe.extend(
+                    (int(r), t) for t in terms if t in var.elems
+                )
+        probe = sorted(set(probe), key=lambda p: (p[0], repr(p[1])))
+        if probe:
+            rs = np.asarray([p[0] for p in probe], dtype=np.int32)
+            es = np.asarray(
+                [var.elems.index_of(p[1]) for p in probe], dtype=np.int32
+            )
+            present = np.asarray((states.dots[rs, es] > 0).any(axis=-1))
+            live = {p: bool(v) for p, v in zip(probe, present)}
+        else:
+            live = {}
+        for k, (r, op, _actor) in enumerate(ops):
+            verb = op[0]
+            if verb in ("add", "add_all"):
+                for t in op[1] if verb == "add_all" else [op[1]]:
+                    live[(int(r), t)] = True
+            elif verb in ("remove", "remove_all"):
+                for t in op[1] if verb == "remove_all" else [op[1]]:
+                    if not live.get((int(r), t), False):
+                        return k, PreconditionError(f"not_present: {t!r}")
+                    live[(int(r), t)] = False
+            else:
+                raise ValueError(f"update_batch: unsupported op {op!r}")
+        return len(ops), None
 
     def _elem_word_masks(self, var_id: str) -> np.ndarray:
         """uint32[E, W]: per-element word masks of the flat bit layout

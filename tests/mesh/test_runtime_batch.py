@@ -448,3 +448,48 @@ def test_add_all_exhausting_pool_is_atomic(packed):
     with pytest.raises(CapacityError):
         rt2.update_batch("s", ops)
     assert rt1.replica_value("s", 0) == rt2.replica_value("s", 0) == {"x"}
+
+
+def test_failing_batch_does_not_intern_later_ops_terms():
+    """Ops after the failing op must not consume interner slots: a caller
+    that catches the error and continues must see exactly the per-op
+    loop's capacity."""
+    from lasp_tpu.store.store import PreconditionError
+
+    def build():
+        store = Store(n_actors=4)
+        graph = Graph(store)
+        store.declare(id="s", type="riak_dt_orswot", n_elems=2, n_actors=4)
+        return ReplicatedRuntime(store, graph, 2, ring(2, 1))
+
+    ops = [
+        (0, ("remove", "ghost"), "w"),
+        (0, ("add", "a"), "w"),
+        (0, ("add", "b"), "w"),
+    ]
+    rt1, rt2 = build(), build()
+    with pytest.raises(PreconditionError):
+        for r, op, actor in ops:
+            rt1.update_at(r, "s", op, actor)
+    with pytest.raises(PreconditionError):
+        rt2.update_batch("s", ops)
+    # both paths left the 2-slot universe empty; 'c' then 'd' both fit
+    rt1.update_at(0, "s", ("add", "c"), "w")
+    rt2.update_batch("s", [(0, ("add", "c"), "w"), (0, ("add", "d"), "w")])
+    assert rt1.replica_value("s", 0) == {"c"}
+    assert rt2.replica_value("s", 0) == {"c", "d"}
+
+
+def test_update_batch_accepts_iterator_payloads():
+    """One-shot iterables as add_all payloads must not be silently drained
+    by the validation walks before dispatch."""
+    _, _, rt = _runtime(type="lasp_orset", n_elems=8)
+    rt.update_batch("s", [(0, ("add_all", iter(["a", "b"])), "w")])
+    assert rt.replica_value("s", 0) == {"a", "b"}
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    store.declare(id="s", type="riak_dt_orswot", n_elems=8, n_actors=4)
+    rt2 = ReplicatedRuntime(store, graph, 2, ring(2, 1))
+    rt2.update_batch("s", [(0, ("add_all", iter(["x", "y"])), "w")])
+    rt2.update_batch("s", [(0, ("remove_all", iter(["x"])), "w")])
+    assert rt2.replica_value("s", 0) == {"y"}
