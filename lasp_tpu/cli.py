@@ -99,6 +99,28 @@ def cmd_scenario(args) -> int:
     return 0
 
 
+def cmd_bridge(args) -> int:
+    """Serve the Erlang backend bridge until interrupted (the release's
+    long-running node role; BEAM side: bridge/erlang/lasp_tpu_backend.erl
+    with LASP_TPU_BRIDGE_HOST/PORT pointing here)."""
+    import time
+
+    from lasp_tpu.bridge import BridgeServer
+
+    server = BridgeServer(host=args.host, port=args.port,
+                          n_actors=args.actors)
+    port = server.start()
+    print(json.dumps({"listening": f"{args.host}:{port}"}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
 def cmd_inspect(args) -> int:
     from lasp_tpu.store import HostStore
     from lasp_tpu.store.checkpoint import loads_manifest
@@ -170,6 +192,11 @@ def main(argv=None) -> int:
     ins = sub.add_parser("inspect", help="list a checkpoint's contents")
     ins.add_argument("path")
 
+    br = sub.add_parser("bridge", help="serve the Erlang backend bridge")
+    br.add_argument("--host", default="127.0.0.1")
+    br.add_argument("--port", type=int, default=9190)
+    br.add_argument("--actors", type=int, default=cfg.n_actors)
+
     args = p.parse_args(argv)
     return {
         "status": cmd_status,
@@ -177,6 +204,7 @@ def main(argv=None) -> int:
         "bench": cmd_bench,
         "scenario": cmd_scenario,
         "inspect": cmd_inspect,
+        "bridge": cmd_bridge,
     }[args.verb](args)
 
 

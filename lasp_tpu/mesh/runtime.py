@@ -574,12 +574,14 @@ class ReplicatedRuntime:
         dots, clock = states.dots, states.clock
         if dot_rows:
             vals = np.stack([dot_rows[p] for p in pairs])
-            dots = dots.at[pr, pe].set(vals.astype(np.asarray(dots).dtype))
+            # .dtype reads metadata only — np.asarray(dots) would pull the
+            # whole population state device-to-host per batch
+            dots = dots.at[pr, pe].set(vals.astype(dots.dtype))
         if clocks:
             cr = np.asarray([k[0] for k in clocks], dtype=np.int32)
             ca = np.asarray([k[1] for k in clocks], dtype=np.int32)
             cv = np.asarray(list(clocks.values()))
-            clock = clock.at[cr, ca].set(cv.astype(np.asarray(clock).dtype))
+            clock = clock.at[cr, ca].set(cv.astype(clock.dtype))
         self.states[var.id] = states._replace(clock=clock, dots=dots)
         if err is not None:
             raise err

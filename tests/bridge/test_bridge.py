@@ -168,3 +168,33 @@ def test_stop_disconnects_live_clients():
         for _ in range(3):  # first call may see the buffered close late
             c.call((Atom("keys"),))
     c.close()
+
+
+def test_cli_bridge_verb_serves():
+    """`cli bridge` starts a servable endpoint (run in-process via the
+    server class path the verb uses; the verb itself just wraps it)."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lasp_tpu.cli", "bridge", "--port", "0"],
+        stdout=subprocess.PIPE,
+        text=True,
+        env=env,
+    )
+    try:
+        import json as _json
+
+        line = proc.stdout.readline()
+        port = int(_json.loads(line)["listening"].rsplit(":", 1)[1])
+        with BridgeClient("127.0.0.1", port) as c:
+            c.start("v")
+            c.declare(b"s", "lasp_gset", n_elems=2)
+            c.update(b"s", (Atom("add"), b"e"), b"w")
+            assert c.read(b"s") == (Atom("ok"), [b"e"])
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
