@@ -1014,19 +1014,40 @@ class ReplicatedRuntime:
         return None
 
     def read_until(self, replica: int, var_id: str, threshold=None,
-                   max_rounds: int = 10_000, edge_mask=None):
+                   max_rounds: int = 10_000, edge_mask=None, block: int = 1):
         """Blocking monotonic threshold read (``lasp:read/2`` semantics,
         ``src/lasp_core.erl:329-364``): steps the mesh until the threshold
         is met at the given replica, then returns that replica's state.
         The reference parks a process and wakes it on write; here the
-        bulk-synchronous loop IS the scheduler."""
-        for _ in range(max_rounds):
+        bulk-synchronous loop IS the scheduler. ``block > 1`` runs the
+        rounds in fused dispatches between threshold checks (the wake-up
+        granularity coarsens to the block — thresholds are monotonic, so
+        overshooting rounds never unmeets one). Once the population
+        quiesces with the threshold still unmet, it can never be met (no
+        client ops land inside this loop), so the wait fails fast instead
+        of burning the remaining round budget."""
+        rounds = 0
+        while rounds < max_rounds:
             row = self.read_at(replica, var_id, threshold)
             if row is not None:
                 return row
-            self.step(edge_mask)
+            if block > 1 and max_rounds - rounds >= block:
+                quiescent = self.fused_steps(block, edge_mask) >= 0
+                rounds += block
+            else:
+                # per-round tail: a remainder-sized fused kernel would be
+                # a fresh XLA compile for a one-off block
+                quiescent = self.step(edge_mask) == 0
+                rounds += 1
+            if quiescent:
+                break
+        row = self.read_at(replica, var_id, threshold)
+        if row is not None:
+            return row
         raise TimeoutError(
-            f"threshold not met at replica {replica} within {max_rounds} rounds"
+            f"threshold not met at replica {replica} within {rounds} rounds"
+            + (" (population quiescent: the threshold is unreachable)"
+               if rounds < max_rounds else "")
         )
 
     # -- compaction ------------------------------------------------------------

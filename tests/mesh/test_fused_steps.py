@@ -223,3 +223,21 @@ def test_compact_refuses_trigger_touched_variable():
     rt.run_to_convergence(block=4)
     with pytest.raises(RuntimeError, match="trigger"):
         rt.compact_orset("s")
+
+
+def test_read_until_fused_blocks():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="c", type="riak_dt_gcounter")
+    rt = ReplicatedRuntime(store, graph, 16, ring(16, 1))
+    rt.update_batch("c", [(0, ("increment", 5), "w")])
+    assert rt.read_at(8, "c", Threshold(5)) is None
+    row = rt.read_until(8, "c", Threshold(5), block=4)
+    assert row is not None
+    with pytest.raises(TimeoutError, match="unreachable"):
+        # fails fast: the mesh quiesces long before 1000 rounds
+        rt.read_until(8, "c", Threshold(99), max_rounds=1000, block=4)
