@@ -195,11 +195,33 @@ def _child(label: str) -> int:
     on_tpu = jax.devices()[0].platform != "cpu"
     kind = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
 
+    def oom_adaptive(fn, n0: int, floor: int):
+        """Run ``fn(n)`` at descending population sizes until it fits HBM.
+        A single chip's memory ceiling must degrade the artifact's scale,
+        never its existence (the r2 failure mode was an unparseable
+        artifact). Returns (result, n, downscales)."""
+        n, tries = n0, 0
+        while True:
+            try:
+                return fn(n), n, tries
+            except Exception as exc:  # jax raises XlaRuntimeError subtypes
+                if "RESOURCE_EXHAUSTED" not in str(exc) or n // 2 < floor:
+                    raise
+                print(
+                    f"bench: RESOURCE_EXHAUSTED at n={n}; retrying at {n // 2}",
+                    file=sys.stderr,
+                )
+                n, tries = n // 2, tries + 1
+
     # -- headline: wide-row packed OR-Set anti-entropy ----------------------
     wide = dict(n_elems=128, n_actors=64, tokens_per_actor=4)  # 8 KiB/replica
-    n_replicas = cfg.bench_replicas or ((1 << 18) if on_tpu else (1 << 12))
-    out = orset_anti_entropy(
-        n_replicas, block=cfg.bench_block, gossip_impl=cfg.gossip_impl, **wide
+    n0 = cfg.bench_replicas or ((1 << 18) if on_tpu else (1 << 12))
+    out, n_replicas, headline_downscales = oom_adaptive(
+        lambda n: orset_anti_entropy(
+            n, block=cfg.bench_block, gossip_impl=cfg.gossip_impl, **wide
+        ),
+        n0,
+        floor=1 << 12,
     )
     tpu_rate = out["merges_per_sec"]
 
@@ -232,6 +254,8 @@ def _child(label: str) -> int:
 
     detail = {
         "n_replicas": n_replicas,
+        "requested_replicas": n0,
+        "oom_downscales": headline_downscales,
         "fanout": out["fanout"],
         "rounds_to_convergence": out["rounds"],
         "elapsed_s": out["seconds"],
@@ -252,13 +276,17 @@ def _child(label: str) -> int:
     }
 
     # -- north-star: 10M-replica engine-path ad counter ---------------------
-    ns_replicas = cfg.bench_northstar_replicas or (
+    ns0 = cfg.bench_northstar_replicas or (
         10 * (1 << 20) if on_tpu else (1 << 13)
     )
     try:
-        ns = adcounter_10m(n_replicas=ns_replicas)
+        ns, ns_replicas, ns_downscales = oom_adaptive(
+            lambda n: adcounter_10m(n_replicas=n), ns0, floor=1 << 16
+        )
         detail["adcounter_northstar"] = {
             "n_replicas": ns_replicas,
+            "requested_replicas": ns0,
+            "oom_downscales": ns_downscales,
             "rounds": ns["rounds"],
             "seconds": ns["seconds"],
             "under_60s": ns["under_60s"],

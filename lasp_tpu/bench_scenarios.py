@@ -184,7 +184,8 @@ def orset_anti_entropy(
     from lasp_tpu.lattice.base import replicate
     from lasp_tpu.mesh import converged, random_regular
     from lasp_tpu.mesh.gossip import gossip_round
-    from lasp_tpu.ops import PackedORSet, PackedORSetSpec, fused_gossip_rounds
+    from lasp_tpu.ops import PackedORSet, PackedORSetSpec
+    from lasp_tpu.ops.fused import fused_gossip_rounds_count
 
     if gossip_impl not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown gossip_impl {gossip_impl!r}")
@@ -200,30 +201,28 @@ def orset_anti_entropy(
         )(r, states)
 
     nbrs = jnp.asarray(random_regular(n_replicas, fanout, seed=seed))
+    # donate the carried states: phase 1 never looks back at a block's
+    # entry state (productive rounds are counted INSIDE the block), so the
+    # input buffers are recycled and peak HBM stays ~2 population copies.
+    # CPU ignores donation with a warning, so only request it elsewhere.
+    donate = (0,) if jax.devices()[0].platform != "cpu" else ()
     fused = jax.jit(
-        lambda s, nb: fused_gossip_rounds(PackedORSet, spec, s, nb, block)
+        lambda s, nb: fused_gossip_rounds_count(PackedORSet, spec, s, nb, block),
+        donate_argnums=donate,
     )
 
-    # phase 1 (untimed): exact rounds-to-convergence. Convergence can land
-    # mid-block, so after the block loop stops, REWIND to the state before
-    # the last changed block and walk that block one round at a time —
-    # the count is exact, never block-quantized.
+    # phase 1 (untimed): exact rounds-to-convergence. Monotone gossip makes
+    # productive rounds a prefix of each block, so the per-block productive
+    # count sums to the exact total — convergence landing mid-block is
+    # handled without rewinding or block-quantizing.
     s = seed_states()
-    s_prev, rounds = s, 0
+    rounds = 0
     while True:
-        s2, changed = fused(s, nbrs)
-        if not bool(changed):
+        s, prod = fused(s, nbrs)
+        prod = int(prod)
+        rounds += prod
+        if prod < block:
             break
-        s_prev, s, rounds = s, s2, rounds + block
-    if rounds:
-        t, rounds = s_prev, rounds - block
-        while True:
-            t2 = gossip_round(PackedORSet, spec, t, nbrs)
-            if bool(
-                jnp.all(jax.vmap(lambda a, b: PackedORSet.equal(spec, a, b))(t, t2))
-            ):
-                break
-            t, rounds = t2, rounds + 1
     assert bool(converged(PackedORSet, spec, s))
     live = np.asarray(PackedORSet.value(spec, jax.tree_util.tree_map(lambda x: x[0], s)))
     assert live.all()  # every element reached everyone
@@ -238,7 +237,8 @@ def orset_anti_entropy(
             lambda st, nb: jax.lax.fori_loop(
                 0, n_rounds,
                 lambda _, x: gossip_round(PackedORSet, spec, x, nb), st
-            )
+            ),
+            donate_argnums=donate,
         )
 
     timed_full, timed_tail = xla_block(block), xla_block(tail)
@@ -261,14 +261,15 @@ def orset_anti_entropy(
         )
 
         def pallas_block(n_rounds):
-            @jax.jit
             def run(e, m, nb):
                 return jax.lax.fori_loop(
                     0, n_rounds,
                     lambda _, c: pallas_gossip_round(c[0], c[1], nb), (e, m)
                 )
 
-            return run
+            return jax.jit(
+                run, donate_argnums=(0, 1) if donate else ()
+            )
 
         p_full, p_tail = pallas_block(block), pallas_block(tail)
 
@@ -285,14 +286,29 @@ def orset_anti_entropy(
 
     # warm every candidate (compiles outside the clock), then time ONE
     # fused block of each (best of 2) — the measured gate that picks the
-    # shipping kernel under "auto"
-    warm = seed_states()
-    jax.block_until_ready(warm)
-    probes = {"xla": lambda: jax.block_until_ready(timed_full(warm, nbrs))}
+    # shipping kernel under "auto". Donated blocks consume their input, so
+    # each impl probes against its own state cell, chaining block outputs
+    # (the OR-join's cost is data-independent, so timing is unaffected).
+    xcell = [seed_states()]
+    jax.block_until_ready(xcell[0])
+
+    def probe_xla():
+        xcell[0] = timed_full(xcell[0], nbrs)
+        jax.block_until_ready(xcell[0])
+
+    probes = {"xla": probe_xla}
     if "pallas" in runners:
-        e0, _ = flatten_plane(warm.exists)
-        m0, _ = flatten_plane(warm.removed)
-        probes["pallas"] = lambda: jax.block_until_ready(p_full(e0, m0, nbrs))
+        pw = seed_states()
+        pe0, _ = flatten_plane(pw.exists)
+        pm0, _ = flatten_plane(pw.removed)
+        del pw
+        pcell = [(pe0, pm0)]
+
+        def probe_pallas():
+            pcell[0] = p_full(pcell[0][0], pcell[0][1], nbrs)
+            jax.block_until_ready(pcell[0])
+
+        probes["pallas"] = probe_pallas
     for name, probe in list(probes.items()):
         try:
             probe()  # compile + warm
@@ -310,10 +326,12 @@ def orset_anti_entropy(
             probe()
             reps.append(time.perf_counter() - t0)
         block_seconds[name] = min(reps)
-    if tail:  # warm the tail-block shapes too
-        jax.block_until_ready(timed_tail(warm, nbrs))
+    if tail:  # warm the tail-block shapes too (chaining the probe cells)
+        xcell[0] = timed_tail(xcell[0], nbrs)
+        jax.block_until_ready(xcell[0])
         if "pallas" in runners:
-            jax.block_until_ready(p_tail(e0, m0, nbrs))
+            pcell[0] = p_tail(pcell[0][0], pcell[0][1], nbrs)
+            jax.block_until_ready(pcell[0])
 
     if gossip_impl == "auto":
         chosen = min(
@@ -329,6 +347,12 @@ def orset_anti_entropy(
             f"errors: {block_seconds})"
         )
 
+    # release the probe cells BEFORE seeding the measured run — otherwise
+    # their population copies coexist with the run's and raise peak HBM
+    # right where the donation work lowered it
+    xcell[0] = None
+    if "pcell" in locals():
+        pcell[0] = None
     states = seed_states()
     jax.block_until_ready(states)
 

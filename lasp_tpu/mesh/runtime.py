@@ -1026,14 +1026,15 @@ class ReplicatedRuntime:
         quiesces with the threshold still unmet, it can never be met (no
         client ops land inside this loop), so the wait fails fast instead
         of burning the remaining round budget."""
-        rounds = 0
+        rounds, quiescent = 0, False
         while rounds < max_rounds:
             row = self.read_at(replica, var_id, threshold)
             if row is not None:
                 return row
             if block > 1 and max_rounds - rounds >= block:
-                quiescent = self.fused_steps(block, edge_mask) >= 0
-                rounds += block
+                at = self.fused_steps(block, edge_mask)
+                quiescent = at >= 0
+                rounds += at if quiescent else block
             else:
                 # per-round tail: a remainder-sized fused kernel would be
                 # a fresh XLA compile for a one-off block
@@ -1047,7 +1048,7 @@ class ReplicatedRuntime:
         raise TimeoutError(
             f"threshold not met at replica {replica} within {rounds} rounds"
             + (" (population quiescent: the threshold is unreachable)"
-               if rounds < max_rounds else "")
+               if quiescent else "")
         )
 
     # -- compaction ------------------------------------------------------------

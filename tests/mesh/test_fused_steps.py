@@ -241,3 +241,22 @@ def test_read_until_fused_blocks():
     with pytest.raises(TimeoutError, match="unreachable"):
         # fails fast: the mesh quiesces long before 1000 rounds
         rt.read_until(8, "c", Threshold(99), max_rounds=1000, block=4)
+
+
+def test_read_until_quiescent_on_final_block_still_labeled():
+    """Quiescence detected during the LAST permitted fused block must be
+    reported as unreachable, not as a plain round-budget timeout (the exit
+    reason is tracked, not inferred from the round count)."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="c", type="riak_dt_gcounter")
+    rt = ReplicatedRuntime(store, graph, 8, ring(8, 2))
+    rt.update_batch("c", [(0, ("increment", 1), "w")])
+    # diameter of ring(8,2) is 2: the mesh quiesces inside one 8-round
+    # block, which is also the whole budget
+    with pytest.raises(TimeoutError, match="unreachable"):
+        rt.read_until(0, "c", Threshold(99), max_rounds=8, block=8)

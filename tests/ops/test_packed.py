@@ -119,3 +119,35 @@ def test_fused_gossip_matches_per_round():
         if not bool(changed):
             break
     assert bool(converged(PackedORSet, SPEC, fused))
+
+
+def test_fused_gossip_count_exact_rounds():
+    """The counting block's productive-round sum equals the exact
+    rounds-to-convergence found by stepping one round at a time."""
+    from lasp_tpu.ops.fused import fused_gossip_rounds_count
+
+    n = 24
+    states = replicate(PackedORSet.new(SPEC), n)
+    states = jax.vmap(
+        lambda i, s: PackedORSet.add(SPEC, s, i % SPEC.n_elems, i % SPEC.n_actors)
+    )(jnp.arange(n), states)
+    nbrs = jnp.asarray(ring(n, 2))
+
+    # oracle: exact per-round convergence count
+    t, oracle_rounds = states, 0
+    while True:
+        t2 = gossip_round(PackedORSet, SPEC, t, nbrs)
+        if bool(jnp.all(jax.vmap(lambda a, b: PackedORSet.equal(SPEC, a, b))(t, t2))):
+            break
+        t, oracle_rounds = t2, oracle_rounds + 1
+
+    for block in (1, 3, 4, 7):  # block sizes that do and don't divide it
+        s, rounds = states, 0
+        while True:
+            s, prod = fused_gossip_rounds_count(PackedORSet, SPEC, s, nbrs, block)
+            prod = int(prod)
+            rounds += prod
+            if prod < block:
+                break
+        assert rounds == oracle_rounds, (block, rounds, oracle_rounds)
+        assert bool(converged(PackedORSet, SPEC, s))
