@@ -227,6 +227,7 @@ def orset_anti_entropy(
     live = np.asarray(PackedORSet.value(spec, jax.tree_util.tree_map(lambda x: x[0], s)))
     assert live.all()  # every element reached everyone
     conv_rounds = rounds
+    del s  # release the converged population before probing/timing
 
     # phase 2 (timed): exactly conv_rounds productive rounds, one fused
     # dispatch per block, zero residual/equality work in the timed region
@@ -290,6 +291,7 @@ def orset_anti_entropy(
     # each impl probes against its own state cell, chaining block outputs
     # (the OR-join's cost is data-independent, so timing is unaffected).
     xcell = [seed_states()]
+    pcell = None
     jax.block_until_ready(xcell[0])
 
     def probe_xla():
@@ -351,7 +353,7 @@ def orset_anti_entropy(
     # their population copies coexist with the run's and raise peak HBM
     # right where the donation work lowered it
     xcell[0] = None
-    if "pcell" in locals():
+    if pcell is not None:
         pcell[0] = None
     states = seed_states()
     jax.block_until_ready(states)

@@ -65,15 +65,17 @@ def _slice_index(device) -> int:
     return getattr(device, "slice_index", 0) or 0
 
 
-def n_slices(devices: Optional[Sequence] = None) -> int:
+def n_slices(devices: Optional[Sequence] = None, slice_of=None) -> int:
     devices = list(devices) if devices is not None else jax.devices()
-    return len({_slice_index(d) for d in devices})
+    slice_of = slice_of or _slice_index
+    return len({slice_of(d) for d in devices})
 
 
 def build_mesh(
     replicas: int = -1,
     state: Optional[int] = None,
     devices: Optional[Sequence] = None,
+    slice_of=None,
 ) -> Mesh:
     """Build the framework's canonical mesh: axes ``("slices", "replicas",
     "state")``.
@@ -88,15 +90,21 @@ def build_mesh(
       coarse population partitioning crosses DCN (SURVEY §2.5: "partition
       the replica graph between slices with boundary exchange"). On a
       single slice (or CPU) its extent is 1 and the mesh is ICI-only.
+    - ``slice_of`` — optional ``device -> slice id`` override. Real TPU
+      slices are detected from ``device.slice_index``; tests (and any
+      topology the runtime can't see, e.g. DCN islands of CPU hosts) can
+      partition devices explicitly to exercise the multi-slice layout
+      without a pod.
     """
     if state is None:
         from ..config import get_config
 
         state = get_config().mesh_state_axis
     devices = list(devices) if devices is not None else jax.devices()
+    slice_of = slice_of or _slice_index
     slices: dict[int, list] = {}
     for d in devices:
-        slices.setdefault(_slice_index(d), []).append(d)
+        slices.setdefault(slice_of(d), []).append(d)
     ns = len(slices)
     per_slice = min(len(v) for v in slices.values())
     if state < 1 or per_slice % state:

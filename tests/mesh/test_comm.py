@@ -42,6 +42,52 @@ def test_build_mesh_state_axis_and_validation():
         build_mesh(replicas=8, state=2)
 
 
+def test_build_mesh_two_virtual_slices():
+    """The multi-slice (DCN) layout, exercised without a pod: partition
+    the 8 virtual devices into 2 'slices' of 4 via the slice_of override
+    and check the hybrid (slices, replicas, state) grid comes out with the
+    DCN axis outermost and each slice's devices contiguous inside it."""
+    devs = jax.devices()
+    by_half = {d: i // 4 for i, d in enumerate(devs)}
+    mesh = build_mesh(slice_of=by_half.get)
+    assert mesh.shape["slices"] == 2
+    assert mesh.shape["replicas"] == 4 and mesh.shape["state"] == 1
+    assert n_slices(slice_of=by_half.get) == 2
+    # each row of the slices axis holds exactly one half's devices
+    grid = np.asarray(mesh.devices)
+    for si in range(2):
+        assert {by_half[d] for d in grid[si].ravel()} == {si}
+    # state axis still splits within a slice
+    mesh2 = build_mesh(state=2, slice_of=by_half.get)
+    assert mesh2.shape == {"slices": 2, "replicas": 2, "state": 2}
+
+
+def test_sharded_gossip_converges_on_two_slice_mesh():
+    """Random-neighbor gossip where the population spans both virtual
+    slices: gathers cross the slice boundary (the boundary-exchange role,
+    SURVEY §2.5 'partition the replica graph between slices') and still
+    reach the global join."""
+    devs = jax.devices()
+    mesh = build_mesh(slice_of={d: i // 4 for i, d in enumerate(devs)}.get)
+    n, e = 32, 8
+    spec = GSetSpec(n_elems=e)
+    rng = np.random.RandomState(3)
+    states = replicate(GSet.new(spec), n)._replace(
+        mask=jnp.asarray(rng.rand(n, e) < 0.1)
+    )
+    nbrs = jnp.asarray(random_regular(n, 3, seed=3))
+    sharded = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, population_sharding(mesh)), states
+    )
+    nbrs_sh = jax.device_put(nbrs, neighbor_sharding(mesh))
+    step = jax.jit(lambda s, nb: gossip_round(GSet, spec, s, nb))
+    out = sharded
+    for _ in range(8):
+        out = step(out, nbrs_sh)
+    expect = np.asarray(states.mask).any(axis=0)
+    assert (np.asarray(out.mask) == expect[None, :]).all()
+
+
 def test_sharded_gossip_converges_on_built_mesh():
     mesh = build_mesh()
     n, e = 64, 16
