@@ -290,6 +290,7 @@ def _child(label: str) -> int:
             "rounds": ns["rounds"],
             "seconds": ns["seconds"],
             "under_60s": ns["under_60s"],
+            "state_bytes_per_replica": ns["state_bytes_per_replica"],
             "engine": ns["engine"],
             "check": ns["check"],
         }

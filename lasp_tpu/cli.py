@@ -181,11 +181,9 @@ def main(argv=None) -> int:
     bench.add_argument("--replicas", type=int, default=0)
 
     scen = sub.add_parser("scenario", help="run a BASELINE eval config")
-    scen.add_argument(
-        "name",
-        choices=["adcounter_6", "gset_1k", "orset_100k", "pipeline_1m",
-                 "adcounter_10m"],
-    )
+    from lasp_tpu.bench_scenarios import SCENARIOS as _scenarios
+
+    scen.add_argument("name", choices=sorted(_scenarios))
     scen.add_argument("--replicas", type=int, default=0,
                       help="override the population for sized scenarios")
 

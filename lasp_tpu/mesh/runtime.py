@@ -52,12 +52,23 @@ class ReplicatedRuntime:
         n_replicas: int,
         neighbors: np.ndarray,
         packed: bool = False,
+        donate_steps: bool = True,
     ):
         self.store = store
         self.graph = graph
         self.n_replicas = n_replicas
         self.neighbors = jnp.asarray(neighbors)
         self.packed = packed
+        #: donate step inputs on accelerators (one fewer store-population
+        #: copy of HBM per step). Trade-off: if a donated dispatch FAILS
+        #: (e.g. RESOURCE_EXHAUSTED mid-block) the pre-step state is
+        #: already gone — the runtime is then poisoned and raises on
+        #: further use. Pass False for checkpoint-then-retry workflows
+        #: that must preserve state across a failed step. NOTE for either
+        #: setting: do not hold ``rt.states[v]`` leaf references across a
+        #: step on accelerators — donation deletes the old buffers.
+        self.donate_steps = donate_steps
+        self._poisoned: str | None = None
         self.states: dict = {}
         self._packed_specs: dict[str, FlatORSetSpec] = {}
         self._triggers: list = []
@@ -828,20 +839,56 @@ class ReplicatedRuntime:
             return out, residual
 
         self._step_pure = step  # un-jitted; __graft_entry__ re-jits with shardings
-        return jax.jit(step)
+        # donate the input states: both callers (step / fused_steps) rebind
+        # self.states to the output immediately, so the old buffers are
+        # recycled — at 10M-replica engine scale this is a full
+        # store-population copy of HBM. CPU ignores donation (warning), so
+        # only request it on accelerators.
+        return jax.jit(step, donate_argnums=self._donate_argnums())
+
+    def _donate_argnums(self) -> tuple:
+        """Donate the states argument on accelerators (callers rebind
+        ``self.states`` right away); CPU would only warn."""
+        if not self.donate_steps:
+            return ()
+        from ..utils.donation import donate_argnums
+
+        return donate_argnums(0)
+
+    def _check_poisoned(self) -> None:
+        if self._poisoned is not None:
+            raise RuntimeError(
+                "runtime state was lost by a failed donated step "
+                f"({self._poisoned}); rebuild the runtime or restore a "
+                "checkpoint (construct with donate_steps=False to keep "
+                "pre-step state across failures at the cost of one "
+                "population copy of HBM)"
+            )
+
+    def _run_step_fn(self, fn, edge_mask, tables):
+        """Dispatch a (possibly donating) compiled step; on failure with
+        donation active, mark the runtime poisoned — the donated input
+        buffers are gone, so ``self.states`` must not be trusted."""
+        try:
+            return fn(self.states, self.neighbors, edge_mask, tables)
+        except Exception as exc:
+            if self._donate_argnums():
+                self._poisoned = f"{type(exc).__name__}: {str(exc)[:200]}"
+            raise
 
     def step(self, edge_mask=None) -> int:
         """One bulk-synchronous round: local dataflow sweep + gossip.
         Returns the number of (replica, variable) states the step CHANGED
         (0 on the final, quiescent round)."""
+        self._check_poisoned()
         if self._n_edges != len(self.graph.edges):
             self._sync_graph()
         if self._step is None:
             self._step = self._build_step()
         tables = tuple(e.device_tables() for e in self.graph.edges)
         with Timer() as t:
-            self.states, residual = self._step(
-                self.states, self.neighbors, edge_mask, tables
+            self.states, residual = self._run_step_fn(
+                self._step, edge_mask, tables
             )
             residual = int(residual)  # device sync closes the timing window
         self.trace.record_round(residual, t.elapsed)
@@ -861,6 +908,7 @@ class ReplicatedRuntime:
         step function (join idempotence + the triggers' inflation gate),
         rounds after the first zero are no-ops — running the remainder of
         the block is harmless."""
+        self._check_poisoned()
         if self._n_edges != len(self.graph.edges):
             self._sync_graph()
         if self._step is None:
@@ -883,12 +931,12 @@ class ReplicatedRuntime:
                     0, block, body, (states, jnp.int32(-1))
                 )
 
-            fn = jax.jit(fused)
+            fn = jax.jit(fused, donate_argnums=self._donate_argnums())
             self._fused_steps_cache[block] = fn
         tables = tuple(e.device_tables() for e in self.graph.edges)
         with Timer() as t:
-            self.states, first_zero = fn(
-                self.states, self.neighbors, edge_mask, tables
+            self.states, first_zero = self._run_step_fn(
+                fn, edge_mask, tables
             )
             first_zero = int(first_zero)  # device sync closes timing window
         self.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)

@@ -1,0 +1,18 @@
+"""Buffer-donation policy, in one place.
+
+Donation lets a jitted step recycle its input buffers for its outputs —
+at population scale that is a full store copy of HBM. The CPU backend
+does not implement donation (it warns and copies), so the policy is
+"donate on accelerators only"; every donation site routes through here
+so the rule can change in exactly one place.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def donate_argnums(*nums: int) -> tuple:
+    """``nums`` on accelerators, ``()`` on CPU (where donation would only
+    warn). Pass the result to ``jax.jit(..., donate_argnums=...)``."""
+    return nums if jax.devices()[0].platform != "cpu" else ()

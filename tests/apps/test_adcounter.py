@@ -52,6 +52,42 @@ def test_gcounter_adcounter():
         assert watches[ad].done
 
 
+def test_orset_adcounter_reactive_removal():
+    """``riak_test/lasp_adcounter_orset_test.erl:57-145``: the ad *set*
+    itself is an OR-Set of counter ids; each ad's server is a blocking
+    threshold read that REMOVES the ad from the set at 5 impressions
+    (:128-137), and clients pick ads by re-reading the live set (:139-151)
+    rather than from local bookkeeping. Ends with the ad set empty."""
+    s = Session(n_actors=8)
+    n_ads, n_clients, limit = 5, 5, 5
+    ads = s.declare("lasp_orset", n_elems=8)
+    counters = [s.declare("riak_dt_gcounter", id=f"oad{i}") for i in range(n_ads)]
+    for c in counters:
+        s.update(ads, ("add", c), actor="setup")
+
+    # server per ad: parked threshold watch; firing removes the ad from
+    # the OR-Set (the reference's server/2 loop, one process per ad)
+    for c in counters:
+        w = s.read(c, Threshold(limit))
+        assert not w.done
+        w.callback = lambda _res, c=c: s.update(ads, ("remove", c), actor=c)
+
+    rng = random.Random(7)
+    views = 0
+    while views < 500:
+        live = sorted(s.value(ads))  # clients read the CURRENT ad list
+        if not live:
+            break
+        ad = live[rng.randrange(len(live))]
+        s.update(ad, ("increment",), f"client{rng.randrange(n_clients)}")
+        views += 1
+
+    assert s.value(ads) == frozenset()  # every ad disabled by its server
+    for c in counters:
+        assert s.value(c) == limit  # live-set reads stop views at exactly 5
+    assert views == n_ads * limit
+
+
 def test_advertisement_counter_dataflow():
     # riak_test/lasp_advertisement_counter_test.erl:64-235, shrunk shapes
     s = Session(n_actors=16)

@@ -243,6 +243,24 @@ def test_read_until_fused_blocks():
         rt.read_until(8, "c", Threshold(99), max_rounds=1000, block=4)
 
 
+def test_poisoned_runtime_raises_loudly():
+    """After a failed donated dispatch the pre-step state is gone; the
+    runtime must refuse further stepping with a clear error instead of
+    surfacing 'Array has been deleted' from deep inside jax."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="v", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 8, ring(8, 2))
+    rt._poisoned = "ResourceExhausted: simulated"
+    with pytest.raises(RuntimeError, match="donate_steps=False"):
+        rt.step()
+    with pytest.raises(RuntimeError, match="failed donated step"):
+        rt.fused_steps(4)
+
+
 def test_read_until_quiescent_on_final_block_still_labeled():
     """Quiescence detected during the LAST permitted fused block must be
     reported as unreachable, not as a plain round-budget timeout (the exit

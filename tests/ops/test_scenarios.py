@@ -22,7 +22,20 @@ def test_scenario_registry_complete():
         "orset_100k",
         "pipeline_1m",
         "adcounter_10m",
+        "packed_vs_dense",
     }
+
+
+def test_packed_vs_dense_small():
+    """CI-scale packed-vs-dense comparison: both modes produce the same
+    dataflow value and the record carries per-mode round timings."""
+    from lasp_tpu.bench_scenarios import packed_vs_dense
+
+    out = packed_vs_dense(n_replicas=256, blocks=2, block=4)
+    assert out["check"] == "dense==packed value"
+    assert set(out["per_round_s"]) == {"dense", "packed"}
+    assert out["per_round_s"]["dense"] > 0 and out["per_round_s"]["packed"] > 0
+    assert out["rounds_timed"] == 8
 
 
 def test_adcounter_6():
