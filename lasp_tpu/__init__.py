@@ -18,9 +18,34 @@ Layer map (mirrors SURVEY.md §1, redesigned per §7):
 
 __version__ = "0.1.0"
 
-from . import api, bridge, config, dataflow, lattice, mesh, ops, programs, store
-from .api import Session
-from .config import LaspConfig, get_config
+# Lazy submodule/attribute loading (PEP 562): importing the package must
+# not pull in jax — lightweight consumers (CLI --help/status, the bridge
+# server parent, bench.py's never-import-jax parent) need the namespace
+# without paying jax's import cost or risking any backend touch.
+_SUBMODULES = frozenset({
+    "api", "bridge", "config", "dataflow", "lattice", "mesh", "ops",
+    "programs", "store", "utils",
+})
+_ATTRS = {
+    "Session": ("api", "Session"),
+    "LaspConfig": ("config", "LaspConfig"),
+    "get_config": ("config", "get_config"),
+}
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in _SUBMODULES:
+        return importlib.import_module(f".{name}", __name__)
+    if name in _ATTRS:
+        mod, attr = _ATTRS[name]
+        return getattr(importlib.import_module(f".{mod}", __name__), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | _SUBMODULES | set(_ATTRS))
 
 __all__ = [
     "LaspConfig",

@@ -181,9 +181,15 @@ def main(argv=None) -> int:
     bench.add_argument("--replicas", type=int, default=0)
 
     scen = sub.add_parser("scenario", help="run a BASELINE eval config")
-    from lasp_tpu.bench_scenarios import SCENARIOS as _scenarios
-
-    scen.add_argument("name", choices=sorted(_scenarios))
+    # literal list (not the SCENARIOS registry): importing bench_scenarios
+    # here would pull jax into every CLI invocation including --help;
+    # tests/ops/test_scenarios.py::test_cli_scenario_choices_in_sync pins
+    # this against the registry
+    scen.add_argument(
+        "name",
+        choices=["adcounter_10m", "adcounter_6", "gset_1k", "orset_100k",
+                 "packed_vs_dense", "pipeline_1m"],
+    )
     scen.add_argument("--replicas", type=int, default=0,
                       help="override the population for sized scenarios")
 

@@ -855,6 +855,19 @@ class ReplicatedRuntime:
 
         return donate_argnums(0)
 
+    @property
+    def states(self) -> dict:
+        """The population state pytrees. Reading raises once a failed
+        donated step has deleted the backing buffers (every consumer —
+        reads, coverage queries, checkpoints — gets the clear error, not
+        jax's 'Array has been deleted')."""
+        self._check_poisoned()
+        return self._states
+
+    @states.setter
+    def states(self, value: dict) -> None:
+        self._states = value
+
     def _check_poisoned(self) -> None:
         if self._poisoned is not None:
             raise RuntimeError(
@@ -866,13 +879,25 @@ class ReplicatedRuntime:
             )
 
     def _run_step_fn(self, fn, edge_mask, tables):
-        """Dispatch a (possibly donating) compiled step; on failure with
-        donation active, mark the runtime poisoned — the donated input
-        buffers are gone, so ``self.states`` must not be trusted."""
+        """Dispatch a (possibly donating) compiled step and SYNC on its
+        scalar result inside the guarded region — jax dispatch is
+        asynchronous, so a device-side failure (OOM mid-block) surfaces at
+        the blocking ``int()``, not at the call. Returns
+        ``(new_states, scalar: int)``. On failure, the runtime is marked
+        poisoned only if donation actually consumed the input buffers
+        (trace/compile-time errors leave state intact and recoverable)."""
+        states_in = self.states  # property read: raises if already poisoned
         try:
-            return fn(self.states, self.neighbors, edge_mask, tables)
+            new_states, scalar = fn(
+                states_in, self.neighbors, edge_mask, tables
+            )
+            return new_states, int(scalar)  # device sync: errors land here
         except Exception as exc:
-            if self._donate_argnums():
+            if self._donate_argnums() and any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for state in self._states.values()
+                for leaf in jax.tree_util.tree_leaves(state)
+            ):
                 self._poisoned = f"{type(exc).__name__}: {str(exc)[:200]}"
             raise
 
@@ -887,10 +912,10 @@ class ReplicatedRuntime:
             self._step = self._build_step()
         tables = tuple(e.device_tables() for e in self.graph.edges)
         with Timer() as t:
+            # _run_step_fn syncs on the residual, closing the timing window
             self.states, residual = self._run_step_fn(
                 self._step, edge_mask, tables
             )
-            residual = int(residual)  # device sync closes the timing window
         self.trace.record_round(residual, t.elapsed)
         return residual
 
@@ -935,10 +960,10 @@ class ReplicatedRuntime:
             self._fused_steps_cache[block] = fn
         tables = tuple(e.device_tables() for e in self.graph.edges)
         with Timer() as t:
+            # _run_step_fn syncs on first_zero, closing the timing window
             self.states, first_zero = self._run_step_fn(
                 fn, edge_mask, tables
             )
-            first_zero = int(first_zero)  # device sync closes timing window
         self.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
         return first_zero
 

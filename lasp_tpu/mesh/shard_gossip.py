@@ -102,6 +102,37 @@ def ring_gossip_rounds(codec, spec, states, mesh: Mesh, n_rounds: int,
     return run(states)
 
 
+def sharded_join_all(codec, spec, states, mesh: Mesh, axis: str = "replicas"):
+    """Explicit-collective coverage/quorum merge of a block-sharded replica
+    population: each device folds its local block to one state (the
+    vnode-local part of a coverage query, ``src/lasp_vnode.erl:480-505``),
+    then ONE small ``lax.all_gather`` moves the per-device partials and a
+    local fold joins them — the "coverage execute = tree reduction over the
+    mesh" / "read-repair = all_reduce(join)" rows of SURVEY §2.5's
+    communication-backend table, hand-scheduled. Wire traffic per device is
+    one state row per peer, not the population. Returns the global join
+    (replicated on every device); semantically identical to
+    :func:`lasp_tpu.mesh.gossip.join_all`.
+
+    An idempotent join is not one of XLA's built-in all-reduce monoids
+    (bitwise OR over packed words is not add/min/max elementwise in
+    general), so the reduction is expressed as gather + fold; for
+    log-device-depth over very large meshes, XLA may further optimize the
+    gather, and the payload is a single row either way."""
+    from .gossip import join_all
+
+    def local(block):
+        top = join_all(codec, spec, block)  # my block's join, no lead axis
+        gathered = jax.tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, axis), top
+        )  # [n_dev, ...] per leaf
+        return join_all(codec, spec, gathered)
+
+    return _shard_map(
+        local, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False
+    )(states)
+
+
 def ring_gossip_shardmap_dryrun(mesh: Mesh, n_replicas: int) -> None:
     """Compile-and-run proof that the explicit ppermute path works on the
     current device population (called from ``__graft_entry__``'s multi-chip
@@ -137,3 +168,14 @@ def ring_gossip_shardmap_dryrun(mesh: Mesh, n_replicas: int) -> None:
         lambda a, b: bool(jnp.array_equal(a, b)), out, ref
     )
     assert all(jax.tree_util.tree_leaves(ok)), "ppermute ring != dense ring"
+
+    # the explicit coverage/quorum collective must execute on the same
+    # mesh and agree with the dense join
+    from .gossip import join_all
+
+    top = sharded_join_all(PackedORSet, spec, states, flat, axis=axis)
+    ref_top = join_all(PackedORSet, spec, states)
+    ok2 = jax.tree_util.tree_map(
+        lambda a, b: bool(jnp.array_equal(a, b)), top, ref_top
+    )
+    assert all(jax.tree_util.tree_leaves(ok2)), "sharded join != dense join"

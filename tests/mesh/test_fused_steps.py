@@ -259,6 +259,11 @@ def test_poisoned_runtime_raises_loudly():
         rt.step()
     with pytest.raises(RuntimeError, match="failed donated step"):
         rt.fused_steps(4)
+    # every state consumer gets the clear error, not jax's deleted-array one
+    with pytest.raises(RuntimeError, match="failed donated step"):
+        rt.coverage_value("v")
+    with pytest.raises(RuntimeError, match="failed donated step"):
+        rt.states
 
 
 def test_read_until_quiescent_on_final_block_still_labeled():

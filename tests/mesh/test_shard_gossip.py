@@ -91,3 +91,38 @@ def test_hlo_contains_collective_permute():
 
 def test_dryrun_helper_runs():
     ring_gossip_shardmap_dryrun(_mesh(), 64)
+
+
+def test_sharded_join_all_equals_dense_join():
+    from lasp_tpu.mesh.gossip import join_all
+    from lasp_tpu.mesh.shard_gossip import sharded_join_all
+
+    mesh = _mesh()
+    n = 72  # odd per-device blocks (9 rows) exercise join_all's padding
+    spec = PackedORSetSpec(n_elems=8, n_actors=4, tokens_per_actor=2)
+    rng = np.random.RandomState(8)
+    from lasp_tpu.lattice.base import replicate as rep
+
+    states = rep(PackedORSet.new(spec), n)._replace(
+        exists=jnp.asarray(
+            rng.randint(0, 256, size=(n, spec.n_elems, spec.n_words)),
+            dtype=jnp.uint32,
+        )
+    )
+    got = sharded_join_all(PackedORSet, spec, states, mesh)
+    ref = join_all(PackedORSet, spec, states)
+    assert jnp.array_equal(got.exists, ref.exists)
+    assert jnp.array_equal(got.removed, ref.removed)
+
+
+def test_sharded_join_all_hlo_contains_all_gather():
+    from lasp_tpu.mesh.shard_gossip import sharded_join_all
+
+    mesh = _mesh()
+    spec = GSetSpec(n_elems=16)
+    states = replicate(GSet.new(spec), 64)
+    sh = NamedSharding(mesh, P("replicas"))
+    sharded = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
+    fn = jax.jit(lambda s: sharded_join_all(GSet, spec, s, mesh))
+    hlo = fn.lower(sharded).compile().as_text()
+    assert "all-gather" in hlo, "coverage join must lower to all-gather"

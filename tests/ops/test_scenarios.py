@@ -26,7 +26,37 @@ def test_scenario_registry_complete():
     }
 
 
-def test_packed_vs_dense_small():
+def test_cli_scenario_choices_in_sync():
+    """cli.py keeps a literal choices list (importing the registry there
+    would pull jax into every CLI start); it must match SCENARIOS."""
+    import re
+
+    src = open("lasp_tpu/cli.py").read()
+    block = re.search(
+        r'scen\.add_argument\(\s*"name",\s*choices=\[(.*?)\]', src, re.S
+    ).group(1)
+    choices = set(re.findall(r'"([a-z0-9_]+)"', block))
+    assert choices == set(SCENARIOS)
+
+
+def test_cli_import_stays_light():
+    """Importing the CLI (or the bare package) must not load the heavy
+    submodules — lasp_tpu/__init__ is lazy (PEP 562) so lightweight
+    consumers (--help, the bridge parent, bench.py's parent) pay no
+    framework import cost. jax itself cannot be asserted absent here:
+    this machine's sitecustomize imports it in every interpreter."""
+    import subprocess
+    import sys
+
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import lasp_tpu.cli; "
+         "heavy = [m for m in sys.modules if m.startswith('lasp_tpu.') "
+         "and m not in ('lasp_tpu.cli',)]; "
+         "sys.exit(1 if heavy else 0)"],
+        capture_output=True,
+    )
+    assert probe.returncode == 0, probe.stderr.decode()[-500:]
     """CI-scale packed-vs-dense comparison: both modes produce the same
     dataflow value and the record carries per-mode round timings."""
     from lasp_tpu.bench_scenarios import packed_vs_dense
