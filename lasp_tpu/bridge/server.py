@@ -207,28 +207,171 @@ def _export_value(store: Store, var_id) -> Any:
 # server
 # ---------------------------------------------------------------------------
 
-class _Conn:
-    """One connection = one vnode's store."""
+#: mutating verbs -> write-through persistence after dispatch
+_MUTATORS = frozenset({"declare", "put", "update", "bind", "merge_batch"})
 
-    def __init__(self, n_actors: int):
+#: compact the durable log every this many persisted mutations (superseded
+#: records are pure waste until reclaimed — the bitcask merge role)
+_COMPACT_EVERY = 256
+
+
+class _Conn:
+    """One connection = one vnode's store.
+
+    With ``data_dir`` set, ``{start, Name}`` opens (or re-opens) a DURABLE
+    store: the eleveldb/bitcask role of the reference backend
+    (``src/lasp_eleveldb_backend.erl:38-53`` — one persistent store per
+    partition, named by the start argument). Every mutating verb writes
+    the variable's state through to the append-only host log (CRC'd
+    records, per-put flush — per-op durability like the reference's
+    backends), and a restarted connection that sends the same name gets
+    its state back. One live connection per name at a time (the reference
+    gives each partition exactly one owning vnode process)."""
+
+    def __init__(self, n_actors: int, data_dir: Optional[str] = None,
+                 locks: Optional[dict] = None):
         self.n_actors = n_actors
+        self.data_dir = data_dir
+        self._locks = locks  # BridgeServer-owned {name: lock-holder}
         self.store: Optional[Store] = None
+        self._hs = None
+        self._manifest: Optional[dict] = None
+        self._name: Optional[str] = None
+        self._writes = 0
+
+    def _release(self) -> None:
+        if self._hs is not None:
+            try:
+                self._hs.close()
+            finally:
+                self._hs = None
+        if self._name is not None and self._locks is not None:
+            self._locks.pop(self._name, None)
+        self._name = None
+
+    def _start_durable(self, name: str):
+        import os
+        import re
+
+        if not re.fullmatch(r"[A-Za-z0-9._-]{1,128}", name):
+            return (etf.ERROR, Atom("badarg"),
+                    f"unusable store name {name!r}".encode())
+        if self._locks is not None:
+            # dict.setdefault is atomic under the GIL: exactly one of two
+            # racing connections claims the name
+            holder = self._locks.setdefault(name, self)
+            if holder is not self:
+                return (
+                    etf.ERROR, Atom("locked"),
+                    f"store {name!r} already open on another connection".encode(),
+                )
+        from ..store.checkpoint import load_store, save_store
+        from ..store.host_store import HostStore
+
+        # close the previous durable store (keeping the just-claimed name:
+        # _release only drops self._name, which is still the OLD name here)
+        old_name, self._name = self._name, None
+        if self._hs is not None:
+            try:
+                self._hs.close()
+            finally:
+                self._hs = None
+        if (
+            old_name is not None
+            and old_name != name
+            and self._locks is not None
+        ):
+            self._locks.pop(old_name, None)
+        os.makedirs(self.data_dir, exist_ok=True)
+        path = os.path.join(self.data_dir, name)
+        if os.path.exists(path):
+            self.store = load_store(path)
+        else:
+            self.store = Store(n_actors=self.n_actors)
+            save_store(self.store, path)  # manifest exists from first open
+        if self._locks is not None:
+            self._locks[name] = self
+        self._name = name
+        self._hs = HostStore(path)
+        from ..store.checkpoint import loads_manifest
+
+        self._manifest = loads_manifest(self._hs.get("manifest"))
+        return (etf.OK, Atom(name))
+
+    def _persist(self, var_ids) -> None:
+        """Write-through the touched variables + manifest to the log."""
+        if self._hs is None:
+            return
+        import pickle
+
+        from ..store.checkpoint import _put_state, _var_manifest
+
+        for var_id in var_ids:
+            if var_id not in self.store.ids():
+                continue
+            var = self.store.variable(var_id)
+            entry = _var_manifest(var)
+            _put_state(self._hs, var_id, var.state, entry)
+            self._manifest["vars"][var_id] = entry
+        self._manifest["mutations"] = self.store.mutations
+        self._hs.put("manifest", pickle.dumps(self._manifest))
+        self._writes += 1
+        if self._writes % _COMPACT_EVERY == 0:
+            self._hs.compact()
+
+    def close(self) -> None:
+        self._release()
 
     def handle(self, req: Any) -> Any:
         if not isinstance(req, tuple) or not req:
             return (etf.ERROR, Atom("badarg"), b"request must be a tuple")
         verb = req[0]
         if verb == "start":
+            raw_name = req[1] if len(req) > 1 else Atom("store")
+            # binaries are the protocol's normal currency for names/ids
+            name = (
+                raw_name.decode("utf-8", "replace")
+                if isinstance(raw_name, (bytes, bytearray))
+                else str(raw_name)
+            )
+            if self.data_dir is not None:
+                try:
+                    return self._start_durable(name)
+                except Exception as e:
+                    # release a half-claimed name so a retry can succeed,
+                    # and drop any previous store binding — verbs must not
+                    # silently mutate an orphaned, no-longer-durable store
+                    if (
+                        self._locks is not None
+                        and self._name != name
+                        and self._locks.get(name) is self
+                    ):
+                        self._locks.pop(name, None)
+                    self.store = None
+                    self._manifest = None
+                    return (etf.ERROR, Atom(type(e).__name__), str(e).encode())
+            self._release()
             self.store = Store(n_actors=self.n_actors)
             return (etf.OK, req[1] if len(req) > 1 else Atom("store"))
         if self.store is None:
             return (etf.ERROR, Atom("not_started"), b"send {start, Name} first")
         try:
-            return self._dispatch(verb, req)
+            resp = self._dispatch(verb, req)
+            if verb in _MUTATORS and self._hs is not None and resp and (
+                not isinstance(resp, tuple) or resp[0] != etf.ERROR
+            ):
+                self._persist(self._touched(verb, req))
+            return resp
         except KeyError as e:
             return (etf.ERROR, Atom("not_found"), repr(e).encode())
         except Exception as e:  # surface as an error term, keep serving
             return (etf.ERROR, Atom(type(e).__name__), str(e).encode())
+
+    @staticmethod
+    def _touched(verb: str, req: tuple) -> list:
+        if verb == "merge_batch":
+            return [_to_key(var_id) for var_id, _ in req[1]]
+        return [_to_key(req[1])]
 
     def _dispatch(self, verb: str, req: tuple) -> Any:
         store = self.store
@@ -289,10 +432,19 @@ class _Conn:
             return (etf.OK, _export_value(store, var_id))
         if verb == "merge_batch":
             _, items = req
-            for var_id, portable in items:
-                var_id = _to_key(var_id)
-                var = store.variable(var_id)
-                store.bind(var_id, _import_state(var, portable))
+            applied = []
+            try:
+                for var_id, portable in items:
+                    var_id = _to_key(var_id)
+                    var = store.variable(var_id)
+                    store.bind(var_id, _import_state(var, portable))
+                    applied.append(var_id)
+            except Exception:
+                # a mid-batch failure leaves the applied prefix in memory
+                # (bind is an idempotent join); the durable log must agree
+                # with what a same-connection read now observes
+                self._persist(applied)
+                raise
             return (etf.OK, len(items))
         if verb == "read":
             _, var_id = req
@@ -307,10 +459,14 @@ class BridgeServer:
     a free port (read it from :attr:`port` after :meth:`start`)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 n_actors: int = 16):
+                 n_actors: int = 16, data_dir: Optional[str] = None):
         self.host = host
         self.port = port
         self.n_actors = n_actors
+        #: with a data_dir, {start, Name} opens a durable per-name store
+        #: (the eleveldb per-partition persistence role)
+        self.data_dir = data_dir
+        self._store_locks: dict = {}
         self._sock: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -346,7 +502,7 @@ class BridgeServer:
             ).start()
 
     def _serve_conn(self, sock: socket.socket) -> None:
-        state = _Conn(self.n_actors)
+        state = _Conn(self.n_actors, self.data_dir, self._store_locks)
         try:
             with sock:
                 while not self._stop.is_set():
@@ -366,6 +522,7 @@ class BridgeServer:
                     except OSError:
                         break
         finally:
+            state.close()  # flush + release the durable store's name lock
             with self._conns_lock:
                 self._conns.discard(sock)
 
@@ -414,8 +571,12 @@ class BridgeClient:
         return etf.decode(frame)
 
     # convenience verbs mirroring lasp_tpu_backend.erl
-    def start(self, name: str = "store"):
-        return self.call((Atom("start"), Atom(name)))
+    def start(self, name="store"):
+        # bytes pass through as an ETF binary (BEAM nodes may name the
+        # partition either way); strings ride as atoms
+        return self.call(
+            (Atom("start"), name if isinstance(name, bytes) else Atom(name))
+        )
 
     def declare(self, var_id, type_name: str, **caps):
         return self.call(

@@ -108,7 +108,7 @@ def cmd_bridge(args) -> int:
     from lasp_tpu.bridge import BridgeServer
 
     server = BridgeServer(host=args.host, port=args.port,
-                          n_actors=args.actors)
+                          n_actors=args.actors, data_dir=args.data_dir)
     port = server.start()
     print(json.dumps({"listening": f"{args.host}:{port}"}), flush=True)
     try:
@@ -200,6 +200,9 @@ def main(argv=None) -> int:
     br.add_argument("--host", default="127.0.0.1")
     br.add_argument("--port", type=int, default=9190)
     br.add_argument("--actors", type=int, default=cfg.n_actors)
+    br.add_argument("--data-dir", default=None,
+                    help="durable per-name stores (eleveldb role); "
+                         "omit for in-memory")
 
     args = p.parse_args(argv)
     return {

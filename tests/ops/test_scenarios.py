@@ -57,6 +57,9 @@ def test_cli_import_stays_light():
         capture_output=True,
     )
     assert probe.returncode == 0, probe.stderr.decode()[-500:]
+
+
+def test_packed_vs_dense_small():
     """CI-scale packed-vs-dense comparison: both modes produce the same
     dataflow value and the record carries per-mode round timings."""
     from lasp_tpu.bench_scenarios import packed_vs_dense
