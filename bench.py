@@ -41,6 +41,14 @@ _PROBE_WINDOW_S = int(os.environ.get("LASP_BENCH_PROBE_WINDOW", "300"))
 _PROBE_TIMEOUT_S = int(os.environ.get("LASP_BENCH_PROBE_TIMEOUT", "90"))
 _TPU_CHILD_TIMEOUT_S = int(os.environ.get("LASP_BENCH_TPU_TIMEOUT", "900"))
 _CPU_CHILD_TIMEOUT_S = int(os.environ.get("LASP_BENCH_CPU_TIMEOUT", "480"))
+#: hard wall-clock ceiling for the WHOLE bench run. The driver runs this
+#: under its own (unknown) budget; the one unforgivable outcome is being
+#: killed before the JSON line prints. Stage budgets shrink to fit, the
+#: CPU fallback always gets a reserved slice, and a too-tight deadline
+#: degrades scale/steps — never the artifact's existence.
+_TOTAL_BUDGET_S = int(os.environ.get("LASP_BENCH_TOTAL_BUDGET", "2100"))
+#: slice of the deadline reserved for the CPU fallback + JSON emission
+_CPU_RESERVE_S = 420
 
 #: single-chip HBM roofline, GB/s, by device-kind substring
 _ROOFLINE_GBPS = (
@@ -132,9 +140,11 @@ def _extract_json(out: str) -> dict | None:
 
 def main() -> int:
     start = time.monotonic()
+    deadline = start + _TOTAL_BUDGET_S
     errors: list[str] = []
 
-    tpu_ok = _probe_tpu(start + _PROBE_WINDOW_S)
+    probe_deadline = min(start + _PROBE_WINDOW_S, deadline - _CPU_RESERVE_S)
+    tpu_ok = _probe_tpu(probe_deadline)
     attempts: list[tuple[str, dict, int]] = []
     if tpu_ok:
         attempts.append(("tpu", dict(os.environ), _TPU_CHILD_TIMEOUT_S))
@@ -144,6 +154,17 @@ def main() -> int:
     attempts.append(("cpu-fallback", cpu_env, _CPU_CHILD_TIMEOUT_S))
 
     for i, (label, env, budget) in enumerate(attempts):
+        if label != "cpu-fallback":
+            # fit inside the deadline, keeping the CPU fallback's reserve;
+            # a squeezed TPU attempt is skipped, not run to certain death
+            budget = min(budget, int(deadline - _CPU_RESERVE_S - time.monotonic()))
+            if budget < 120:
+                errors.append(f"{label}: skipped (deadline)")
+                continue
+        else:
+            budget = max(60, min(budget, int(deadline - time.monotonic()) - 30))
+        env = dict(env)
+        env["LASP_BENCH_CHILD_BUDGET"] = str(budget)
         if label == "tpu-retry":
             time.sleep(45)  # give a transiently-wedged tunnel a beat
         rc, out, err = _run(
@@ -178,6 +199,9 @@ def main() -> int:
 # ---------------------------------------------------------------------------
 
 def _child(label: str) -> int:
+    child_start = time.monotonic()
+    child_budget = int(os.environ.get("LASP_BENCH_CHILD_BUDGET", "900"))
+
     import numpy as np
 
     import jax
@@ -195,11 +219,12 @@ def _child(label: str) -> int:
     on_tpu = jax.devices()[0].platform != "cpu"
     kind = getattr(jax.devices()[0], "device_kind", jax.devices()[0].platform)
 
-    def oom_adaptive(fn, n0: int, floor: int):
+    def oom_adaptive(fn, n0: int, floor: int, deadline: float = None):
         """Run ``fn(n)`` at descending population sizes until it fits HBM.
         A single chip's memory ceiling must degrade the artifact's scale,
         never its existence (the r2 failure mode was an unparseable
-        artifact). Returns (result, n, downscales)."""
+        artifact). Each retry recompiles, so the descent also stops at
+        ``deadline``. Returns (result, n, downscales)."""
         n, tries = n0, 0
         while True:
             try:
@@ -207,6 +232,10 @@ def _child(label: str) -> int:
             except Exception as exc:  # jax raises XlaRuntimeError subtypes
                 if "RESOURCE_EXHAUSTED" not in str(exc) or n // 2 < floor:
                     raise
+                if deadline is not None and time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"OOM at n={n} with no budget left to retry smaller"
+                    ) from exc
                 print(
                     f"bench: RESOURCE_EXHAUSTED at n={n}; retrying at {n // 2}",
                     file=sys.stderr,
@@ -222,6 +251,10 @@ def _child(label: str) -> int:
         ),
         n0,
         floor=1 << 12,
+        # at least half the budget stays usable for downscale retries even
+        # under a squeezed child budget (a past deadline would turn the
+        # first OOM into a zero-value artifact)
+        deadline=child_start + max(child_budget - 240, child_budget * 0.5),
     )
     tpu_rate = out["merges_per_sec"]
 
@@ -279,9 +312,16 @@ def _child(label: str) -> int:
     ns0 = cfg.bench_northstar_replicas or (
         10 * (1 << 20) if on_tpu else (1 << 13)
     )
+    ns_left = child_budget - (time.monotonic() - child_start) - 60
     try:
+        if ns_left < 180:
+            raise RuntimeError(
+                f"skipped: only {int(ns_left)}s left in the child budget "
+                "after the headline (the JSON line must still print)"
+            )
         ns, ns_replicas, ns_downscales = oom_adaptive(
-            lambda n: adcounter_10m(n_replicas=n), ns0, floor=1 << 16
+            lambda n: adcounter_10m(n_replicas=n), ns0, floor=1 << 16,
+            deadline=child_start + child_budget - 60,
         )
         detail["adcounter_northstar"] = {
             "n_replicas": ns_replicas,

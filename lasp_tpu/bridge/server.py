@@ -85,32 +85,35 @@ def _send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 
-class _Tag(str):
-    """Container-shape marker for interned terms. Checked by IDENTITY, so
-    no wire term can ever collide with it."""
-
-
-_LIST = _Tag("list")
-_TUPLE = _Tag("tuple")
-
-
 def _to_key(term: Any) -> Any:
-    """ETF terms used as ids/elems/actors must be hashable AND shape-
-    faithful: lists (unhashable) and tuples both become tuples, tagged so
-    ``[1,2]`` and ``{1,2}`` stay DISTINCT keys and can round-trip back to
-    their original shapes via :func:`_from_key`."""
+    """ETF terms used as ids/elems/actors must be hashable, shape-faithful
+    AND plain data: lists (unhashable), tuples, and atoms become
+    value-tagged tuples of builtins, so ``[1,2]`` / ``{1,2}`` /
+    ``'x'`` / ``<<"x">>`` all stay DISTINCT keys, round-trip via
+    :func:`_from_key`, and — critically for durable stores — pickle into
+    checkpoint manifests without referencing bridge classes (the
+    restricted manifest unpickler admits no bridge module; an
+    ``etf.Atom`` in an interner would make the log unloadable).
+
+    Tag unambiguity: raw ETF decode never yields a plain tuple (tuples
+    arrive only as containers, which this encoding always tags), so a
+    tuple starting with "atom"/"list"/"tuple" is always ours."""
+    if isinstance(term, Atom):  # BEFORE str/bytes checks: Atom is a str
+        return ("atom", str(term))
     if isinstance(term, list):
-        return (_LIST,) + tuple(_to_key(x) for x in term)
+        return ("list",) + tuple(_to_key(x) for x in term)
     if isinstance(term, tuple):
-        return (_TUPLE,) + tuple(_to_key(x) for x in term)
+        return ("tuple",) + tuple(_to_key(x) for x in term)
     return term
 
 
 def _from_key(term: Any) -> Any:
     if isinstance(term, tuple) and term:
-        if term[0] is _LIST:
+        if term[0] == "atom" and len(term) == 2:
+            return Atom(term[1])
+        if term[0] == "list":
             return [_from_key(x) for x in term[1:]]
-        if term[0] is _TUPLE:
+        if term[0] == "tuple":
             return tuple(_from_key(x) for x in term[1:])
     return term
 
@@ -299,22 +302,41 @@ class _Conn:
         return (etf.OK, Atom(name))
 
     def _persist(self, var_ids) -> None:
-        """Write-through the touched variables + manifest to the log."""
+        """Write-through the touched variables to the log — O(touched),
+        not O(store): one ``varmeta`` + leaf records per touched var, a
+        tiny counters record, and the header only when the var set grew.
+        Ordering is crash-safe: varmeta (interner superset) lands BEFORE
+        the state leaves, so a crash between the two restores a store
+        whose interner merely lists an element the state doesn't carry
+        yet — harmless — rather than state bits with no term to decode
+        to."""
         if self._hs is None:
             return
         import pickle
 
-        from ..store.checkpoint import _put_state, _var_manifest
+        from ..store.checkpoint import (
+            _put_leaves,
+            _state_leaf_meta,
+            _var_manifest,
+            _varmeta_key,
+        )
 
         for var_id in var_ids:
             if var_id not in self.store.ids():
                 continue
             var = self.store.variable(var_id)
             entry = _var_manifest(var)
-            _put_state(self._hs, var_id, var.state, entry)
-            self._manifest["vars"][var_id] = entry
-        self._manifest["mutations"] = self.store.mutations
-        self._hs.put("manifest", pickle.dumps(self._manifest))
+            entry["leaves"] = _state_leaf_meta(var.state)
+            self._hs.put(_varmeta_key(var_id), pickle.dumps(entry))
+            _put_leaves(self._hs, var_id, var.state)
+        ids = list(self.store.ids())
+        if ids != self._manifest["var_ids"]:
+            self._manifest["var_ids"] = ids
+            self._hs.put("manifest", pickle.dumps(self._manifest))
+        self._hs.put("counters", pickle.dumps(
+            {"metrics": dict(self.store.metrics),
+             "mutations": self.store.mutations}
+        ))
         self._writes += 1
         if self._writes % _COMPACT_EVERY == 0:
             self._hs.compact()

@@ -133,9 +133,21 @@ def cmd_inspect(args) -> int:
             # stock pickle.loads would execute attacker-controlled code
             m = loads_manifest(manifest)
             out["kind"] = m.get("kind")
-            out["vars"] = {
-                vid: entry["type_name"] for vid, entry in m.get("vars", {}).items()
-            }
+            if "vars" in m:  # runtime snapshots: inline entries
+                out["vars"] = {
+                    str(vid): entry["type_name"]
+                    for vid, entry in m["vars"].items()
+                }
+            else:  # store logs: header + per-var varmeta records
+                from lasp_tpu.store.checkpoint import _varmeta_key
+
+                out["vars"] = {}
+                for vid in m.get("var_ids", []):
+                    raw = hs.get(_varmeta_key(vid))
+                    entry = loads_manifest(raw) if raw is not None else None
+                    out["vars"][str(vid)] = (
+                        entry["type_name"] if entry else "<missing varmeta>"
+                    )
             if "n_replicas" in m:
                 out["n_replicas"] = m["n_replicas"]
         print(json.dumps(out, indent=2, default=str))

@@ -63,6 +63,8 @@ class LaspConfig:
             "LASP_BENCH_PROBE",
             "LASP_BENCH_TPU_TIMEOUT",
             "LASP_BENCH_CPU_TIMEOUT",
+            "LASP_BENCH_TOTAL_BUDGET",
+            "LASP_BENCH_CHILD_BUDGET",
             "LASP_DRYRUN",
         )
         for key, raw in env.items():

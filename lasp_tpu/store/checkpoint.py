@@ -109,13 +109,25 @@ def _restore_interners(var: Variable, m: dict) -> None:
                     shim.ivar_payloads.intern(t)
 
 
+def _varmeta_key(var_id) -> str:
+    return f"varmeta/{var_id!r}"
+
+
+def _state_leaf_meta(state) -> list:
+    return [
+        (str(np.asarray(leaf).dtype), np.asarray(leaf).shape)
+        for leaf in jax.tree_util.tree_leaves(state)
+    ]
+
+
+def _put_leaves(hs: HostStore, var_id: str, state) -> None:
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(state)):
+        hs.put(_leaf_key(var_id, i), np.asarray(leaf).tobytes())
+
+
 def _put_state(hs: HostStore, var_id: str, state, manifest_entry: dict) -> None:
-    leaves = jax.tree_util.tree_leaves(state)
-    manifest_entry["leaves"] = []
-    for i, leaf in enumerate(leaves):
-        arr = np.asarray(leaf)
-        manifest_entry["leaves"].append((str(arr.dtype), arr.shape))
-        hs.put(_leaf_key(var_id, i), arr.tobytes())
+    manifest_entry["leaves"] = _state_leaf_meta(state)
+    _put_leaves(hs, var_id, state)
 
 
 def _get_state(hs: HostStore, var_id: str, template, manifest_entry: dict):
@@ -134,21 +146,28 @@ def _get_state(hs: HostStore, var_id: str, template, manifest_entry: dict):
 
 
 def save_store(store: Store, path: str) -> None:
-    """Snapshot a single-replica store (the eleveldb persistence role)."""
+    """Snapshot a single-replica store (the eleveldb persistence role).
+
+    Layout: a small header record listing var ids, one ``varmeta/<id>``
+    record per variable (spec + interners + leaf shapes), a ``counters``
+    record, and the raw leaf records — so an incremental writer (the
+    durable bridge) re-appends only the touched variable's records per
+    mutation, O(touched) not O(store)."""
     with HostStore(path) as hs:
-        manifest = {
+        header = {
             "kind": "store",
             "n_actors": store.n_actors,
-            "metrics": dict(store.metrics),
-            "mutations": store.mutations,
-            "vars": {},
+            "var_ids": list(store.ids()),
         }
         for var_id in store.ids():
             var = store.variable(var_id)
             entry = _var_manifest(var)
             _put_state(hs, var_id, var.state, entry)
-            manifest["vars"][var_id] = entry
-        hs.put("manifest", pickle.dumps(manifest))
+            hs.put(_varmeta_key(var_id), pickle.dumps(entry))
+        hs.put("counters", pickle.dumps(
+            {"metrics": dict(store.metrics), "mutations": store.mutations}
+        ))
+        hs.put("manifest", pickle.dumps(header))
 
 
 def load_store(path: str) -> Store:
@@ -157,11 +176,18 @@ def load_store(path: str) -> Store:
         raw = hs.get("manifest")
         if raw is None:
             raise IOError(f"no checkpoint manifest in {path}")
-        manifest = loads_manifest(raw)
-        store = Store(n_actors=manifest["n_actors"])
-        store.metrics.update(manifest.get("metrics", {}))
-        store.mutations = manifest.get("mutations", 0)
-        for var_id, entry in manifest["vars"].items():
+        header = loads_manifest(raw)
+        store = Store(n_actors=header["n_actors"])
+        counters = hs.get("counters")
+        if counters is not None:
+            counters = loads_manifest(counters)
+            store.metrics.update(counters.get("metrics", {}))
+            store.mutations = counters.get("mutations", 0)
+        for var_id in header["var_ids"]:
+            raw_entry = hs.get(_varmeta_key(var_id))
+            if raw_entry is None:
+                raise IOError(f"checkpoint missing varmeta for {var_id!r}")
+            entry = loads_manifest(raw_entry)
             store.declare(id=var_id, type=entry["type_name"], spec=entry["spec"])
             var = store.variable(var_id)
             _restore_interners(var, entry)

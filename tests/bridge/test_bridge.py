@@ -311,8 +311,6 @@ def test_durable_merge_batch_midfail_persists_applied_prefix(tmp_path):
         with BridgeClient("127.0.0.1", server.port) as c:
             c.start("p")
             c.declare(b"a", "lasp_gset", n_elems=4)
-            st = c.get(b"a")[1]  # (type, portable-state)
-            # craft a live state for "a" via a real update on a twin var
             c.update(b"a", (Atom("add"), b"x"), b"w")
             live = c.get(b"a")[1]
             resp = c.call((Atom("merge_batch"),
@@ -327,4 +325,32 @@ def test_durable_merge_batch_midfail_persists_applied_prefix(tmp_path):
                     break
                 time.sleep(0.02)
             assert c2.read(b"a") == (Atom("ok"), [b"x"])
-            del st
+
+
+def test_durable_store_survives_atom_and_container_terms(tmp_path):
+    """Atom ids/elems/actors and container ids are BEAM-idiomatic; the
+    durable log must reload them (the key encoding is plain data — a
+    bridge class in an interner would be refused by the restricted
+    manifest unpickler and brick the store)."""
+    import time
+
+    d = str(tmp_path / "stores")
+    with BridgeServer(data_dir=d) as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("p")
+            assert c.declare(Atom("myvar"), "lasp_orset", n_elems=8) == (
+                Atom("ok"), Atom("myvar")
+            )
+            c.update(Atom("myvar"), (Atom("add"), Atom("elem_a")), Atom("w"))
+            c.update(Atom("myvar"), (Atom("add"), [b"x", 1]), b"w")
+            c.declare([1, 2], "lasp_gset", n_elems=4)
+            c.update([1, 2], (Atom("add"), (b"t", 9)), b"w")
+        with BridgeClient("127.0.0.1", server.port) as c2:
+            for _ in range(100):
+                if c2.start("p")[0] == Atom("ok"):
+                    break
+                time.sleep(0.02)
+            ok, val = c2.read(Atom("myvar"))
+            assert ok == Atom("ok")
+            assert Atom("elem_a") in val and [b"x", 1] in val
+            assert c2.read([1, 2]) == (Atom("ok"), [(b"t", 9)])
