@@ -1242,19 +1242,36 @@ class ReplicatedRuntime:
         self._fused_steps_cache.clear()
 
     # -- sharding -------------------------------------------------------------
-    def shard(self, mesh: jax.sharding.Mesh, axis: str = "replicas") -> None:
-        """Distribute every variable's replica axis over a mesh axis; states
-        move device-side and the jitted step computes with XLA-inserted
-        collectives over ICI (SURVEY.md §2.5 communication-backend table)."""
-        sharding = jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec(axis)
-        )
+    def shard(self, mesh: jax.sharding.Mesh, axis=None) -> None:
+        """Distribute every variable's replica axis over a mesh axis (a
+        name or a tuple of names); states move device-side and the jitted
+        step computes with XLA-inserted collectives over ICI (SURVEY.md
+        §2.5 communication-backend table).
+
+        With ``axis=None`` the layout adapts to the mesh: on the canonical
+        ``build_mesh`` axes the population splits over ``("slices",
+        "replicas")`` — coarse partition across DCN slices, fine within a
+        slice (SURVEY §2.5 "partition the replica graph between slices") —
+        and over plain ``"replicas"`` otherwise."""
+        if axis is None and {"slices", "replicas"} <= set(mesh.axis_names):
+            # canonical build_mesh layout: comm.py owns its definition
+            from .comm import neighbor_sharding, population_sharding
+
+            sharding = population_sharding(mesh)
+            nbr_sharding = neighbor_sharding(mesh)
+        else:
+            if axis is None:
+                axis = "replicas"
+            sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(axis)
+            )
+            nbr_sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(axis, None)
+            )
         self.states = {
             v: jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, sharding), self.states[v]
             )
             for v in self.var_ids
         }
-        self.neighbors = jax.device_put(
-            self.neighbors, jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec(axis, None))
-        )
+        self.neighbors = jax.device_put(self.neighbors, nbr_sharding)

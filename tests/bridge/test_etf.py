@@ -84,3 +84,36 @@ def test_improper_and_truncated_raise():
     with pytest.raises(etf.ETFDecodeError):
         # LIST with a non-nil tail (improper list)
         etf.decode(bytes([131, 108, 0, 0, 0, 1, 97, 1, 97, 2]))
+
+
+def test_fuzz_roundtrip_random_nested_terms():
+    """decode(encode(t)) == t over a few hundred random nested terms —
+    the property the EQC binary round-trip runs per CRDT
+    (test/crdt_statem_eqc.erl prop_bin_roundtrip), here at the codec."""
+    import random
+
+    rng = random.Random(99)
+
+    def gen(depth=0):
+        kinds = ["int", "bigint", "bytes", "atom", "float"]
+        if depth < 3:
+            kinds += ["list", "tuple", "list", "tuple"]
+        k = rng.choice(kinds)
+        if k == "int":
+            return rng.randint(-(1 << 30), 1 << 30)
+        if k == "bigint":
+            return rng.randint(-(1 << 200), 1 << 200)
+        if k == "bytes":
+            return bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 12)))
+        if k == "atom":
+            return Atom("".join(rng.choice("abc_xyz") for _ in range(rng.randint(1, 10))))
+        if k == "float":
+            return rng.uniform(-1e12, 1e12)
+        n = rng.randint(0, 4)
+        items = [gen(depth + 1) for _ in range(n)]
+        return items if k == "list" else tuple(items)
+
+    for i in range(300):
+        t = gen()
+        got = etf.decode(etf.encode(t))
+        assert got == t, (i, t, got)

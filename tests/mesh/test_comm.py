@@ -88,6 +88,27 @@ def test_sharded_gossip_converges_on_two_slice_mesh():
     assert (np.asarray(out.mask) == expect[None, :]).all()
 
 
+def test_runtime_shard_on_two_slice_mesh():
+    """ReplicatedRuntime.shard with no axis adapts to the canonical mesh:
+    population split over (slices, replicas), and the engine still steps
+    to the right fixed point across the virtual DCN boundary."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    devs = jax.devices()
+    mesh = build_mesh(slice_of={d: i // 4 for i, d in enumerate(devs)}.get)
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    v = store.declare(id="v", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 32, ring(32, 2))
+    rt.update_batch(v, [(0, ("add", "k"), "w")])
+    rt.shard(mesh)
+    rt.run_to_convergence(block=4)
+    assert rt.coverage_value(v) == frozenset({"k"})
+    assert rt.divergence(v) == 0
+
+
 def test_sharded_gossip_converges_on_built_mesh():
     mesh = build_mesh()
     n, e = 64, 16
