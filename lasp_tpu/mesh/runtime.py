@@ -1242,7 +1242,11 @@ class ReplicatedRuntime:
         self._fused_steps_cache.clear()
 
     # -- sharding -------------------------------------------------------------
-    def shard(self, mesh: jax.sharding.Mesh, axis=None) -> None:
+    def shard(
+        self,
+        mesh: jax.sharding.Mesh,
+        axis: "str | tuple[str, ...] | None" = None,
+    ) -> None:
         """Distribute every variable's replica axis over a mesh axis (a
         name or a tuple of names); states move device-side and the jitted
         step computes with XLA-inserted collectives over ICI (SURVEY.md
@@ -1252,8 +1256,15 @@ class ReplicatedRuntime:
         ``build_mesh`` axes the population splits over ``("slices",
         "replicas")`` — coarse partition across DCN slices, fine within a
         slice (SURVEY §2.5 "partition the replica graph between slices") —
-        and over plain ``"replicas"`` otherwise."""
-        if axis is None and {"slices", "replicas"} <= set(mesh.axis_names):
+        falling back to plain ``"replicas"`` when the population doesn't
+        divide the joint extent (or the mesh isn't canonical)."""
+        joint_divides = (
+            {"slices", "replicas"} <= set(mesh.axis_names)
+            and self.n_replicas
+            % (mesh.shape["slices"] * mesh.shape["replicas"])
+            == 0
+        )
+        if axis is None and joint_divides:
             # canonical build_mesh layout: comm.py owns its definition
             from .comm import neighbor_sharding, population_sharding
 

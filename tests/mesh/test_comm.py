@@ -109,6 +109,26 @@ def test_runtime_shard_on_two_slice_mesh():
     assert rt.divergence(v) == 0
 
 
+def test_runtime_shard_falls_back_when_joint_axis_does_not_divide():
+    """n_replicas not divisible by slices*replicas: shard(None) must fall
+    back to the plain replicas split instead of raising."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    devs = jax.devices()
+    mesh = build_mesh(slice_of={d: i // 4 for i, d in enumerate(devs)}.get)
+    assert mesh.shape["slices"] * mesh.shape["replicas"] == 8
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    v = store.declare(id="v", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 12, ring(12, 2))  # 12 % 8 != 0
+    rt.update_batch(v, [(0, ("add", "k"), "w")])
+    rt.shard(mesh)
+    rt.run_to_convergence(block=4)
+    assert rt.coverage_value(v) == frozenset({"k"})
+
+
 def test_sharded_gossip_converges_on_built_mesh():
     mesh = build_mesh()
     n, e = 64, 16

@@ -283,3 +283,57 @@ def test_read_until_quiescent_on_final_block_still_labeled():
     # block, which is also the whole budget
     with pytest.raises(TimeoutError, match="unreachable"):
         rt.read_until(0, "c", Threshold(99), max_rounds=8, block=8)
+
+
+def test_engine_fixed_point_schedule_independent():
+    """Whole-engine determinism (SURVEY §5 permutation suite, at the top
+    altitude): the same client ops issued in different orders, at
+    different replicas, over different gossip topologies, through
+    different block sizes, all converge to the IDENTICAL dataflow fixed
+    point — the merge-schedule-independence argument that lets the BSP
+    engine stand in for the reference's asynchronous FSMs."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import random_regular, scale_free
+    from lasp_tpu.store import Store
+
+    def run(order, topo_fn, block, n=24):
+        store = Store(n_actors=4)
+        graph = Graph(store)
+        a = store.declare(id="a", type="lasp_orset", n_elems=8)
+        b = store.declare(id="b", type="lasp_orset", n_elems=8)
+        u = graph.union(a, b, dst="u")
+        graph.filter(u, lambda x: not x.endswith("!"), dst="keep")
+        ops = [
+            (a, (3, ("add", "x"), "w1")),
+            (a, (7, ("add", "gone!"), "w1")),
+            (b, (11, ("add_all", ["y", "z"]), "w2")),
+            # remove at the SAME replica as the add: observe-remove needs
+            # the tokens visible locally (no gossip runs between ops here)
+            (a, (7, ("remove", "gone!"), "w1")),
+        ]
+        rt = ReplicatedRuntime(store, graph, n, topo_fn(n))
+        for i in order:
+            var, (r, op, actor) = ops[i]
+            rt.update_batch(var, [(r % n, op, actor)])
+        rt.run_to_convergence(block=block)
+        assert rt.divergence("keep") == 0
+        # check the UNION too: a schedule-dependent (or silently no-op'd)
+        # remove would leave "gone!" in u, which the filter on keep hides
+        assert rt.coverage_value("u") == frozenset({"x", "y", "z"})
+        return rt.coverage_value("keep")
+
+    # remove-after-add must stay AFTER its add in any tested order
+    # (observe-remove semantics: an unobserved remove is a precondition
+    # error, exactly like the reference)
+    orders = [(0, 1, 2, 3), (1, 0, 2, 3), (2, 1, 0, 3), (1, 2, 0, 3)]
+    topos = [
+        lambda n: ring(n, 2),
+        lambda n: random_regular(n, 3, seed=2),
+        lambda n: scale_free(n, 3, seed=2),
+    ]
+    results = {
+        run(o, t, blk)
+        for o in orders
+        for t, blk in zip(topos, (1, 4, 8))
+    }
+    assert results == {frozenset({"x", "y", "z"})}
