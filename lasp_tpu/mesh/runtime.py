@@ -261,6 +261,9 @@ class ReplicatedRuntime:
             verb = op[0]
             need_e: list = []
             need_a: list = []
+            # lasp_ivar needs no prefix walk: its payload interner is
+            # effectively unbounded (store.py hardcodes 2**31-1 and
+            # declare() exposes no ivar capacity kwarg)
             if tn == "riak_dt_gcounter":
                 if actor not in var.actors and actor not in seen_a:
                     need_a = [actor]
@@ -343,10 +346,46 @@ class ReplicatedRuntime:
             self._orset_batch(var, ops)
         elif tn == "riak_dt_orswot":
             self._orswot_batch(var, ops)
-        else:
-            raise ValueError(
-                f"update_batch: unsupported type {tn!r} (use update_at)"
+        elif tn == "lasp_ivar":
+            rows, payloads = [], []
+            for r, op, _actor in ops:
+                if op[0] != "set":
+                    raise ValueError(f"update_batch: unsupported op {op!r}")
+                rows.append(r)
+                payloads.append(var.ivar_payloads.intern(op[1]))
+            rows = np.asarray(rows, dtype=np.int32)
+            payloads = np.asarray(payloads, dtype=states.value.dtype)
+            # sequential semantics: per row, the FIRST set wins (a later
+            # different payload is a non-inflation the bind rule ignores),
+            # and an already-defined row keeps its value (single
+            # assignment, src/lasp_ivar.erl:50-56)
+            _, first = np.unique(rows, return_index=True)
+            rows, payloads = rows[first], payloads[first]
+            # gather the touched rows' flags DEVICE-side: pulling the full
+            # [R] defined plane would be O(population) host traffic per
+            # batch (the cliff the ORSWOT batch path removed)
+            open_rows = ~np.asarray(states.defined[rows])
+            rows, payloads = rows[open_rows], payloads[open_rows]
+            self.states[var_id] = states._replace(
+                defined=states.defined.at[rows].set(True),
+                value=states.value.at[rows].set(payloads),
             )
+        else:
+            # vclock-composed types (riak_dt_map): no vectorized kernel —
+            # fall back to per-op update_at, preserving exact sequential
+            # semantics at O(batch) device dispatches. Loud enough to
+            # never hide a population-scale perf cliff.
+            import warnings
+
+            warnings.warn(
+                f"update_batch({tn!r}): no vectorized kernel; applying "
+                f"{len(ops)} ops via per-op update_at (one dispatch per "
+                "op — fine for control-plane writes, not for "
+                "population-scale seeding)",
+                stacklevel=3,
+            )
+            for r, op, actor in ops:
+                self.update_at(r, var_id, op, actor)
 
     def _orset_batch(self, var, ops) -> None:
         """Batched OR-Set adds/removes with SEQUENTIAL semantics: ops are
@@ -1257,7 +1296,8 @@ class ReplicatedRuntime:
         "replicas")`` — coarse partition across DCN slices, fine within a
         slice (SURVEY §2.5 "partition the replica graph between slices") —
         falling back to plain ``"replicas"`` when the population doesn't
-        divide the joint extent (or the mesh isn't canonical)."""
+        divide the joint extent (or the mesh isn't canonical), and raising
+        a clear error when it divides neither."""
         joint_divides = (
             {"slices", "replicas"} <= set(mesh.axis_names)
             and self.n_replicas
@@ -1273,6 +1313,14 @@ class ReplicatedRuntime:
         else:
             if axis is None:
                 axis = "replicas"
+                if self.n_replicas % mesh.shape[axis] != 0:
+                    raise ValueError(
+                        f"cannot shard {self.n_replicas} replicas over this "
+                        f"mesh: neither the joint (slices, replicas) extent "
+                        f"nor the replicas extent ({mesh.shape[axis]}) "
+                        f"divides the population — resize the population "
+                        f"or pass an explicit axis"
+                    )
             sharding = jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(axis)
             )

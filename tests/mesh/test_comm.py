@@ -128,6 +128,14 @@ def test_runtime_shard_falls_back_when_joint_axis_does_not_divide():
     rt.run_to_convergence(block=4)
     assert rt.coverage_value(v) == frozenset({"k"})
 
+    # population dividing NEITHER extent: a clear error, not a jax one
+    store2 = Store(n_actors=2)
+    graph2 = Graph(store2)
+    store2.declare(id="v", type="lasp_gset", n_elems=4)
+    rt2 = ReplicatedRuntime(store2, graph2, 10, ring(10, 2))  # 10 % 4 != 0
+    with pytest.raises(ValueError, match="resize the population"):
+        rt2.shard(mesh)
+
 
 def test_sharded_gossip_converges_on_built_mesh():
     mesh = build_mesh()

@@ -493,3 +493,50 @@ def test_update_batch_accepts_iterator_payloads():
     rt2.update_batch("s", [(0, ("add_all", iter(["x", "y"])), "w")])
     rt2.update_batch("s", [(0, ("remove_all", iter(["x"])), "w")])
     assert rt2.replica_value("s", 0) == {"y"}
+
+
+def test_ivar_batch_first_set_wins_and_respects_existing():
+    """Vectorized I-Var batch: per row the FIRST set defines (later
+    different payloads are bind-rule non-inflations), and an
+    already-defined row keeps its value (src/lasp_ivar.erl:50-56)."""
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    v = store.declare(id="v", type="lasp_ivar")
+    rt = ReplicatedRuntime(store, graph, 8, ring(8, 2))
+    rt.update_batch(v, [(3, ("set", "pre"), "w")])
+    rt.update_batch(v, [
+        (0, ("set", "a"), "w"),
+        (0, ("set", "clobber"), "w"),   # same row, later: ignored
+        (3, ("set", "clobber"), "w"),   # already defined: ignored
+        (5, ("set", "b"), "w"),
+    ])
+    assert rt.replica_value(v, 0) == "a"
+    assert rt.replica_value(v, 3) == "pre"
+    assert rt.replica_value(v, 5) == "b"
+    # converges to ONE winner under the ivar conflict rule, deterministically
+    rt.run_to_convergence(block=4)
+    assert rt.divergence(v) == 0
+
+
+def test_map_batch_falls_back_to_per_op_with_warning():
+    import warnings
+
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    m = store.declare(
+        id="m", type="riak_dt_map",
+        fields=[("tags", "lasp_gset", {"n_elems": 4}),
+                ("hits", "riak_dt_gcounter", {})],
+        n_actors=4,
+    )
+    rt = ReplicatedRuntime(store, graph, 8, ring(8, 2))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        rt.update_batch(m, [
+            (0, ("update", "tags", ("add", "t1")), "w0"),
+            (2, ("update", "hits", ("increment", 3)), "w1"),
+        ])
+    assert any("no vectorized kernel" in str(w.message) for w in caught)
+    rt.run_to_convergence(block=4)
+    assert rt.coverage_value(m) == {"tags": frozenset({"t1"}), "hits": 3}
+    assert rt.divergence(m) == 0
