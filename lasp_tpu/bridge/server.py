@@ -153,6 +153,25 @@ def _export_state(var) -> Any:
             Atom("value"),
             _from_key(var.ivar_payloads.terms()[int(state.value)]),
         )
+    if tn == "riak_dt_orswot":
+        # {VClock, Entries} in portable form: the dense (clock, dot-matrix)
+        # encoding round-trips as per-actor clock pairs + per-element dot
+        # lists (riak_dt_orswot's own state shape, minus deferred ops,
+        # which the synchronous bridge never accumulates)
+        clock = np.asarray(state.clock)
+        dots = np.asarray(state.dots)
+        actors = var.actors.terms()
+        clock_part = [
+            (_from_key(actors[a]), int(clock[a])) for a in np.flatnonzero(clock)
+        ]
+        entries = []
+        for e in np.flatnonzero(dots.any(axis=-1)):
+            entries.append((
+                _from_key(var.elems.terms()[int(e)]),
+                [(_from_key(actors[a]), int(dots[e, a]))
+                 for a in np.flatnonzero(dots[e])],
+            ))
+        return (clock_part, entries)
     raise ValueError(f"bridge: unsupported type {tn!r}")
 
 
@@ -170,18 +189,21 @@ def _import_state(var, portable: Any):
             )
         return state
     if tn in ("lasp_orset", "lasp_orset_gbtree"):
+        # validate BEFORE interning: a rejected state must not consume
+        # interner capacity or leave ghost elements on the live variable
+        for _elem, toks in portable or []:
+            for tok, _deleted in toks:
+                if not 0 <= int(tok) < spec.n_tokens:
+                    raise ValueError(
+                        f"token {int(tok)} outside token space {spec.n_tokens}"
+                    )
         ex = np.zeros((spec.n_elems, spec.n_tokens), dtype=bool)
         rm = np.zeros_like(ex)
         for elem, toks in portable or []:
             e = var.elems.intern(_to_key(elem))
             for tok, deleted in toks:
-                tok = int(tok)
-                if not 0 <= tok < spec.n_tokens:
-                    raise ValueError(
-                        f"token {tok} outside token space {spec.n_tokens}"
-                    )
-                ex[e, tok] = True
-                rm[e, tok] = bool(deleted)
+                ex[e, int(tok)] = True
+                rm[e, int(tok)] = bool(deleted)
         return state._replace(exists=jnp.asarray(ex), removed=jnp.asarray(rm))
     if tn == "riak_dt_gcounter":
         counts = np.zeros((spec.n_actors,), dtype=np.asarray(state.counts).dtype)
@@ -194,6 +216,32 @@ def _import_state(var, portable: Any):
         tag, value = portable
         return var.codec.set(
             spec, state, var.ivar_payloads.intern(_to_key(value))
+        )
+    if tn == "riak_dt_orswot":
+        clock_part, entries = portable if portable else ([], [])
+        # validate every dot against the PORTABLE clock before interning
+        # anything — a rejected bind/put must not consume actor/elem
+        # capacity on the live variable (the same precheck-before-intern
+        # rule the runtime's ORSWOT batch path follows)
+        pclock = {_to_key(actor): int(count) for actor, count in clock_part}
+        for elem, elem_dots in entries:
+            for actor, count in elem_dots:
+                seen = pclock.get(_to_key(actor), 0)
+                if int(count) < 1 or int(count) > seen:
+                    raise ValueError(
+                        f"dot ({actor!r}, {int(count)}) outside the state's "
+                        f"own clock ({seen}) — not a valid orswot state"
+                    )
+        clock = np.zeros((spec.n_actors,), dtype=np.int32)
+        dots = np.zeros((spec.n_elems, spec.n_actors), dtype=np.int32)
+        for actor, count in clock_part:
+            clock[var.actors.intern(_to_key(actor))] = int(count)
+        for elem, elem_dots in entries:
+            e = var.elems.intern(_to_key(elem))
+            for actor, count in elem_dots:
+                dots[e, var.actors.intern(_to_key(actor))] = int(count)
+        return state._replace(
+            clock=jnp.asarray(clock), dots=jnp.asarray(dots)
         )
     raise ValueError(f"bridge: unsupported type {tn!r}")
 

@@ -354,3 +354,81 @@ def test_durable_store_survives_atom_and_container_terms(tmp_path):
             assert ok == Atom("ok")
             assert Atom("elem_a") in val and [b"x", 1] in val
             assert c2.read([1, 2]) == (Atom("ok"), [(b"t", 9)])
+
+
+def test_orswot_bridge_round_trip_and_merge():
+    """riak_dt_orswot over the wire: {VClock, Entries} portable form,
+    get/put round-trip, and the no-tombstone remove-wins merge (a dot the
+    peer's clock has seen but no longer carries stays removed)."""
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("v")
+            c.declare(b"s", "riak_dt_orswot", n_elems=8, n_actors=4)
+            c.update(b"s", (Atom("add"), b"x"), b"a1")
+            c.update(b"s", (Atom("add"), b"y"), b"a2")
+            ok, (type_atom, portable) = c.get(b"s")
+            assert ok == Atom("ok") and type_atom == Atom("riak_dt_orswot")
+            clock, entries = portable
+            assert (b"a1", 1) in clock and (b"a2", 1) in clock
+            assert dict(entries)[b"x"] == [(b"a1", 1)]
+            # blind put into a twin, value preserved
+            assert c.put(b"s2", "riak_dt_orswot", portable,
+                         n_elems=8, n_actors=4) == Atom("ok")
+            ok, val = c.read(b"s2")
+            assert set(val) == {b"x", b"y"}
+            # peer state whose clock saw a1@1 but carries no dot for x:
+            # binding it must NOT resurrect x... and y removed by peer
+            peer = ([(b"a1", 1), (b"a2", 1)], [])
+            ok, val = c.bind(b"s2", peer)
+            assert ok == Atom("ok") and val == []
+            # invalid dot (beyond own clock) is refused loudly
+            bad = ([(b"a9", 1)], [(b"z", [(b"a9", 2)])])
+            resp = c.put(b"s3", "riak_dt_orswot", bad, n_elems=4, n_actors=4)
+            assert resp[0] == Atom("error")
+
+
+def test_orswot_bridge_durable(tmp_path):
+    import time
+
+    d = str(tmp_path / "stores")
+    with BridgeServer(data_dir=d) as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("p")
+            c.declare(b"s", "riak_dt_orswot", n_elems=8, n_actors=4)
+            c.update(b"s", (Atom("add"), b"x"), b"a1")
+            c.update(b"s", (Atom("remove"), b"x"), b"a1")
+            c.update(b"s", (Atom("add"), b"y"), b"a2")
+        with BridgeClient("127.0.0.1", server.port) as c2:
+            for _ in range(100):
+                if c2.start("p")[0] == Atom("ok"):
+                    break
+                time.sleep(0.02)
+            assert c2.read(b"s") == (Atom("ok"), [b"y"])
+
+
+def test_rejected_state_consumes_no_interner_capacity():
+    """A rejected bind/put must leave the live variable untouched — no
+    ghost elems/actors interned (4 bad binds must not exhaust a 4-actor
+    universe)."""
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("v")
+            c.declare(b"s", "riak_dt_orswot", n_elems=4, n_actors=4)
+            for i in range(6):  # > n_actors rejected states
+                bad = ([(f"bad{i}".encode(), 1)],
+                       [(b"z", [(f"bad{i}".encode(), 2)])])
+                resp = c.bind(b"s", bad)
+                assert resp[0] == Atom("error")
+            # legitimate actors still fit
+            for i in range(4):
+                ok, _ = c.update(b"s", (Atom("add"), b"x"), f"a{i}".encode())
+                assert ok == Atom("ok")
+            # orset: bad token index must not intern the element
+            c.declare(b"o", "lasp_orset", n_elems=2, n_actors=1,
+                      tokens_per_actor=1)
+            for i in range(4):
+                resp = c.bind(b"o", [(f"g{i}".encode(), [(99, False)])])
+                assert resp[0] == Atom("error")
+            ok, _ = c.update(b"o", (Atom("add"), b"real"), b"w")
+            assert ok == Atom("ok")
+            assert c.read(b"o") == (Atom("ok"), [b"real"])
