@@ -40,6 +40,18 @@ terms; tokens are integers into the declared token space):
   shape of ``src/lasp_orset.erl:42-45``, tokens dense)
 - ``riak_dt_gcounter``: ``[{Actor, Count}, ...]``
 - ``lasp_ivar``: ``undefined`` | ``{value, Term}``
+- ``riak_dt_orswot``: ``{[{Actor, Count}, ...],
+  [{Elem, [{Actor, Dot}, ...]}, ...]}``  (clock + per-element birth
+  dots; no tombstones, no deferred ops)
+- ``riak_dt_map``: ``{[{Actor, Count}, ...],
+  [{Key, [{Actor, Dot}, ...], InnerState}, ...]}`` — one triple per
+  STATIC schema field (declare with caps
+  ``#{fields => [{Key, TypeAtom, Caps}, ...]}``); presence dots follow
+  OR-SWOT logic, ``InnerState`` is the field type's own portable shape.
+  Values read back as proplists ``[{Key, Value}, ...]``
+  (``riak_dt_map:value`` shape). Map update ops:
+  ``{update, Key, InnerOp}``, ``{remove, Key}``, or the batched
+  ``{update, [SubOp, ...]}``
 
 Every connection owns an isolated :class:`~lasp_tpu.store.Store` (the
 per-vnode store of the reference; one vnode holds one connection).
@@ -62,6 +74,57 @@ _HDR = struct.Struct(">I")
 
 #: declare caps accepted over the wire, per type (mirrors store.ALLOWED_CAPS)
 _CAP_KEYS = ("n_elems", "n_actors", "tokens_per_actor")
+
+
+def _convert_op(op: tuple) -> tuple:
+    """Wire op -> store op. Container positions that are op SYNTAX — the
+    term collection of add_all/remove_all, and the nested field ops of
+    the map's {update, Key, InnerOp} / {update, [SubOps]} — keep their
+    shape; everything else is a TERM and goes through the key encoding."""
+    verb_s = str(op[0])
+    if verb_s in ("add_all", "remove_all"):
+        return (verb_s, [_to_key(x) for x in op[1]])
+    if verb_s == "update" and len(op) == 2 and isinstance(op[1], list):
+        # riak_dt_map batched shape {update, [SubOps]}: every sub-op is
+        # itself op syntax (store.py _apply_op accepts this shape)
+        return (
+            verb_s,
+            [
+                _convert_op(s if isinstance(s, tuple) else (s,))
+                for s in op[1]
+            ],
+        )
+    if verb_s == "update" and len(op) == 3:
+        # riak_dt_map {update, Key, InnerOp}: Key is a term; InnerOp is
+        # syntax and recurses (a bare atom like `increment` is an op too)
+        inner = op[2] if isinstance(op[2], tuple) else (op[2],)
+        return (verb_s, _to_key(op[1]), _convert_op(inner))
+    return (verb_s,) + tuple(_to_key(x) for x in op[1:])
+
+
+def _parse_caps(caps) -> dict:
+    """Wire caps -> declare kwargs. Scalar capacities pass as ints; a
+    ``fields`` entry (riak_dt_map static schema) is a list of
+    ``{Key, TypeAtom, Caps}`` triples, recursively parsed."""
+    kwargs = {}
+    for k, v in (caps or {}).items():
+        ks = str(k)
+        if ks in _CAP_KEYS:
+            kwargs[ks] = int(v)
+        elif ks == "fields":
+            kwargs["fields"] = [
+                (
+                    _to_key(fk),
+                    str(ft),
+                    {
+                        str(ck): int(cv)
+                        for ck, cv in (fc or {}).items()
+                        if str(ck) in _CAP_KEYS
+                    },
+                )
+                for fk, ft, fc in v
+            ]
+    return kwargs
 
 
 def _recv_frame(sock: socket.socket) -> Optional[bytes]:
@@ -122,9 +185,9 @@ def _from_key(term: Any) -> Any:
 # portable-state import/export
 # ---------------------------------------------------------------------------
 
-def _export_state(var) -> Any:
+def _export_state(var, state=None) -> Any:
     tn = var.type_name
-    state = var.state
+    state = var.state if state is None else state
     if tn == "lasp_gset":
         mask = np.asarray(state.mask)
         return [_from_key(var.elems.terms()[i]) for i in np.flatnonzero(mask)]
@@ -172,15 +235,105 @@ def _export_state(var) -> Any:
                  for a in np.flatnonzero(dots[e])],
             ))
         return (clock_part, entries)
+    if tn == "riak_dt_map":
+        # {VClock, Fields}: per schema field (STATIC schema — the dense
+        # divergence documented in lattice/map.py) a (key, presence-dots,
+        # embedded-portable) triple. Embedded contents ride even for
+        # absent fields: they are join-monotone across remove/re-add
+        # here, so a faithful round-trip must carry them.
+        clock = np.asarray(state.clock)
+        dots = np.asarray(state.dots)
+        actors = var.actors.terms()
+        clock_part = [
+            (_from_key(actors[a]), int(clock[a])) for a in np.flatnonzero(clock)
+        ]
+        fields_part = []
+        for f, (key, _fcodec, _fspec) in enumerate(var.spec.fields):
+            fdots = [
+                (_from_key(actors[a]), int(dots[f, a]))
+                for a in np.flatnonzero(dots[f])
+            ]
+            inner = _export_state(var.map_aux[f], state=state.fields[f])
+            fields_part.append((_from_key(key), fdots, inner))
+        return (clock_part, fields_part)
     raise ValueError(f"bridge: unsupported type {tn!r}")
 
 
-def _import_state(var, portable: Any):
+def _check_capacity(interner, terms, what: str) -> None:
+    if interner is None:
+        return
+    new = {t for t in terms if t not in interner}
+    free = interner.capacity - len(interner)
+    if len(new) > free:
+        raise ValueError(
+            f"state names {len(new)} new {what}s but only {free} "
+            f"slot(s) remain (capacity {interner.capacity}) — "
+            "rejected before interning anything"
+        )
+
+
+def _validate_portable(var, portable: Any) -> None:
+    """Full validation of a portable state WITHOUT touching any interner
+    — structure (token ranges, dots vs the state's own clock, schema
+    keys) AND interner capacity for every new elem/actor it names,
+    recursing into map fields — so a rejected state consumes no capacity
+    anywhere, including in embedded field universes."""
+    tn, spec = var.type_name, var.spec
+    if tn == "lasp_gset":
+        _check_capacity(var.elems, [_to_key(e) for e in portable or []], "elem")
+    elif tn in ("lasp_orset", "lasp_orset_gbtree"):
+        for _elem, toks in portable or []:
+            for tok, _deleted in toks:
+                if not 0 <= int(tok) < spec.n_tokens:
+                    raise ValueError(
+                        f"token {int(tok)} outside token space {spec.n_tokens}"
+                    )
+        _check_capacity(
+            var.elems, [_to_key(e) for e, _t in portable or []], "elem"
+        )
+    elif tn == "riak_dt_gcounter":
+        _check_capacity(
+            var.actors, [_to_key(a) for a, _c in portable or []], "actor"
+        )
+    elif tn == "riak_dt_orswot":
+        clock_part, entries = portable if portable else ([], [])
+        pclock = {_to_key(a): int(c) for a, c in clock_part}
+        for _elem, elem_dots in entries:
+            for actor, count in elem_dots:
+                seen = pclock.get(_to_key(actor), 0)
+                if int(count) < 1 or int(count) > seen:
+                    raise ValueError(
+                        f"dot ({actor!r}, {int(count)}) outside the state's "
+                        f"own clock ({seen}) — not a valid orswot state"
+                    )
+        _check_capacity(var.actors, pclock, "actor")
+        _check_capacity(
+            var.elems, [_to_key(e) for e, _d in entries], "elem"
+        )
+    elif tn == "riak_dt_map":
+        clock_part, fields_part = portable if portable else ([], [])
+        pclock = {_to_key(a): int(c) for a, c in clock_part}
+        for key, fdots, inner in fields_part:
+            f = spec.field_index(_to_key(key))  # KeyError if unknown field
+            for actor, count in fdots:
+                seen = pclock.get(_to_key(actor), 0)
+                if int(count) < 1 or int(count) > seen:
+                    raise ValueError(
+                        f"field dot ({actor!r}, {int(count)}) outside the "
+                        f"state's own clock ({seen}) — not a valid map state"
+                    )
+            _validate_portable(var.map_aux[f], inner)
+        _check_capacity(var.actors, pclock, "actor")
+
+
+def _import_state(var, portable: Any, *, _validated: bool = False):
     import jax.numpy as jnp
 
     tn = var.type_name
     spec = var.spec
     state = var.codec.new(spec)
+    if not _validated:
+        _validate_portable(var, portable)
     if tn == "lasp_gset":
         idx = [var.elems.intern(_to_key(e)) for e in (portable or [])]
         if idx:
@@ -189,14 +342,6 @@ def _import_state(var, portable: Any):
             )
         return state
     if tn in ("lasp_orset", "lasp_orset_gbtree"):
-        # validate BEFORE interning: a rejected state must not consume
-        # interner capacity or leave ghost elements on the live variable
-        for _elem, toks in portable or []:
-            for tok, _deleted in toks:
-                if not 0 <= int(tok) < spec.n_tokens:
-                    raise ValueError(
-                        f"token {int(tok)} outside token space {spec.n_tokens}"
-                    )
         ex = np.zeros((spec.n_elems, spec.n_tokens), dtype=bool)
         rm = np.zeros_like(ex)
         for elem, toks in portable or []:
@@ -219,19 +364,6 @@ def _import_state(var, portable: Any):
         )
     if tn == "riak_dt_orswot":
         clock_part, entries = portable if portable else ([], [])
-        # validate every dot against the PORTABLE clock before interning
-        # anything — a rejected bind/put must not consume actor/elem
-        # capacity on the live variable (the same precheck-before-intern
-        # rule the runtime's ORSWOT batch path follows)
-        pclock = {_to_key(actor): int(count) for actor, count in clock_part}
-        for elem, elem_dots in entries:
-            for actor, count in elem_dots:
-                seen = pclock.get(_to_key(actor), 0)
-                if int(count) < 1 or int(count) > seen:
-                    raise ValueError(
-                        f"dot ({actor!r}, {int(count)}) outside the state's "
-                        f"own clock ({seen}) — not a valid orswot state"
-                    )
         clock = np.zeros((spec.n_actors,), dtype=np.int32)
         dots = np.zeros((spec.n_elems, spec.n_actors), dtype=np.int32)
         for actor, count in clock_part:
@@ -243,14 +375,44 @@ def _import_state(var, portable: Any):
         return state._replace(
             clock=jnp.asarray(clock), dots=jnp.asarray(dots)
         )
+    if tn == "riak_dt_map":
+        clock_part, fields_part = portable if portable else ([], [])
+        clock = np.zeros((spec.n_actors,), dtype=np.int32)
+        dots = np.zeros((spec.n_fields, spec.n_actors), dtype=np.int32)
+        for actor, count in clock_part:
+            clock[var.actors.intern(_to_key(actor))] = int(count)
+        fields = list(state.fields)
+        for key, fdots, inner in fields_part:
+            f = spec.field_index(_to_key(key))
+            for actor, count in fdots:
+                dots[f, var.actors.intern(_to_key(actor))] = int(count)
+            fields[f] = _import_state(var.map_aux[f], inner, _validated=True)
+        return state._replace(
+            clock=jnp.asarray(clock),
+            dots=jnp.asarray(dots),
+            fields=tuple(fields),
+        )
     raise ValueError(f"bridge: unsupported type {tn!r}")
 
 
 def _export_value(store: Store, var_id) -> Any:
-    v = store.value(var_id)
+    return _portable_value(store.value(var_id))
+
+
+def _portable_value(v) -> Any:
+    """Decoded value -> wire shape, recursively: sets sort into lists;
+    map values become sorted proplists ``[{K, V}, ...]`` (the
+    ``riak_dt_map:value`` shape — shape-faithful for any key term)."""
     if isinstance(v, (frozenset, set)):
-        members = [_from_key(t) for t in v]
-        return sorted(members, key=etf.encode)
+        return sorted((_portable_value(t) for t in v), key=etf.encode)
+    if isinstance(v, dict):
+        # proplist [{K, V}], the reference's riak_dt_map:value shape —
+        # shape-faithful for ANY key term (an ETF map would need hashable
+        # python keys)
+        return sorted(
+            ((_from_key(k), _portable_value(val)) for k, val in v.items()),
+            key=etf.encode,
+        )
     return _from_key(v)
 
 
@@ -448,11 +610,7 @@ class _Conn:
         if verb == "declare":
             _, raw_id, type_atom, caps = req
             var_id = _to_key(raw_id)
-            kwargs = {
-                str(k): int(v)
-                for k, v in (caps or {}).items()
-                if str(k) in _CAP_KEYS
-            }
+            kwargs = _parse_caps(caps)
             if var_id not in store.ids():
                 store.declare(id=var_id, type=str(type_atom), **kwargs)
             return (etf.OK, raw_id)  # echo the id exactly as sent
@@ -460,11 +618,7 @@ class _Conn:
             _, var_id, payload = req
             var_id = _to_key(var_id)
             type_atom, portable, caps = payload
-            kwargs = {
-                str(k): int(v)
-                for k, v in (caps or {}).items()
-                if str(k) in _CAP_KEYS
-            }
+            kwargs = _parse_caps(caps)
             if var_id not in store.ids():
                 store.declare(id=var_id, type=str(type_atom), **kwargs)
             var = store.variable(var_id)
@@ -484,14 +638,7 @@ class _Conn:
             var_id = _to_key(var_id)
             if not isinstance(op, tuple):
                 op = (op,)
-            verb_s = str(op[0])
-            if verb_s in ("add_all", "remove_all"):
-                # the list here is op SYNTAX (a collection of terms), not
-                # itself a term — convert its items, not the container
-                args = ([_to_key(x) for x in op[1]],)
-            else:
-                args = tuple(_to_key(x) for x in op[1:])
-            store.update(var_id, (verb_s,) + args, _to_key(actor))
+            store.update(var_id, _convert_op(op), _to_key(actor))
             return (etf.OK, _export_value(store, var_id))
         if verb == "bind":
             _, var_id, portable = req

@@ -432,3 +432,114 @@ def test_rejected_state_consumes_no_interner_capacity():
             ok, _ = c.update(b"o", (Atom("add"), b"real"), b"w")
             assert ok == Atom("ok")
             assert c.read(b"o") == (Atom("ok"), [b"real"])
+
+
+def test_map_bridge_declare_update_read_roundtrip():
+    """riak_dt_map over the wire: fields schema in caps, {update, Key,
+    InnerOp} ops, proplist value (riak_dt_map:value shape), get/put
+    round-trip, remove field."""
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("v")
+            fields = [
+                (b"tags", Atom("lasp_gset"), {Atom("n_elems"): 4}),
+                (b"hits", Atom("riak_dt_gcounter"), {}),
+            ]
+            resp = c.call((Atom("declare"), b"m", Atom("riak_dt_map"),
+                           {Atom("fields"): fields, Atom("n_actors"): 4}))
+            assert resp == (Atom("ok"), b"m")
+            ok, val = c.update(b"m", (Atom("update"), b"tags",
+                                      (Atom("add"), b"t1")), b"w0")
+            assert ok == Atom("ok")
+            ok, val = c.update(b"m", (Atom("update"), b"hits",
+                                      (Atom("increment"), 3)), b"w1")
+            assert ok == Atom("ok")
+            assert val == [(b"hits", 3), (b"tags", [b"t1"])]
+            # get/put round-trip into a twin
+            ok, (type_atom, portable) = c.get(b"m")
+            assert type_atom == Atom("riak_dt_map")
+            resp = c.call((Atom("put"), b"m2",
+                           (Atom("riak_dt_map"), portable,
+                            {Atom("fields"): fields, Atom("n_actors"): 4})))
+            assert resp == Atom("ok")
+            assert c.read(b"m2") == (Atom("ok"),
+                                     [(b"hits", 3), (b"tags", [b"t1"])])
+            # remove a field: presence dropped, counter keeps counting
+            ok, val = c.update(b"m", (Atom("remove"), b"tags"), b"w0")
+            assert val == [(b"hits", 3)]
+            # unknown field in a put is rejected, and consumes nothing
+            bad = ([(b"w9", 1)], [(b"nope", [(b"w9", 1)], [])])
+            resp = c.call((Atom("put"), b"m3",
+                           (Atom("riak_dt_map"), bad,
+                            {Atom("fields"): fields, Atom("n_actors"): 4})))
+            assert resp[0] == Atom("error")
+
+
+def test_map_bridge_durable(tmp_path):
+    import time
+
+    d = str(tmp_path / "stores")
+    fields = [(b"tags", Atom("lasp_gset"), {Atom("n_elems"): 4}),
+              (b"hits", Atom("riak_dt_gcounter"), {})]
+    with BridgeServer(data_dir=d) as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("p")
+            c.call((Atom("declare"), b"m", Atom("riak_dt_map"),
+                    {Atom("fields"): fields, Atom("n_actors"): 4}))
+            c.update(b"m", (Atom("update"), b"tags", (Atom("add"), b"t")), b"w")
+            c.update(b"m", (Atom("update"), b"hits", (Atom("increment"),)), b"w")
+        with BridgeClient("127.0.0.1", server.port) as c2:
+            for _ in range(100):
+                if c2.start("p")[0] == Atom("ok"):
+                    break
+                time.sleep(0.02)
+            assert c2.read(b"m") == (Atom("ok"),
+                                     [(b"hits", 1), (b"tags", [b"t"])])
+
+
+def test_map_bridge_batched_op_and_bare_atom_inner():
+    """The reference's batched map op {update, [SubOps]} and bare-atom
+    inner ops ({update, Key, increment}) work over the wire."""
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("v")
+            fields = [(b"tags", Atom("lasp_gset"), {Atom("n_elems"): 4}),
+                      (b"hits", Atom("riak_dt_gcounter"), {})]
+            c.call((Atom("declare"), b"m", Atom("riak_dt_map"),
+                    {Atom("fields"): fields, Atom("n_actors"): 4}))
+            ok, val = c.update(
+                b"m",
+                (Atom("update"), [
+                    (Atom("update"), b"tags", (Atom("add"), b"t1")),
+                    (Atom("update"), b"hits", Atom("increment")),
+                ]),
+                b"w0",
+            )
+            assert ok == Atom("ok"), val
+            assert val == [(b"hits", 1), (b"tags", [b"t1"])]
+            ok, val = c.update(b"m", (Atom("update"), b"hits",
+                                      Atom("increment")), b"w1")
+            assert ok == Atom("ok") and (b"hits", 2) in val
+
+
+def test_oversized_state_rejected_before_any_interning():
+    """A structurally-valid state naming more actors/elems than the
+    declared universes is refused up front — nothing interned."""
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("v")
+            c.declare(b"s", "riak_dt_orswot", n_elems=4, n_actors=2)
+            big = ([(f"a{i}".encode(), 1) for i in range(5)], [])
+            resp = c.bind(b"s", big)
+            assert resp[0] == Atom("error")
+            assert b"rejected before interning" in resp[2]
+            # both declared actor slots still usable
+            for i in range(2):
+                ok, _ = c.update(b"s", (Atom("add"), b"x"), f"w{i}".encode())
+                assert ok == Atom("ok")
+            # gset elem overflow too
+            c.declare(b"g", "lasp_gset", n_elems=2)
+            resp = c.bind(b"g", [b"e1", b"e2", b"e3"])
+            assert resp[0] == Atom("error")
+            ok, _ = c.update(b"g", (Atom("add"), b"fine"), b"w")
+            assert ok == Atom("ok")
