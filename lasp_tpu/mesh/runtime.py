@@ -917,7 +917,7 @@ class ReplicatedRuntime:
                 "population copy of HBM)"
             )
 
-    def _run_step_fn(self, fn, edge_mask, tables):
+    def _run_step_fn(self, fn, edge_mask, tables, *extra):
         """Dispatch a (possibly donating) compiled step and SYNC on its
         scalar result inside the guarded region — jax dispatch is
         asynchronous, so a device-side failure (OOM mid-block) surfaces at
@@ -928,7 +928,7 @@ class ReplicatedRuntime:
         states_in = self.states  # property read: raises if already poisoned
         try:
             new_states, scalar = fn(
-                states_in, self.neighbors, edge_mask, tables
+                states_in, self.neighbors, edge_mask, tables, *extra
             )
             return new_states, int(scalar)  # device sync: errors land here
         except Exception as exc:
@@ -940,16 +940,23 @@ class ReplicatedRuntime:
                 self._poisoned = f"{type(exc).__name__}: {str(exc)[:200]}"
             raise
 
-    def step(self, edge_mask=None) -> int:
-        """One bulk-synchronous round: local dataflow sweep + gossip.
-        Returns the number of (replica, variable) states the step CHANGED
-        (0 on the final, quiescent round)."""
+    def _ensure_step(self) -> tuple:
+        """Shared prologue of every stepping entry point: poison check,
+        graph sync, (re)build of the compiled step (invalidating the
+        derived-executable cache), and the traced edge tables."""
         self._check_poisoned()
         if self._n_edges != len(self.graph.edges):
             self._sync_graph()
         if self._step is None:
             self._step = self._build_step()
-        tables = tuple(e.device_tables() for e in self.graph.edges)
+            self._fused_steps_cache.clear()
+        return tuple(e.device_tables() for e in self.graph.edges)
+
+    def step(self, edge_mask=None) -> int:
+        """One bulk-synchronous round: local dataflow sweep + gossip.
+        Returns the number of (replica, variable) states the step CHANGED
+        (0 on the final, quiescent round)."""
+        tables = self._ensure_step()
         with Timer() as t:
             # _run_step_fn syncs on the residual, closing the timing window
             self.states, residual = self._run_step_fn(
@@ -972,12 +979,7 @@ class ReplicatedRuntime:
         step function (join idempotence + the triggers' inflation gate),
         rounds after the first zero are no-ops — running the remainder of
         the block is harmless."""
-        self._check_poisoned()
-        if self._n_edges != len(self.graph.edges):
-            self._sync_graph()
-        if self._step is None:
-            self._step = self._build_step()
-            self._fused_steps_cache.clear()
+        tables = self._ensure_step()
         fn = self._fused_steps_cache.get(block)
         if fn is None:
             step = self._step_pure
@@ -997,7 +999,6 @@ class ReplicatedRuntime:
 
             fn = jax.jit(fused, donate_argnums=self._donate_argnums())
             self._fused_steps_cache[block] = fn
-        tables = tuple(e.device_tables() for e in self.graph.edges)
         with Timer() as t:
             # _run_step_fn syncs on first_zero, closing the timing window
             self.states, first_zero = self._run_step_fn(
@@ -1027,6 +1028,67 @@ class ReplicatedRuntime:
             if self.step(edge_mask) == 0:
                 return i + 1
         raise RuntimeError(f"no convergence within {max_rounds} rounds")
+
+    def converge_on_device(
+        self, max_rounds: int = 10_000, edge_mask=None, strict: bool = True
+    ) -> int:
+        """Run to the fixed point in ONE device dispatch: a
+        ``lax.while_loop`` over the full step (sweep + triggers + gossip +
+        residual) that exits when a round changes nothing or the budget is
+        spent. Zero per-round/per-block host syncs — the end state of the
+        dispatch-amortization ladder (step -> fused_steps -> this); at
+        population scale the driver loop IS the scheduler, all on-chip.
+
+        Returns the exact rounds-to-convergence under the same counting
+        convention as :meth:`run_to_convergence` (the final quiescent
+        round is included). Raises if the budget ran out (with
+        ``strict=False``, returns ``-rounds_executed`` instead — the warm
+        path for callers that compile with a 1-round budget). The round
+        budget rides as a TRACED scalar, so one compile serves every
+        ``max_rounds``. The trade vs :meth:`fused_steps`: nothing (not
+        even a residual) is observable until the whole run finishes, so
+        use fused blocks when a caller wants progress (e.g.
+        ``read_until``'s threshold checks) and this when it only wants
+        the fixed point."""
+        if max_rounds < 1:
+            raise ValueError("max_rounds must be >= 1")
+        tables = self._ensure_step()
+        fn = self._fused_steps_cache.get("while")
+        if fn is None:
+            step = self._step_pure
+
+            def converge(states, neighbors, mask, tables, mr):
+                def cond(carry):
+                    _s, rounds, residual = carry
+                    return (residual != 0) & (rounds < mr)
+
+                def body(carry):
+                    s, rounds, _residual = carry
+                    out, residual = step(s, neighbors, mask, tables)
+                    return out, rounds + 1, residual
+
+                # seed residual=1 so the first round always runs; the
+                # count includes the final quiescent round, exactly like
+                # run_to_convergence's per-round and block paths
+                out, rounds, residual = jax.lax.while_loop(
+                    cond, body, (states, jnp.int32(0), jnp.int32(1))
+                )
+                return out, jnp.where(residual == 0, rounds, -rounds)
+
+            fn = jax.jit(converge, donate_argnums=self._donate_argnums())
+            self._fused_steps_cache["while"] = fn
+        with Timer() as t:
+            self.states, signed_rounds = self._run_step_fn(
+                fn, edge_mask, tables, jnp.int32(max_rounds)
+            )
+        # 0 = reached the fixed point; -1 = budget ran out unconverged
+        # (the same convention fused_steps' trace rows use)
+        self.trace.record_round(0 if signed_rounds > 0 else -1, t.elapsed)
+        if signed_rounds < 0 and strict:
+            raise RuntimeError(
+                f"no convergence within {-signed_rounds} rounds"
+            )
+        return signed_rounds
 
     # -- vectorized population seeding ---------------------------------------
     def intern_terms(self, var_id: str, terms) -> np.ndarray:

@@ -337,3 +337,39 @@ def test_engine_fixed_point_schedule_independent():
         for t, blk in zip(topos, (1, 4, 8))
     }
     assert results == {frozenset({"x", "y", "z"})}
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_converge_on_device_matches_host_loop(packed):
+    """The single-dispatch while_loop driver reaches the same fixed point
+    in the same number of rounds as the host-looped paths."""
+    rt1 = _adcounter_runtime(packed=packed)
+    rt2 = _adcounter_runtime(packed=packed)
+    r_host = rt1.run_to_convergence(block=4)
+    r_dev = rt2.converge_on_device()
+    assert r_host == r_dev
+    for v in rt1.var_ids:
+        assert rt1.coverage_value(v) == rt2.coverage_value(v)
+        assert rt2.divergence(v) == 0
+    # an already-converged population bills exactly the one probe round
+    assert rt2.converge_on_device() == 1
+
+
+def test_converge_on_device_budget_and_mask():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="s", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 64, ring(64, 1))
+    rt.update_batch("s", [(0, ("add", "e"), "w")])
+    # diameter of ring(64,1) is 32; a 4-round budget must fail loudly
+    with pytest.raises(RuntimeError, match="no convergence within 4"):
+        rt.converge_on_device(max_rounds=4)
+    # all edges dead: quiesces immediately under the mask
+    dead = jnp.zeros((64, 1), dtype=bool)
+    assert rt.converge_on_device(edge_mask=dead) == 1
+    assert rt.converge_on_device() >= 1
+    assert rt.coverage_value("s") == {"e"}
+    assert rt.divergence("s") == 0
