@@ -32,6 +32,45 @@ from .gossip import divergence, gossip_round, join_all
 _PACKABLE = ("lasp_orset", "lasp_orset_gbtree")
 
 
+class _CapacityWalk:
+    """Free-slot accounting for ONE interner across a batch walk: counts
+    the new terms an op needs WITHOUT interning, so a failing op can be
+    refused before anything mutates — the shared precheck of every batch
+    path (ops are then applied knowing their prefix fits)."""
+
+    def __init__(self, interner):
+        from ..utils.interning import CapacityError
+
+        self._err_cls = CapacityError
+        self.interner = interner
+        self.free = (
+            interner.capacity - len(interner) if interner is not None else None
+        )
+        self.seen: set = set()
+
+    def take(self, terms):
+        """Reserve slots for the new terms among ``terms``. Returns the
+        ``CapacityError`` to raise (nothing reserved) or None."""
+        if self.interner is None:
+            return None
+        need = [
+            t
+            for t in dict.fromkeys(terms)
+            if t not in self.interner and t not in self.seen
+        ]
+        if self.free is not None and len(need) > self.free:
+            return self._err_cls(
+                f"{self.interner.kind} universe full "
+                f"({self.interner.capacity}); cannot intern "
+                f"{need[self.free]!r} — declare the variable with a "
+                "larger capacity"
+            )
+        if self.free is not None:
+            self.free -= len(need)
+        self.seen.update(need)
+        return None
+
+
 class ReplicatedRuntime:
     """Simulates ``n_replicas`` copies of a store + dataflow graph under a
     gossip topology, bulk-synchronously.
@@ -245,143 +284,132 @@ class ReplicatedRuntime:
         the first overflowing op would raise (or None). Walked BEFORE any
         interning so a mid-batch overflow leaves exactly the per-op-loop
         state: earlier ops applied, the overflowing op untouched."""
-        from ..utils.interning import CapacityError
-
-        free_e = (
-            var.elems.capacity - len(var.elems) if var.elems is not None else None
-        )
-        free_a = (
-            var.actors.capacity - len(var.actors)
-            if var.actors is not None
-            else None
-        )
-        seen_e: set = set()
-        seen_a: set = set()
+        walk_e = _CapacityWalk(var.elems)
+        walk_a = _CapacityWalk(var.actors)
         for k, (_r, op, actor) in enumerate(ops):
             verb = op[0]
-            need_e: list = []
-            need_a: list = []
+            err = None
             # lasp_ivar needs no prefix walk: its payload interner is
             # effectively unbounded (store.py hardcodes 2**31-1 and
             # declare() exposes no ivar capacity kwarg)
             if tn == "riak_dt_gcounter":
-                if actor not in var.actors and actor not in seen_a:
-                    need_a = [actor]
+                err = walk_a.take([actor])
             elif verb in ("add", "add_all"):
                 terms = op[1] if verb == "add_all" else [op[1]]
-                need_e = [
-                    t
-                    for t in dict.fromkeys(terms)
-                    if t not in var.elems and t not in seen_e
-                ]
-                if (
-                    tn != "lasp_gset"
-                    and var.actors is not None
-                    and actor not in var.actors
-                    and actor not in seen_a
-                ):
-                    need_a = [actor]
-            if free_e is not None and need_e and len(need_e) > free_e:
-                return k, CapacityError(
-                    f"{var.elems.kind} universe full ({var.elems.capacity}); "
-                    f"cannot intern {need_e[free_e]!r} — declare the variable "
-                    f"with a larger capacity"
-                )
-            if free_a is not None and need_a and len(need_a) > free_a:
-                return k, CapacityError(
-                    f"{var.actors.kind} universe full ({var.actors.capacity});"
-                    f" cannot intern {need_a[free_a]!r} — declare the variable"
-                    f" with a larger capacity"
-                )
-            if free_e is not None:
-                free_e -= len(need_e)
-            if free_a is not None:
-                free_a -= len(need_a)
-            seen_e.update(need_e)
-            seen_a.update(need_a)
+                err = walk_e.take(terms)
+                if err is None and tn != "lasp_gset":
+                    err = walk_a.take([actor])
+            if err is not None:
+                return k, err
         return len(ops), None
+
+    @staticmethod
+    def _gcounter_batch_pure(var, states, ops):
+        rows, lanes, by = [], [], []
+        for r, op, actor in ops:
+            if op[0] != "increment":
+                raise ValueError(f"update_batch: unsupported op {op!r}")
+            amount = op[1] if len(op) > 1 else 1
+            if amount < 1:
+                # reference riak_dt_gcounter rejects non-positive
+                # increments; per-op update_at would drop it at the
+                # inflation gate — batch must not silently deflate
+                raise ValueError(
+                    f"update_batch: G-Counter increment must be >= 1, "
+                    f"got {amount!r}"
+                )
+            rows.append(r)
+            lanes.append(var.actors.intern(actor))
+            by.append(amount)
+        counts = states.counts.at[
+            np.asarray(rows, dtype=np.int32), np.asarray(lanes, dtype=np.int32)
+        ].add(np.asarray(by, dtype=states.counts.dtype))
+        return states._replace(counts=counts)
+
+    @staticmethod
+    def _gset_batch_pure(var, states, ops):
+        rows, elems = [], []
+        for r, op, _actor in ops:
+            if op[0] == "add":
+                rows.append(r)
+                elems.append(var.elems.intern(op[1]))
+            elif op[0] == "add_all":
+                for e in op[1]:
+                    rows.append(r)
+                    elems.append(var.elems.intern(e))
+            else:
+                raise ValueError(f"update_batch: unsupported op {op!r}")
+        if not rows:
+            return states
+        mask = states.mask.at[
+            np.asarray(rows, dtype=np.int32),
+            np.asarray(elems, dtype=np.int32),
+        ].set(True)
+        return states._replace(mask=mask)
+
+    @staticmethod
+    def _ivar_batch_pure(var, states, ops):
+        rows, payloads = [], []
+        for r, op, _actor in ops:
+            if op[0] != "set":
+                raise ValueError(f"update_batch: unsupported op {op!r}")
+            rows.append(r)
+            payloads.append(var.ivar_payloads.intern(op[1]))
+        rows = np.asarray(rows, dtype=np.int32)
+        payloads = np.asarray(payloads, dtype=states.value.dtype)
+        # sequential semantics: per row, the FIRST set wins (a later
+        # different payload is a non-inflation the bind rule ignores),
+        # and an already-defined row keeps its value (single
+        # assignment, src/lasp_ivar.erl:50-56)
+        _, first = np.unique(rows, return_index=True)
+        rows, payloads = rows[first], payloads[first]
+        # gather the touched rows' flags DEVICE-side: pulling the full
+        # [R] defined plane would be O(population) host traffic per
+        # batch (the cliff the ORSWOT batch path removed)
+        open_rows = ~np.asarray(states.defined[rows])
+        rows, payloads = rows[open_rows], payloads[open_rows]
+        return states._replace(
+            defined=states.defined.at[rows].set(True),
+            value=states.value.at[rows].set(payloads),
+        )
+
+    #: field types the vectorized map batch can embed (pure kernels)
+    _MAP_FIELD_BATCH = {
+        "riak_dt_gcounter": "_gcounter_batch_pure",
+        "lasp_gset": "_gset_batch_pure",
+        "lasp_ivar": "_ivar_batch_pure",
+    }
 
     def _dispatch_batch(self, var, tn, states, ops) -> None:
         var_id = var.id
         if tn == "riak_dt_gcounter":
-            rows, lanes, by = [], [], []
-            for r, op, actor in ops:
-                if op[0] != "increment":
-                    raise ValueError(f"update_batch: unsupported op {op!r}")
-                amount = op[1] if len(op) > 1 else 1
-                if amount < 1:
-                    # reference riak_dt_gcounter rejects non-positive
-                    # increments; per-op update_at would drop it at the
-                    # inflation gate — batch must not silently deflate
-                    raise ValueError(
-                        f"update_batch: G-Counter increment must be >= 1, "
-                        f"got {amount!r}"
-                    )
-                rows.append(r)
-                lanes.append(var.actors.intern(actor))
-                by.append(amount)
-            counts = states.counts.at[
-                np.asarray(rows, dtype=np.int32), np.asarray(lanes, dtype=np.int32)
-            ].add(np.asarray(by, dtype=states.counts.dtype))
-            self.states[var_id] = states._replace(counts=counts)
+            self.states[var_id] = self._gcounter_batch_pure(var, states, ops)
         elif tn == "lasp_gset":
-            rows, elems = [], []
-            for r, op, _actor in ops:
-                if op[0] == "add":
-                    rows.append(r)
-                    elems.append(var.elems.intern(op[1]))
-                elif op[0] == "add_all":
-                    for e in op[1]:
-                        rows.append(r)
-                        elems.append(var.elems.intern(e))
-                else:
-                    raise ValueError(f"update_batch: unsupported op {op!r}")
-            if rows:
-                mask = states.mask.at[
-                    np.asarray(rows, dtype=np.int32),
-                    np.asarray(elems, dtype=np.int32),
-                ].set(True)
-                self.states[var_id] = states._replace(mask=mask)
+            self.states[var_id] = self._gset_batch_pure(var, states, ops)
         elif tn in ("lasp_orset", "lasp_orset_gbtree"):
             self._orset_batch(var, ops)
         elif tn == "riak_dt_orswot":
             self._orswot_batch(var, ops)
         elif tn == "lasp_ivar":
-            rows, payloads = [], []
-            for r, op, _actor in ops:
-                if op[0] != "set":
-                    raise ValueError(f"update_batch: unsupported op {op!r}")
-                rows.append(r)
-                payloads.append(var.ivar_payloads.intern(op[1]))
-            rows = np.asarray(rows, dtype=np.int32)
-            payloads = np.asarray(payloads, dtype=states.value.dtype)
-            # sequential semantics: per row, the FIRST set wins (a later
-            # different payload is a non-inflation the bind rule ignores),
-            # and an already-defined row keeps its value (single
-            # assignment, src/lasp_ivar.erl:50-56)
-            _, first = np.unique(rows, return_index=True)
-            rows, payloads = rows[first], payloads[first]
-            # gather the touched rows' flags DEVICE-side: pulling the full
-            # [R] defined plane would be O(population) host traffic per
-            # batch (the cliff the ORSWOT batch path removed)
-            open_rows = ~np.asarray(states.defined[rows])
-            rows, payloads = rows[open_rows], payloads[open_rows]
-            self.states[var_id] = states._replace(
-                defined=states.defined.at[rows].set(True),
-                value=states.value.at[rows].set(payloads),
-            )
+            self.states[var_id] = self._ivar_batch_pure(var, states, ops)
+        elif tn == "riak_dt_map" and all(
+            fcodec.name in self._MAP_FIELD_BATCH
+            for _k, fcodec, _s in var.spec.fields
+        ):
+            self.states[var_id] = self._map_batch(var, states, ops)
         else:
-            # vclock-composed types (riak_dt_map): no vectorized kernel —
-            # fall back to per-op update_at, preserving exact sequential
-            # semantics at O(batch) device dispatches. Loud enough to
-            # never hide a population-scale perf cliff.
+            # maps embedding field types without a pure batch kernel
+            # (orset/orswot/map-in-map fields): fall back to per-op
+            # update_at, preserving exact sequential semantics at O(batch)
+            # device dispatches. Loud enough to never hide a
+            # population-scale perf cliff.
             import warnings
 
             warnings.warn(
-                f"update_batch({tn!r}): no vectorized kernel; applying "
-                f"{len(ops)} ops via per-op update_at (one dispatch per "
-                "op — fine for control-plane writes, not for "
-                "population-scale seeding)",
+                f"update_batch({tn!r}): no vectorized kernel for this "
+                f"shape; applying {len(ops)} ops via per-op update_at "
+                "(one dispatch per op — fine for control-plane writes, "
+                "not for population-scale seeding)",
                 stacklevel=3,
             )
             for r, op, actor in ops:
@@ -554,6 +582,155 @@ class ReplicatedRuntime:
                 return i, PreconditionError(f"not_present: {term!r}")
             seen.add(key)
         return len(items), None
+
+    def _map_batch(self, var, states, ops):
+        """Vectorized riak_dt_map batch with SEQUENTIAL, PER-OP-ATOMIC
+        semantics: presence dots are host-simulated over the touched rows
+        only (O(batch) gathers, never the population), embedded field ops
+        dispatch through the per-type pure batch kernels, and everything
+        lands in O(1) device scatters per plane.
+
+        Op shapes (the reference's ``riak_dt_map`` update contract, see
+        ``store.py _apply_op``): ``("update", Key, InnerOp)``,
+        ``("remove", Key)``, and the batched ``("update", [SubOps])`` —
+        one client op's sub-ops apply atomically. A failing op (absent
+        remove -> PreconditionError; interner overflow -> CapacityError)
+        applies NOTHING of itself (an undo log rewinds its partial
+        presence writes) while every op before it persists — then the
+        error is raised, exactly the per-op ``update_at`` loop's
+        observable state. Malformed shapes (unknown verbs, non-positive
+        counter increments) raise up front, before anything applies."""
+        from ..store.store import PreconditionError
+
+        spec = var.spec
+
+        # pass 0 — flatten + validate SHAPES up front (nothing applied yet)
+        flat = []  # (op_index, replica, ("update", f, inner) | ("remove", f))
+        for k, (r, op, actor) in enumerate(ops):
+            subs = op[1] if op[0] == "update" and len(op) == 2 else [op]
+            for sub in subs:
+                if sub[0] == "update" and len(sub) == 3:
+                    f = spec.field_index(sub[1])  # KeyError: unknown field
+                    inner = sub[2] if isinstance(sub[2], tuple) else (sub[2],)
+                    _key, fcodec, _fspec = spec.fields[f]
+                    if fcodec.name == "riak_dt_gcounter":
+                        if inner[0] != "increment":
+                            raise ValueError(
+                                f"update_batch: unsupported op {inner!r}"
+                            )
+                        if len(inner) > 1 and inner[1] < 1:
+                            raise ValueError(
+                                "update_batch: G-Counter increment must "
+                                f"be >= 1, got {inner[1]!r}"
+                            )
+                    elif fcodec.name == "lasp_gset":
+                        if inner[0] not in ("add", "add_all"):
+                            raise ValueError(
+                                f"update_batch: unsupported op {inner!r}"
+                            )
+                        if inner[0] == "add_all":
+                            # materialize once: the capacity walk AND the
+                            # field kernel both iterate the payload — a
+                            # one-shot iterator would arrive at the kernel
+                            # already drained (silent element loss)
+                            inner = ("add_all", list(inner[1]))
+                    elif inner[0] != "set":
+                        raise ValueError(
+                            f"update_batch: unsupported op {inner!r}"
+                        )
+                    flat.append((k, r, ("update", f, inner), actor))
+                elif sub[0] == "remove" and len(sub) == 2:
+                    f = spec.field_index(sub[1])
+                    flat.append((k, r, ("remove", f), actor))
+                else:
+                    raise ValueError(
+                        f"update_batch: unsupported map op {sub!r}"
+                    )
+        if not flat:
+            return states
+
+        # one device-side gather of the touched rows' presence planes
+        touched = sorted({r for _k, r, _s, _a in flat})
+        tr = np.asarray(touched, dtype=np.int32)
+        row_of = {r: i for i, r in enumerate(touched)}
+        local_clock = np.array(states.clock[tr])  # [T, A]
+        local_dots = np.array(states.dots[tr])  # [T, F, A]
+
+        # pass 1 — sequential walk. Capacity is PRE-checked per op against
+        # free counters (interning is deferred / rewound), presence checks
+        # see the sim state at the op's own position.
+        err = None
+        inner_ops: dict[int, list] = {}  # field -> [(r, inner, actor)]
+        walk_a = _CapacityWalk(var.actors)
+        walk_e = {
+            f: _CapacityWalk(shim.elems) for f, shim in enumerate(var.map_aux)
+        }
+        import itertools
+
+        for _k, giter in itertools.groupby(flat, key=lambda x: x[0]):
+            group = list(giter)
+            undo: list = []
+            inner_mark = {f: len(v) for f, v in inner_ops.items()}
+            for _k, r, sub, actor in group:
+                t = row_of[r]
+                if sub[0] == "remove":
+                    f = sub[1]
+                    if not (local_dots[t, f] > 0).any():
+                        key = spec.fields[f][0]
+                        err = PreconditionError(f"not_present: {key!r}")
+                        break
+                    undo.append((t, f, local_dots[t, f].copy(), None, None))
+                    local_dots[t, f] = 0
+                    continue
+                _u, f, inner = sub
+                if inner[0] in ("add", "add_all"):
+                    terms = inner[1] if inner[0] == "add_all" else [inner[1]]
+                    err = walk_e[f].take(terms)
+                    if err is not None:
+                        break
+                err = walk_a.take([actor])
+                if err is not None:
+                    break
+                a = var.actors.intern(actor)
+                undo.append((t, f, local_dots[t, f].copy(),
+                             a, local_clock[t, a]))
+                local_clock[t, a] += 1
+                # mint REPLACES the field's dot row with the fresh single
+                # dot (lattice/dots.py mint_dot — the riak_dt touch move)
+                local_dots[t, f] = 0
+                local_dots[t, f, a] = local_clock[t, a]
+                inner_ops.setdefault(f, []).append((r, inner, actor))
+            if err is not None:
+                # rewind THIS op's partial presence + inner appends
+                for t, f, dots_old, a, clock_old in reversed(undo):
+                    local_dots[t, f] = dots_old
+                    if a is not None:
+                        local_clock[t, a] = clock_old
+                for f, mark in inner_mark.items():
+                    del inner_ops[f][mark:]
+                for f in list(inner_ops):
+                    if f not in inner_mark:
+                        del inner_ops[f]
+                break
+
+        # pass 2 — apply: presence planes in two scatters, then each
+        # touched field's embedded ops through its pure batch kernel
+        fields = list(states.fields)
+        for f, fops in inner_ops.items():
+            if not fops:
+                continue
+            _key, fcodec, _fspec = spec.fields[f]
+            kernel = getattr(self, self._MAP_FIELD_BATCH[fcodec.name])
+            fields[f] = kernel(var.map_aux[f], fields[f], fops)
+        new_states = states._replace(
+            clock=states.clock.at[tr].set(jnp.asarray(local_clock)),
+            dots=states.dots.at[tr].set(jnp.asarray(local_dots)),
+            fields=tuple(fields),
+        )
+        if err is not None:
+            self.states[var.id] = new_states  # earlier ops persist
+            raise err
+        return new_states
 
     def _orswot_batch(self, var, ops) -> None:
         """Batched OR-SWOT adds/removes with SEQUENTIAL, PER-OP-ATOMIC

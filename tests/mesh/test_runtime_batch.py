@@ -518,7 +518,9 @@ def test_ivar_batch_first_set_wins_and_respects_existing():
     assert rt.divergence(v) == 0
 
 
-def test_map_batch_falls_back_to_per_op_with_warning():
+def test_map_batch_vectorized_without_warning():
+    """Maps whose fields all have pure batch kernels take the vectorized
+    path — no per-op fallback warning — and converge correctly."""
     import warnings
 
     store = Store(n_actors=4)
@@ -536,7 +538,146 @@ def test_map_batch_falls_back_to_per_op_with_warning():
             (0, ("update", "tags", ("add", "t1")), "w0"),
             (2, ("update", "hits", ("increment", 3)), "w1"),
         ])
-    assert any("no vectorized kernel" in str(w.message) for w in caught)
+    assert not any("no vectorized kernel" in str(w.message) for w in caught)
     rt.run_to_convergence(block=4)
     assert rt.coverage_value(m) == {"tags": frozenset({"t1"}), "hits": 3}
     assert rt.divergence(m) == 0
+
+
+def _map_rt(n=8, n_actors=4, gset_elems=4):
+    store = Store(n_actors=n_actors)
+    graph = Graph(store)
+    m = store.declare(
+        id="m", type="riak_dt_map",
+        fields=[("tags", "lasp_gset", {"n_elems": gset_elems}),
+                ("hits", "riak_dt_gcounter", {}),
+                ("owner", "lasp_ivar", {})],
+        n_actors=n_actors,
+    )
+    rt = ReplicatedRuntime(store, graph, n, ring(n, 2))
+    return rt, m
+
+
+def test_map_batch_matches_per_op_random():
+    """The vectorized map batch is indistinguishable from the per-op
+    update_at loop: same presence dots, same clock, same field states,
+    over random op sequences (the EQC-style oracle at batch altitude)."""
+    import random
+
+    import numpy as np
+
+    for seed in range(6):
+        rng = random.Random(seed)
+        ops = []
+        for _ in range(40):
+            r = rng.randrange(8)
+            actor = f"w{rng.randrange(3)}"
+            kind = rng.random()
+            if kind < 0.4:
+                ops.append((r, ("update", "tags",
+                                ("add", f"t{rng.randrange(4)}")), actor))
+            elif kind < 0.6:
+                ops.append((r, ("update", "hits",
+                                ("increment", rng.randrange(1, 4))), actor))
+            elif kind < 0.7:
+                ops.append((r, ("update", "owner",
+                                ("set", f"o{rng.randrange(2)}")), actor))
+            elif kind < 0.85:
+                # batched sub-op shape: atomic pair
+                ops.append((r, ("update", [
+                    ("update", "tags", ("add", f"t{rng.randrange(4)}")),
+                    ("update", "hits", ("increment",)),
+                ]), actor))
+            else:
+                ops.append((r, ("remove", "tags"), actor))
+
+        rt1, m1 = _map_rt()
+        rt2, m2 = _map_rt()
+        ok1 = ok2 = 0
+        try:
+            rt1.update_batch(m1, list(ops))
+            ok1 = len(ops)
+        except Exception as e1:
+            err1 = type(e1).__name__
+        for r, op, actor in ops:
+            try:
+                rt2.update_at(r, m2, op, actor)
+                ok2 += 1
+            except Exception as e2:
+                err2 = type(e2).__name__
+                break
+        if ok1 != len(ops):
+            # both must fail at the same op with the same error class
+            assert ok2 != len(ops) and err1 == err2, (seed, err1)
+        s1, s2 = rt1.states[m1], rt2.states[m2]
+        assert np.array_equal(s1.clock, s2.clock), seed
+        assert np.array_equal(s1.dots, s2.dots), seed
+        for f1, f2 in zip(s1.fields, s2.fields):
+            for l1, l2 in zip(f1, f2):
+                assert np.array_equal(l1, l2), seed
+        rt1.run_to_convergence(block=4)
+        rt2.run_to_convergence(block=4)
+        assert rt1.coverage_value(m1) == rt2.coverage_value(m2), seed
+
+
+def test_map_batch_per_op_atomicity_on_failure():
+    """A failing op mid-batch applies NOTHING of itself (not even earlier
+    sub-ops of its own atomic group); earlier ops persist; the error
+    surfaces."""
+    import numpy as np
+    import pytest as _pytest
+
+    from lasp_tpu.store.store import PreconditionError
+
+    rt, m = _map_rt()
+    with _pytest.raises(PreconditionError, match="not_present"):
+        rt.update_batch(m, [
+            (0, ("update", "tags", ("add", "t1")), "w0"),
+            # atomic group: the add lands in sim, then the remove of an
+            # absent field fails -> the whole group must rewind
+            (1, ("update", [
+                ("update", "hits", ("increment", 2)),
+                ("remove", "owner"),
+            ]), "w1"),
+            (2, ("update", "tags", ("add", "t2")), "w2"),  # never reached
+        ])
+    assert rt.replica_value(m, 0)["tags"] == frozenset({"t1"})
+    assert "hits" not in rt.replica_value(m, 1)  # group rewound: absent
+    assert "tags" not in rt.replica_value(m, 2)  # op after the failure
+    # clock untouched by the rewound group: w1 minted nothing
+    assert int(np.asarray(rt.states[m].clock).sum()) == 1
+
+
+def test_map_batch_capacity_prefix():
+    from lasp_tpu.utils.interning import CapacityError
+
+    import pytest as _pytest
+
+    rt, m = _map_rt(gset_elems=2)
+    with _pytest.raises(CapacityError):
+        rt.update_batch(m, [
+            (0, ("update", "tags", ("add", "a")), "w"),
+            (0, ("update", "tags", ("add", "b")), "w"),
+            (0, ("update", "tags", ("add", "c")), "w"),  # overflows
+            (0, ("update", "tags", ("add", "d")), "w"),
+        ])
+    assert rt.replica_value(m, 0)["tags"] == frozenset({"a", "b"})
+
+
+def test_map_batch_fallback_warning_only_for_unbatchable_fields():
+    import warnings
+
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    m = store.declare(
+        id="m", type="riak_dt_map",
+        fields=[("s", "lasp_orset", {"n_elems": 4, "n_actors": 4,
+                                     "tokens_per_actor": 2})],
+        n_actors=4,
+    )
+    rt = ReplicatedRuntime(store, graph, 8, ring(8, 2))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        rt.update_batch(m, [(0, ("update", "s", ("add", "x")), "w")])
+    assert any("no vectorized kernel" in str(w.message) for w in caught)
+    assert rt.replica_value(m, 0)["s"] == frozenset({"x"})
