@@ -183,7 +183,8 @@ def main(argv=None) -> int:
         default="lasp_orset",
         # only the set family supports the simulate verb's ("add", item)
         # write shape; other types would crash mid-simulation
-        choices=["lasp_gset", "lasp_orset", "lasp_orset_gbtree"],
+        choices=["lasp_gset", "lasp_orset", "lasp_orset_gbtree",
+                 "riak_dt_orswot"],
     )
     sim.add_argument("--elems", type=int, default=64)
     sim.add_argument("--writers", type=int, default=8)

@@ -611,7 +611,13 @@ class ReplicatedRuntime:
             for sub in subs:
                 if sub[0] == "update" and len(sub) == 3:
                     f = spec.field_index(sub[1])  # KeyError: unknown field
-                    inner = sub[2] if isinstance(sub[2], tuple) else (sub[2],)
+                    inner = sub[2]
+                    if not isinstance(inner, tuple):
+                        # the per-op path (store._apply_op) requires tuple
+                        # ops; the batch must not accept a wider language
+                        raise ValueError(
+                            f"update_batch: unsupported op {inner!r}"
+                        )
                     _key, fcodec, _fspec = spec.fields[f]
                     if fcodec.name == "riak_dt_gcounter":
                         if inner[0] != "increment":
