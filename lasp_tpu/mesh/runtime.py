@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..lattice.base import replicate
+from ..lattice.base import Threshold, replicate
 from ..ops.flatpack import FlatORSet, FlatORSetSpec
 from ..utils.metrics import StepTrace, Timer
 from .gossip import divergence, gossip_round, join_all
@@ -1371,7 +1371,8 @@ class ReplicatedRuntime:
         return None
 
     def read_until(self, replica: int, var_id: str, threshold=None,
-                   max_rounds: int = 10_000, edge_mask=None, block: int = 1):
+                   max_rounds: int = 10_000, edge_mask=None, block: int = 1,
+                   on_device: bool = False):
         """Blocking monotonic threshold read (``lasp:read/2`` semantics,
         ``src/lasp_core.erl:329-364``): steps the mesh until the threshold
         is met at the given replica, then returns that replica's state.
@@ -1382,7 +1383,21 @@ class ReplicatedRuntime:
         overshooting rounds never unmeets one). Once the population
         quiesces with the threshold still unmet, it can never be met (no
         client ops land inside this loop), so the wait fails fast instead
-        of burning the remaining round budget."""
+        of burning the remaining round budget.
+
+        ``on_device=True`` parks the WHOLE wait on the chip: a
+        ``lax.while_loop`` whose condition re-evaluates the threshold
+        predicate at the replica's row every round and also exits on
+        quiescence or the budget — one dispatch, zero host syncs, and the
+        loop stops on exactly the round that meets the threshold (the
+        "wakes exactly when met" contract of the parked reader,
+        ``src/lasp_core.erl:352-364``, as device control flow). Replica
+        index, budget, and the threshold state ride as traced operands,
+        so one compiled executable serves every wait on the variable."""
+        if on_device:
+            return self._read_until_on_device(
+                replica, var_id, threshold, max_rounds, edge_mask
+            )
         rounds, quiescent = 0, False
         while rounds < max_rounds:
             row = self.read_at(replica, var_id, threshold)
@@ -1391,7 +1406,9 @@ class ReplicatedRuntime:
             if block > 1 and max_rounds - rounds >= block:
                 at = self.fused_steps(block, edge_mask)
                 quiescent = at >= 0
-                rounds += at if quiescent else block
+                # count the quiescent round itself (at is its 0-based
+                # index), matching run_to_convergence and on_device
+                rounds += at + 1 if quiescent else block
             else:
                 # per-round tail: a remainder-sized fused kernel would be
                 # a fresh XLA compile for a one-off block
@@ -1406,6 +1423,67 @@ class ReplicatedRuntime:
             f"threshold not met at replica {replica} within {rounds} rounds"
             + (" (population quiescent: the threshold is unreachable)"
                if quiescent else "")
+        )
+
+    def _read_until_on_device(self, replica, var_id, threshold, max_rounds,
+                              edge_mask):
+        if max_rounds < 1:
+            raise ValueError("max_rounds must be >= 1")
+        var = self.store.variable(var_id)
+        thr = self.store._resolve_threshold(var, threshold)
+        tables = self._ensure_step()
+        key = ("read_until", var_id, bool(thr.strict))
+        fn = self._fused_steps_cache.get(key)
+        if fn is None:
+            step = self._step_pure
+            codec, spec = var.codec, var.spec
+            strict = bool(thr.strict)
+            to_dense = self._to_dense_row
+
+            def wait(states, neighbors, mask, tables, r, mr, thr_state):
+                def met(s):
+                    row = jax.tree_util.tree_map(lambda x: x[r], s[var_id])
+                    row = to_dense(var_id, row)
+                    return codec.threshold_met(
+                        spec, row, Threshold(thr_state, strict)
+                    )
+
+                def cond(carry):
+                    s, rounds, residual = carry
+                    return ~met(s) & (residual != 0) & (rounds < mr)
+
+                def body(carry):
+                    s, rounds, _residual = carry
+                    out, residual = step(s, neighbors, mask, tables)
+                    return out, rounds + 1, residual
+
+                out, rounds, residual = jax.lax.while_loop(
+                    cond, body, (states, jnp.int32(0), jnp.int32(1))
+                )
+                # exit reason rides in the low bits: 0 met, 1 budget
+                # exhausted, 2 quiescent-unmet (threshold unreachable)
+                code = jnp.where(
+                    met(out), 0, jnp.where(residual == 0, 2, 1)
+                )
+                return out, rounds * 4 + code
+
+            fn = jax.jit(wait, donate_argnums=self._donate_argnums())
+            self._fused_steps_cache[key] = fn
+        with Timer() as t:
+            self.states, packed = self._run_step_fn(
+                fn, edge_mask, tables, jnp.int32(replica),
+                jnp.int32(max_rounds), thr.state,
+            )
+        rounds, code = packed // 4, packed % 4
+        self.trace.record_round(0 if code == 0 else -1, t.elapsed)
+        if code == 0:
+            row = self.read_at(replica, var_id, threshold)
+            assert row is not None  # met on-device must be met on-host
+            return row
+        raise TimeoutError(
+            f"threshold not met at replica {replica} within {rounds} rounds"
+            + (" (population quiescent: the threshold is unreachable)"
+               if code == 2 else "")
         )
 
     # -- compaction ------------------------------------------------------------
