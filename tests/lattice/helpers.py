@@ -37,19 +37,24 @@ def decode_ivar(state):
     return int(np.asarray(state.value)) if bool(np.asarray(state.defined)) else None
 
 
+def decode_dot_matrix(clock, dots, keys):
+    """Shared (clock, dot-matrix) decode: nonzero-filtered clock dict plus
+    ``key -> {actor: counter}`` entries (ORSWOT elements and Map field
+    presence use the identical convention)."""
+    clock = np.asarray(clock)
+    dots = np.asarray(dots)
+    cdict = {a: int(c) for a, c in enumerate(clock) if c != 0}
+    entries = {}
+    for i, key in enumerate(keys):
+        row = {a: int(c) for a, c in enumerate(dots[i]) if c != 0}
+        if row:
+            entries[key] = row
+    return cdict, entries
+
+
 def decode_orswot(spec, state, elems):
     """Dense (clock, dots) -> (clock dict, entries dict elem -> actor -> ctr)."""
-    clock = np.asarray(state.clock)
-    dots = np.asarray(state.dots)
-    cdict = {a: int(clock[a]) for a in range(spec.n_actors) if clock[a] != 0}
-    entries = {}
-    for e in range(spec.n_elems):
-        row = {
-            a: int(dots[e, a]) for a in range(spec.n_actors) if dots[e, a] != 0
-        }
-        if row:
-            entries[elems[e]] = row
-    return (cdict, entries)
+    return decode_dot_matrix(state.clock, state.dots, elems[: spec.n_elems])
 
 
 def decode_orset(spec: ORSetSpec, state, elems):
@@ -81,3 +86,19 @@ def encode_orset(spec: ORSetSpec, model, elems):
                     removed=state.removed.at[e, actor * k + kk].set(True)
                 )
     return state
+
+
+def decode_map(spec, state, elems):
+    """Dense MapState -> (clock dict, fdots dict fname -> actor -> ctr,
+    fields dict fname -> decoded inner state) — the PyMap model shape.
+    Assumes the statem schema: field 0 a GSet over ``elems``, field 1 a
+    GCounter."""
+    cdict, fdots = decode_dot_matrix(
+        state.clock, state.dots, [f[0] for f in spec.fields]
+    )
+    (sname, _sc, sspec), (cname, _cc, cspec) = spec.fields
+    fields = {
+        sname: decode_gset(sspec, state.fields[0], elems),
+        cname: decode_gcounter(cspec, state.fields[1]),
+    }
+    return (cdict, fdots, fields)

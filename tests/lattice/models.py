@@ -146,26 +146,7 @@ class PyORSWOT:
     def merge(a, b):
         ca, ea = a
         cb, eb = b
-        clock = dict(ca)
-        for actor, c in cb.items():
-            clock[actor] = max(clock.get(actor, 0), c)
-        entries = {}
-        for elem in set(ea) | set(eb):
-            da = ea.get(elem, {})
-            db = eb.get(elem, {})
-            keep = {}
-            for actor in set(da) | set(db):
-                va, vb = da.get(actor, 0), db.get(actor, 0)
-                kept = 0
-                if va and (va == vb or va > cb.get(actor, 0)):
-                    kept = max(kept, va)
-                if vb and (vb == va or vb > ca.get(actor, 0)):
-                    kept = max(kept, vb)
-                if kept:
-                    keep[actor] = kept
-            if keep:
-                entries[elem] = keep
-        return (clock, entries)
+        return merge_dot_entries(ca, ea, cb, eb)
 
     @staticmethod
     def value(state):
@@ -252,3 +233,76 @@ class PyORSet:
         )
         new_elems = len(prev) < len(cur)
         return deleted or new_elems
+
+
+def merge_dot_entries(ca, ea, cb, eb):
+    """The shared dot-survival rule (riak_dt vclock merge): keep a dot iff
+    both sides hold it, or one side holds it and the other's clock has not
+    yet seen it. Entries are ``key -> {actor: counter}``; used for ORSWOT
+    elements and Map field presence alike (lattice/dots.py twin)."""
+    clock = dict(ca)
+    for actor, c in cb.items():
+        clock[actor] = max(clock.get(actor, 0), c)
+    entries = {}
+    for key in set(ea) | set(eb):
+        da = ea.get(key, {})
+        db = eb.get(key, {})
+        keep = {}
+        for actor in set(da) | set(db):
+            va, vb = da.get(actor, 0), db.get(actor, 0)
+            kept = 0
+            if va and (va == vb or va > cb.get(actor, 0)):
+                kept = max(kept, va)
+            if vb and (vb == va or vb > ca.get(actor, 0)):
+                kept = max(kept, vb)
+            if kept:
+                keep[actor] = kept
+        if keep:
+            entries[key] = keep
+    return clock, entries
+
+
+class PyMap:
+    """Oracle for the DENSE riak_dt_map semantics (lattice/map.py): static
+    field schema, OR-SWOT presence dots over field names, and — the
+    documented divergence from the reference — field CONTENTS that stay
+    join-monotone across remove/re-add (presence only controls
+    visibility). State = (clock, fdots: fname -> {actor: ctr},
+    fields: fname -> inner model state)."""
+
+    SCHEMA = ()  # (fname, inner_model) pairs; set by the harness
+
+    @classmethod
+    def new(cls):
+        return ({}, {}, {f: m.new() for f, m in cls.SCHEMA})
+
+    @classmethod
+    def update(cls, state, fname, actor, inner_fn):
+        clock, fdots, fields = state
+        clock = dict(clock)
+        clock[actor] = clock.get(actor, 0) + 1
+        fdots = {f: dict(d) for f, d in fdots.items()}
+        fdots[fname] = {actor: clock[actor]}  # mint REPLACES the dot row
+        fields = dict(fields)
+        fields[fname] = inner_fn(fields[fname])
+        return (clock, fdots, fields)
+
+    @classmethod
+    def remove(cls, state, fname):
+        clock, fdots, fields = state
+        if fname not in fdots:
+            raise KeyError(f"precondition: not_present {fname!r}")
+        fdots = {f: dict(d) for f, d in fdots.items() if f != fname}
+        return (clock, fdots, fields)
+
+    @classmethod
+    def merge(cls, a, b):
+        ca, fa, ia = a
+        cb, fb, ib = b
+        clock, fdots = merge_dot_entries(ca, fa, cb, fb)
+        fields = {f: m.merge(ia[f], ib[f]) for f, m in cls.SCHEMA}
+        return (clock, fdots, fields)
+
+    @classmethod
+    def value(cls, state):
+        return frozenset(state[1])

@@ -244,3 +244,104 @@ def test_orset_encode_decode_roundtrip(seed):
     for d, m in zip(gdense, gmodel):
         re_encoded = encode_gset(gspec, decode_gset(gspec, d, ELEMS), ELEMS)
         assert bool(GSet.equal(gspec, d, re_encoded))
+
+
+def run_map(seed):
+    """Statem for the dense riak_dt_map: random field updates (gset add /
+    counter increment), observed-field removes, and cross-replica merges,
+    against the PyMap oracle (the EQC statem hook riak_dt types provide,
+    test/crdt_statem_eqc.erl:50-106, for the composed type)."""
+    from lasp_tpu.lattice import CrdtMap, MapSpec
+
+    from .models import PyGCounter, PyGSet, PyMap
+
+    rng = random.Random(seed)
+    gspec = GSetSpec(n_elems=len(ELEMS))
+    cspec = GCounterSpec(n_actors=N_REPLICAS)
+    spec = MapSpec(
+        fields=(("s", GSet, gspec), ("c", GCounter, cspec)),
+        n_actors=N_REPLICAS,
+    )
+    PyMap.SCHEMA = (("s", PyGSet), ("c", PyGCounter))
+    dense = [CrdtMap.new(spec) for _ in range(N_REPLICAS)]
+    model = [PyMap.new() for _ in range(N_REPLICAS)]
+
+    def dense_update(st, f, r, inner_fn):
+        st = CrdtMap.touch(spec, st, f, r)
+        return CrdtMap.set_field(spec, st, f, inner_fn(st.fields[f]))
+
+    for _ in range(N_OPS):
+        r = rng.randrange(N_REPLICAS)
+        roll = rng.random()
+        if roll < 0.35:
+            e = rng.randrange(len(ELEMS))
+            dense[r] = dense_update(
+                dense[r], 0, r, lambda fs: GSet.add(gspec, fs, e)
+            )
+            model[r] = PyMap.update(
+                model[r], "s", r, lambda ms: PyGSet.add(ms, ELEMS[e])
+            )
+        elif roll < 0.55:
+            dense[r] = dense_update(
+                dense[r], 1, r, lambda fs: GCounter.increment(cspec, fs, r)
+            )
+            model[r] = PyMap.update(
+                model[r], "c", r, lambda ms: PyGCounter.increment(ms, r)
+            )
+        elif roll < 0.7 and model[r][1]:
+            fname = rng.choice(sorted(model[r][1]))
+            f = 0 if fname == "s" else 1
+            dense[r] = CrdtMap.remove(spec, dense[r], f)
+            model[r] = PyMap.remove(model[r], fname)
+        else:
+            r2 = rng.randrange(N_REPLICAS)
+            dense[r] = CrdtMap.merge(spec, dense[r], dense[r2])
+            model[r] = PyMap.merge(model[r], model[r2])
+    return spec, dense, model
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_map_statem_converge(seed):
+    """prop_converge for the composed type: fold-merge of all replicas
+    decodes to the fold-merged model, and the presence value matches."""
+    from lasp_tpu.lattice import CrdtMap
+
+    from .helpers import decode_map
+    from .models import PyMap
+
+    spec, dense, model = run_map(seed)
+    merged_d, merged_m = dense[0], model[0]
+    for d, m in zip(dense[1:], model[1:]):
+        merged_d = CrdtMap.merge(spec, merged_d, d)
+        merged_m = PyMap.merge(merged_m, m)
+    assert decode_map(spec, merged_d, ELEMS) == merged_m
+    present = {
+        spec.fields[i][0]
+        for i, v in enumerate(np.asarray(CrdtMap.value(spec, merged_d)))
+        if v
+    }
+    assert present == set(PyMap.value(merged_m))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_map_statem_merge_schedule_independence(seed):
+    from lasp_tpu.lattice import CrdtMap
+
+    from .helpers import decode_map
+
+    spec, dense, _model = run_map(seed)
+    results = set()
+    for perm in itertools.islice(itertools.permutations(range(N_REPLICAS)), 8):
+        acc = dense[perm[0]]
+        for i in perm[1:]:
+            acc = CrdtMap.merge(spec, acc, dense[i])
+        c, fd, fs = decode_map(spec, acc, ELEMS)
+        results.add((
+            tuple(sorted(c.items())),
+            tuple(sorted((f, tuple(sorted(d.items()))) for f, d in fd.items())),
+            tuple(sorted(
+                (f, v if isinstance(v, frozenset) else tuple(sorted(v.items())))
+                for f, v in fs.items()
+            )),
+        ))
+    assert len(results) == 1
