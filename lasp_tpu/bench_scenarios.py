@@ -4,11 +4,13 @@ single-store reference semantics (the "state identical to ETS-backend
 semantics" requirement of the north-star config).
 
 1. ``adcounter_6``      — 6-replica G-Counter ad counter (the
-   ``lasp_adcounter_test`` shape: 5 ads x 5 clients, threshold 5).
-2. ``gset_1k``          — 1K-replica G-Set union/intersection dataflow.
+   ``lasp_adcounter_test`` shape: 5 ads x 6 clients x 100 views),
+   through the real engine.
+2. ``gset_1k``          — 1K-replica G-Set union/intersection dataflow
+   through the real engine.
 3. ``orset_100k``       — 100K-replica OR-Set anti-entropy, random gossip.
-4. ``pipeline_1m``      — 1M-replica map->filter->fold (packed planes,
-   expressed as mask algebra at population scale).
+4. ``pipeline_1m``      — 1M-replica map->filter->fold through the real
+   engine (packed planes at population scale).
 5. ``adcounter_10m``    — 10M-replica OR-Set ad counter, scale-free
    gossip: ads disabled by removal once the impression target is hit;
    convergence must beat 60 s on one chip.
@@ -51,117 +53,102 @@ def _engine_convergence_driver(rt):
 
 
 def adcounter_6() -> dict:
-    """6 replicas of the G-Counter ad counter converging by gossip."""
-    import jax
-    import jax.numpy as jnp
-
-    from lasp_tpu.lattice import GCounter, GCounterSpec, replicate
-    from lasp_tpu.mesh import converged, gossip_round, join_all, ring
+    """6 replicas of the G-Counter ad counter THROUGH THE REAL ENGINE
+    (the ``lasp_adcounter_test`` shape: 5 ads x 6 clients x 100 views):
+    five counter variables in one replicated store, client views landing
+    as batched ops at the clients' home replicas, the whole convergence
+    in one device dispatch."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
 
     n, n_ads, views = 6, 5, 100
-    spec = GCounterSpec(n_actors=n)
-    # one counter tensor per ad, all replicated: [ads, replicas, actors]
-    states = jax.tree_util.tree_map(
-        lambda x: jnp.broadcast_to(x, (n_ads,) + x.shape),
-        replicate(GCounter.new(spec), n),
-    )
-    rng = np.random.RandomState(1)
-    counts = np.zeros((n_ads, n, n), dtype=np.int32)
-    for _ in range(views):
-        ad, client = rng.randint(n_ads), rng.randint(n)
-        counts[ad, client, client] += 1  # client writes at its own replica
-    states = states._replace(counts=jnp.asarray(counts))
-    nbrs = jnp.asarray(ring(n, 2))
-
-    def run():
-        s = states
-        rounds = 0
-        while not bool(
-            jnp.all(
-                jax.vmap(lambda st: converged(GCounter, spec, st))(s)
-            )
-        ):
-            s = jax.vmap(lambda st: gossip_round(GCounter, spec, st, nbrs))(s)
-            rounds += 1
-        return s, rounds
-
-    (s, rounds), secs = _timed(run)
-    totals = [
-        int(GCounter.value(spec, join_all(GCounter, spec,
-                                          jax.tree_util.tree_map(lambda x: x[a], s))))
+    store = Store(n_actors=n)
+    graph = Graph(store)
+    ads = [
+        store.declare(id=f"ad{a}", type="riak_dt_gcounter", n_actors=n)
         for a in range(n_ads)
     ]
+    rt = ReplicatedRuntime(store, graph, n, ring(n, 2))
+    rng = np.random.RandomState(1)
+    per_ad: dict[str, list] = {a: [] for a in ads}
+    for _ in range(views):
+        ad, client = int(rng.randint(n_ads)), int(rng.randint(n))
+        # client writes at its own replica under its own actor identity
+        per_ad[ads[ad]].append((client, ("increment",), f"client{client}"))
+    for var, ops in per_ad.items():
+        if ops:
+            rt.update_batch(var, ops)
+
+    warm_rounds, run = _engine_convergence_driver(rt)
+    (_, rounds), secs = _timed(run)
+    totals = [int(rt.coverage_value(a)) for a in ads]
     assert sum(totals) == views  # no view lost or duplicated
+    assert all(rt.divergence(a) == 0 for a in ads)
     return {
         "scenario": "adcounter_6",
-        "rounds": rounds,
+        "rounds": warm_rounds + rounds,
         "seconds": round(secs, 4),
         "totals": totals,
+        "engine": "Graph+ReplicatedRuntime",
         "check": "sum==views",
     }
 
 
 def gset_1k() -> dict:
-    """1K replicas; two G-Sets per replica; union and intersection swept
-    per replica then gossiped to the global fixed point."""
+    """1K replicas, two G-Set variables with union AND intersection edges
+    THROUGH THE REAL ENGINE: the dataflow graph's combinator sweep + a
+    gossip round per step, the whole convergence in one device dispatch,
+    checked against the global reference values."""
     import jax
-    import jax.numpy as jnp
 
-    from lasp_tpu.lattice import GSet, GSetSpec, replicate
-    from lasp_tpu.mesh import converged, gossip_round, join_all, random_regular
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.store import Store
 
     n, e = 1024, 64
-    spec = GSetSpec(n_elems=e)
+    store = Store(n_actors=4)
+    graph = Graph(store)
+    left = store.declare(id="left", type="lasp_gset", n_elems=e)
+    right = store.declare(id="right", type="lasp_gset", n_elems=e)
+    graph.union(left, right, dst="u")
+    graph.intersection(left, right, dst="i")
+    rt = ReplicatedRuntime(store, graph, n, random_regular(n, 3, seed=3))
+
+    # population seed: random sparse element masks per replica, interned
+    # once and landed directly on the replica axis (the bulk-seeding path
+    # pipeline_1m uses; per-element client ops would be 3k round trips)
     rng = np.random.RandomState(2)
-    left = jnp.asarray(rng.rand(n, e) < 0.05)
-    right = jnp.asarray(rng.rand(n, e) < 0.05)
-    nbrs = jnp.asarray(random_regular(n, 3, seed=3))
+    lmask = rng.rand(n, e) < 0.05
+    rmask = rng.rand(n, e) < 0.05
+    for var, mask in ((left, lmask), (right, rmask)):
+        # intern into EACH input's universe: the intersection edge's
+        # projection tables pair the two interners term-by-term
+        elems = rt.intern_terms(var, list(range(e)))
+        st = rt.states[var]
+        rt.states[var] = st._replace(
+            mask=st.mask.at[:, elems].set(jax.numpy.asarray(mask))
+        )
 
-    @jax.jit
-    def step(l, r, u, i):
-        # local combinator sweep (mask algebra) then gossip every variable
-        u = u | (l | r)
-        i = i | (l & r)
-
-        def gs(m):
-            st = replicate(GSet.new(spec), n)._replace(mask=m)
-            return gossip_round(GSet, spec, st, nbrs).mask
-
-        return gs(l), gs(r), gs(u), gs(i)
-
-    def run():
-        l, r = left, right
-        u = jnp.zeros_like(l)
-        i = jnp.zeros_like(l)
-        rounds = 0
-        while True:
-            nl, nr, nu, ni = step(l, r, u, i)
-            rounds += 1
-            if (
-                bool(jnp.all(nl == l))
-                and bool(jnp.all(nr == r))
-                and bool(jnp.all(nu == u))
-                and bool(jnp.all(ni == i))
-            ):
-                break
-            l, r, u, i = nl, nr, nu, ni
-        return (l, r, u, i), rounds
-
-    ((l, r, u, i), rounds), secs = _timed(run)
-    # reference: global union of per-replica seeds
-    gl = np.asarray(left).any(axis=0)
-    gr = np.asarray(right).any(axis=0)
-    assert (np.asarray(u[0]) == (gl | gr)).all()
-    # intersection converges to the GLOBAL intersection: the inputs gossip
-    # to their global unions, so the final sweep intersects converged sets
-    # (exactly the reference's semantics for intersecting replicated sets)
-    assert (np.asarray(i[0]) == (gl & gr)).all()
+    warm_rounds, run = _engine_convergence_driver(rt)
+    (_, rounds), secs = _timed(run)
+    # reference: global union / intersection of the per-replica seeds
+    gl = {int(i) for i in np.flatnonzero(lmask.any(axis=0))}
+    gr = {int(i) for i in np.flatnonzero(rmask.any(axis=0))}
+    u_val, i_val = rt.coverage_value("u"), rt.coverage_value("i")
+    assert u_val == (gl | gr)
+    # the inputs gossip to their global unions, so intersection converges
+    # to the GLOBAL intersection (the reference's semantics for
+    # intersecting replicated sets)
+    assert i_val == (gl & gr)
+    assert rt.divergence("u") == 0 and rt.divergence("i") == 0
     return {
         "scenario": "gset_1k",
-        "rounds": rounds,
+        "rounds": warm_rounds + rounds,
         "seconds": round(secs, 4),
-        "union_size": int(np.asarray(u[0]).sum()),
-        "intersection_size": int(np.asarray(i[0]).sum()),
+        "union_size": len(u_val),
+        "intersection_size": len(i_val),
+        "engine": "Graph+ReplicatedRuntime",
         "check": "matches-global-reference",
     }
 
