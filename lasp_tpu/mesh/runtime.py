@@ -26,7 +26,7 @@ import numpy as np
 from ..lattice.base import Threshold, replicate
 from ..ops.flatpack import FlatORSet, FlatORSetSpec
 from ..utils.metrics import StepTrace, Timer
-from .gossip import divergence, gossip_round, join_all
+from .gossip import divergence, gossip_round, join_all, quorum_read
 
 #: store types held flat-bit-packed on the mesh when ``packed=True``
 _PACKABLE = ("lasp_orset", "lasp_orset_gbtree")
@@ -1351,6 +1351,27 @@ class ReplicatedRuntime:
         var = self.store.variable(var_id)
         row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
         return self.store._decode_value(var, self._to_dense_row(var_id, row))
+
+    def quorum_value(self, var_id: str, replicas):
+        """R-of-N quorum read: join the given replica rows and decode —
+        the first-R-replies merge of the read FSM
+        (``src/lasp_read_fsm.erl:125-146``). Any subset's join is a valid
+        monotone lower bound of the coverage value (idempotent join =
+        read-repair), coinciding with it once those rows have gossiped."""
+        replicas = np.asarray(replicas, dtype=np.int32)
+        if replicas.size == 0:
+            raise ValueError("quorum_value needs at least one replica")
+        if replicas.min() < 0 or replicas.max() >= self.n_replicas:
+            # jax gathers CLAMP out-of-range indices — a stale index after
+            # a resize would silently read the wrong quorum
+            raise IndexError(
+                f"replica indices {replicas.tolist()} out of range for "
+                f"{self.n_replicas} replicas"
+            )
+        var = self.store.variable(var_id)
+        codec, spec = self._mesh_meta(var_id)
+        top = quorum_read(codec, spec, self.states[var_id], replicas)
+        return self.store._decode_value(var, self._to_dense_row(var_id, top))
 
     def divergence(self, var_id: str) -> int:
         codec, spec = self._mesh_meta(var_id)

@@ -225,3 +225,41 @@ def test_sharded_replicated_runtime():
     rt.shard(mesh)
     rt.run_to_convergence(max_rounds=64)
     assert rt.coverage_value(s2) == frozenset({107})
+
+
+def test_runtime_quorum_value_monotone_lower_bound():
+    """quorum_value over R rows is a monotone lower bound of the coverage
+    value (the first-R merge of lasp_read_fsm), coinciding after gossip."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    s = store.declare(id="s", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 9, ring(9, 1))
+    rt.update_batch(s, [(0, ("add", "a"), "w"), (4, ("add", "b"), "w")])
+    # before gossip: a quorum holding only replica 4's write sees {b}
+    assert rt.quorum_value(s, [3, 4, 5]) == frozenset({"b"})
+    assert rt.quorum_value(s, [0, 4, 8]) == frozenset({"a", "b"})
+    assert rt.coverage_value(s) == frozenset({"a", "b"})
+    rt.run_to_convergence(block=4)
+    # after anti-entropy every quorum agrees with coverage (read-repair)
+    assert rt.quorum_value(s, [1, 2]) == frozenset({"a", "b"})
+
+
+def test_runtime_quorum_value_rejects_out_of_range():
+    import pytest as _pytest
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    s = store.declare(id="s", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 6, ring(6, 1))
+    with _pytest.raises(IndexError, match="out of range"):
+        rt.quorum_value(s, [5, 6])
+    with _pytest.raises(ValueError, match="at least one"):
+        rt.quorum_value(s, [])

@@ -114,3 +114,24 @@ def test_checkpoint_restore_onto_different_population(tmp_path, packed):
 
     with pytest.raises(ValueError, match="neighbors"):
         load_runtime(path, n_replicas=5)
+
+
+def test_resize_then_device_driver_and_device_read():
+    """Shape-changing membership ops must invalidate the cached
+    while_loop executables (converge_on_device / on-device read_until)."""
+    from lasp_tpu.lattice import Threshold
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="c", type="riak_dt_gcounter")
+    rt = ReplicatedRuntime(store, graph, 8, ring(8, 2))
+    rt.update_batch("c", [(0, ("increment", 3), "w")])
+    assert rt.converge_on_device() >= 1
+    row = rt.read_until(5, "c", Threshold(3), on_device=True)
+    assert row is not None
+    rt.resize(12, ring(12, 2))  # grow: new rows at bottom
+    assert rt.converge_on_device() >= 1  # recompiled for the new shape
+    assert rt.read_until(11, "c", Threshold(3), on_device=True) is not None
+    rt.resize(6, ring(6, 2))  # graceful shrink
+    assert rt.converge_on_device() >= 1
+    assert int(rt.coverage_value("c")) == 3
