@@ -1419,30 +1419,70 @@ class ReplicatedRuntime:
             return self._read_until_on_device(
                 replica, var_id, threshold, max_rounds, edge_mask
             )
-        rounds, quiescent = 0, False
-        while rounds < max_rounds:
-            row = self.read_at(replica, var_id, threshold)
-            if row is not None:
-                return row
-            if block > 1 and max_rounds - rounds >= block:
-                at = self.fused_steps(block, edge_mask)
-                quiescent = at >= 0
-                # count the quiescent round itself (at is its 0-based
-                # index), matching run_to_convergence and on_device
-                rounds += at + 1 if quiescent else block
-            else:
-                # per-round tail: a remainder-sized fused kernel would be
-                # a fresh XLA compile for a one-off block
-                quiescent = self.step(edge_mask) == 0
-                rounds += 1
-            if quiescent:
-                break
-        row = self.read_at(replica, var_id, threshold)
+        row, rounds, quiescent = self._step_until(
+            lambda: self.read_at(replica, var_id, threshold),
+            max_rounds, edge_mask, block,
+        )
         if row is not None:
             return row
         raise TimeoutError(
             f"threshold not met at replica {replica} within {rounds} rounds"
             + (" (population quiescent: the threshold is unreachable)"
+               if quiescent else "")
+        )
+
+    def _step_until(self, probe, max_rounds, edge_mask, block):
+        """Shared stepping loop of the blocking read verbs: run rounds
+        (fused into blocks when ``block > 1``; the per-round tail avoids a
+        fresh XLA compile for a one-off remainder block) until ``probe()``
+        returns non-None, the population quiesces, or the budget is
+        spent. Returns ``(probe_result, rounds, quiescent)`` with the
+        quiescent round itself counted (the run_to_convergence
+        convention)."""
+        rounds, quiescent = 0, False
+        while rounds < max_rounds:
+            hit = probe()
+            if hit is not None:
+                return hit, rounds, quiescent
+            if block > 1 and max_rounds - rounds >= block:
+                at = self.fused_steps(block, edge_mask)
+                quiescent = at >= 0
+                rounds += at + 1 if quiescent else block
+            else:
+                quiescent = self.step(edge_mask) == 0
+                rounds += 1
+            if quiescent:
+                break
+        return probe(), rounds, quiescent
+
+    def read_any_until(self, replica: int, reads, max_rounds: int = 10_000,
+                       edge_mask=None, block: int = 1):
+        """First-match-wins blocking read over ``[(var_id, threshold),
+        ...]`` at one replica — ``lasp:read_any/1``
+        (``src/lasp_core.erl:369-420``) at the mesh surface: steps the
+        population until ANY listed threshold is met, returning
+        ``(var_id, row)`` for the first match (list order breaks
+        same-round ties, like the reference's first-reply wins). Fails
+        fast once the population quiesces with every threshold unmet."""
+        reads = list(reads)  # probed every round: a one-shot iterator
+        if not reads:        # would silently drain after round one
+            raise ValueError("read_any_until needs at least one read")
+
+        def probe():
+            for var_id, threshold in reads:
+                row = self.read_at(replica, var_id, threshold)
+                if row is not None:
+                    return var_id, row
+            return None
+
+        hit, rounds, quiescent = self._step_until(
+            probe, max_rounds, edge_mask, block
+        )
+        if hit is not None:
+            return hit
+        raise TimeoutError(
+            f"no threshold met at replica {replica} within {rounds} rounds"
+            + (" (population quiescent: none is reachable)"
                if quiescent else "")
         )
 

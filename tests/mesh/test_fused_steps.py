@@ -430,3 +430,31 @@ def test_read_until_on_device_packed_orset_threshold():
     row = rt.read_until(9, s, thr, on_device=True)
     assert row is not None
     assert rt.divergence(s) >= 0  # runtime still healthy post-wait
+
+
+def test_read_any_until_first_match_wins():
+    """lasp:read_any at the mesh surface: the first threshold met by
+    gossip delivers; quiescence with none met fails fast."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="a", type="riak_dt_gcounter")
+    store.declare(id="b", type="riak_dt_gcounter")
+    rt = ReplicatedRuntime(store, graph, 16, ring(16, 1))
+    # pull ring(16,1): replica r pulls from r+1, so a write at 9 reaches
+    # the reading replica 8 in one round; the write at 0 needs eight
+    rt.update_batch("a", [(0, ("increment", 5), "w")])
+    rt.update_batch("b", [(9, ("increment", 3), "w")])
+    var, row = rt.read_any_until(
+        8, [("a", Threshold(5)), ("b", Threshold(3))], block=4
+    )
+    assert var == "b" and int(row.counts.sum()) == 3
+    # both unreachable: labeled quiescent fast-fail
+    with pytest.raises(TimeoutError, match="none is reachable"):
+        rt.read_any_until(
+            8, [("a", Threshold(99)), ("b", Threshold(99))],
+            max_rounds=500, block=4,
+        )
