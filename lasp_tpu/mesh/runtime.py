@@ -1339,17 +1339,28 @@ class ReplicatedRuntime:
         )
 
     # -- reads ----------------------------------------------------------------
+    def _population(self, var_id: str):
+        """The variable's [R, ...] states, syncing in variables declared
+        after the runtime was built (the same late-declare rule the write
+        path applies)."""
+        if var_id not in self.states:
+            self._sync_graph()
+        return self.states[var_id]
+
     def coverage_value(self, var_id: str):
         """Global join + decode — the coverage query
         (``src/lasp_execute_coverage_fsm.erl:78-94``)."""
-        var = self.store.variable(var_id)
+        pop = self._population(var_id)  # BEFORE _mesh_meta: the sync may
+        var = self.store.variable(var_id)  # pack a late-declared variable
         codec, spec = self._mesh_meta(var_id)
-        top = join_all(codec, spec, self.states[var_id])
+        top = join_all(codec, spec, pop)
         return self.store._decode_value(var, self._to_dense_row(var_id, top))
 
     def replica_value(self, var_id: str, replica: int):
         var = self.store.variable(var_id)
-        row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
+        row = jax.tree_util.tree_map(
+            lambda x: x[replica], self._population(var_id)
+        )
         return self.store._decode_value(var, self._to_dense_row(var_id, row))
 
     def quorum_value(self, var_id: str, replicas):
@@ -1368,14 +1379,16 @@ class ReplicatedRuntime:
                 f"replica indices {replicas.tolist()} out of range for "
                 f"{self.n_replicas} replicas"
             )
+        pop = self._population(var_id)  # before _mesh_meta (packing sync)
         var = self.store.variable(var_id)
         codec, spec = self._mesh_meta(var_id)
-        top = quorum_read(codec, spec, self.states[var_id], replicas)
+        top = quorum_read(codec, spec, pop, replicas)
         return self.store._decode_value(var, self._to_dense_row(var_id, top))
 
     def divergence(self, var_id: str) -> int:
+        pop = self._population(var_id)  # before _mesh_meta (packing sync)
         codec, spec = self._mesh_meta(var_id)
-        return int(divergence(codec, spec, self.states[var_id]))
+        return int(divergence(codec, spec, pop))
 
     def read_at(self, replica: int, var_id: str, threshold=None):
         """Non-blocking threshold check against one replica's row — the
@@ -1385,7 +1398,7 @@ class ReplicatedRuntime:
         thr = self.store._resolve_threshold(var, threshold)
         row = self._to_dense_row(
             var_id,
-            jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id]),
+            jax.tree_util.tree_map(lambda x: x[replica], self._population(var_id)),
         )
         if bool(var.codec.threshold_met(var.spec, row, thr)):
             return row
@@ -1490,6 +1503,7 @@ class ReplicatedRuntime:
                               edge_mask):
         if max_rounds < 1:
             raise ValueError("max_rounds must be >= 1")
+        self._population(var_id)  # sync in a late-declared variable
         var = self.store.variable(var_id)
         thr = self.store._resolve_threshold(var, threshold)
         tables = self._ensure_step()

@@ -458,3 +458,28 @@ def test_read_any_until_first_match_wins():
             8, [("a", Threshold(99)), ("b", Threshold(99))],
             max_rounds=500, block=4,
         )
+
+
+def test_late_declared_variable_readable_on_all_paths():
+    """A variable declared AFTER the runtime was built is readable via
+    every surface — host reads, device-parked reads, coverage, quorum,
+    divergence — in both dense and packed modes."""
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.store import Store
+
+    for packed in (False, True):
+        store = Store(n_actors=2)
+        graph = Graph(store)
+        rt = ReplicatedRuntime(store, graph, 8, ring(8, 2), packed=packed)
+        store.declare(id="late", type="lasp_orset", n_elems=4, n_actors=2,
+                      tokens_per_actor=2)
+        rt.update_batch("late", [(0, ("add", "x"), "w")])
+        assert rt.divergence("late") >= 0
+        assert rt.coverage_value("late") == frozenset({"x"})
+        rt.run_to_convergence(block=4)
+        assert rt.quorum_value("late", [3, 4]) == frozenset({"x"})
+        assert rt.replica_value("late", 5) == frozenset({"x"})
+        store.declare(id="late_c", type="riak_dt_gcounter")
+        rt.update_batch("late_c", [(0, ("increment", 2), "w")])
+        row = rt.read_until(5, "late_c", Threshold(2), on_device=True)
+        assert row is not None and int(row.counts.sum()) == 2
