@@ -208,10 +208,10 @@ class ReplicatedRuntime:
         domination rule reads as observed-and-removed (silent element
         loss). Use one actor per writing replica, exactly as riak_dt
         requires of the reference."""
-        if var_id not in self.states:
-            self._sync_graph()
         var = self.store.variable(var_id)
-        wire_row = jax.tree_util.tree_map(lambda x: x[replica], self.states[var_id])
+        wire_row = jax.tree_util.tree_map(
+            lambda x: x[replica], self._population(var_id)
+        )
         row = self._to_dense_row(var_id, wire_row)
         candidate = self.store._apply_op(var, row, op, actor)
         merged = var.codec.merge(var.spec, row, candidate)
@@ -253,10 +253,8 @@ class ReplicatedRuntime:
             for r, op, actor in ops
         ]
         var = self.store.variable(var_id)
-        if var_id not in self.states:
-            self._sync_graph()
+        states = self._population(var_id)
         tn = var.type_name
-        states = self.states[var_id]
         if not ops:
             return
         # interner overflow must follow the same per-op prefix semantics as
@@ -1300,6 +1298,9 @@ class ReplicatedRuntime:
         silent state corruption. The dense ``.at[].set(True)`` path is
         already idempotent and skips the dedup (bulk calls stay
         sort-free)."""
+        # sync BEFORE the packed-spec lookup: a late-declared packable
+        # variable registers its wire spec during the sync
+        self._population(var_id)
         if var_id in self._packed_specs:
             d = self.store.variable(var_id).spec
             rows_np = np.asarray(rows, dtype=np.int64)
@@ -1331,7 +1332,7 @@ class ReplicatedRuntime:
         """Device-side bulk G-Counter increments at ``(rows[i], lanes[i])``
         — the population-scale client-view writes of the ad-counter configs
         (``riak_test/lasp_adcounter_test.erl:57-120`` client loop)."""
-        states = self.states[var_id]
+        states = self._population(var_id)
         by = jnp.broadcast_to(jnp.asarray(by, dtype=states.counts.dtype),
                               jnp.asarray(rows).shape)
         self.states[var_id] = states._replace(
@@ -1341,9 +1342,12 @@ class ReplicatedRuntime:
     # -- reads ----------------------------------------------------------------
     def _population(self, var_id: str):
         """The variable's [R, ...] states, syncing in variables declared
-        after the runtime was built (the same late-declare rule the write
-        path applies)."""
+        after the runtime was built — the single late-declare rule every
+        read AND write verb routes through. Unknown ids raise KeyError
+        without the (expensive, cache-invalidating) graph sync."""
         if var_id not in self.states:
+            if var_id not in self.store.ids():
+                raise KeyError(var_id)
             self._sync_graph()
         return self.states[var_id]
 

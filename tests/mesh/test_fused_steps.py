@@ -473,6 +473,11 @@ def test_late_declared_variable_readable_on_all_paths():
         rt = ReplicatedRuntime(store, graph, 8, ring(8, 2), packed=packed)
         store.declare(id="late", type="lasp_orset", n_elems=4, n_actors=2,
                       tokens_per_actor=2)
+        # READ FIRST, before any write registers the packed spec: the
+        # sync must run before codec resolution (a reverted ordering
+        # would pair the dense codec with packed wire words)
+        assert rt.coverage_value("late") == frozenset()
+        assert rt.divergence("late") == 0
         rt.update_batch("late", [(0, ("add", "x"), "w")])
         assert rt.divergence("late") >= 0
         assert rt.coverage_value("late") == frozenset({"x"})
@@ -483,3 +488,22 @@ def test_late_declared_variable_readable_on_all_paths():
         rt.update_batch("late_c", [(0, ("increment", 2), "w")])
         row = rt.read_until(5, "late_c", Threshold(2), on_device=True)
         assert row is not None and int(row.counts.sum()) == 2
+
+
+def test_unknown_variable_raises_without_cache_invalidation():
+    """Probing a nonexistent id must raise KeyError WITHOUT rebuilding the
+    graph or invalidating the compiled step (a monitoring loop probing an
+    optional var would otherwise force re-jits every round)."""
+    store = Store(n_actors=2)
+    graph = Graph(store)
+    store.declare(id="s", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, graph, 8, ring(8, 2))
+    rt.fused_steps(4)  # populate the executable cache
+    cached = dict(rt._fused_steps_cache)
+    step = rt._step
+    for probe in (rt.coverage_value, rt.divergence):
+        with pytest.raises(KeyError):
+            probe("nope")
+    with pytest.raises(KeyError):
+        rt.replica_value("nope", 0)
+    assert rt._step is step and dict(rt._fused_steps_cache) == cached
