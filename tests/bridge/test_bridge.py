@@ -543,3 +543,48 @@ def test_oversized_state_rejected_before_any_interning():
             assert resp[0] == Atom("error")
             ok, _ = c.update(b"g", (Atom("add"), b"fine"), b"w")
             assert ok == Atom("ok")
+
+
+def test_durable_bridge_concurrent_clients_stress(tmp_path):
+    """Several clients hammering DIFFERENT durable stores concurrently:
+    the name-lock registry and per-connection host logs must not cross
+    wires; a contended name serializes via {error, locked}."""
+    import threading
+
+    d = str(tmp_path / "stores")
+    errors: list = []
+
+    def worker(port, name, n_ops):
+        try:
+            with BridgeClient("127.0.0.1", port) as c:
+                assert c.start(name)[0] == Atom("ok")
+                c.declare(b"s", "lasp_gset", n_elems=64)
+                for i in range(n_ops):
+                    ok, _ = c.update(b"s", (Atom("add"), f"{name}-{i}".encode()),
+                                     b"w")
+                    assert ok == Atom("ok")
+        except Exception as e:  # surfaced after join
+            errors.append((name, repr(e)))
+
+    with BridgeServer(data_dir=d) as server:
+        threads = [
+            threading.Thread(target=worker, args=(server.port, f"p{k}", 50))
+            for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        # every store durably holds exactly its own writes
+        for k in range(4):
+            with BridgeClient("127.0.0.1", server.port) as c:
+                import time
+
+                for _ in range(100):
+                    if c.start(f"p{k}")[0] == Atom("ok"):
+                        break
+                    time.sleep(0.02)
+                ok, val = c.read(b"s")
+                assert ok == Atom("ok") and len(val) == 50
+                assert all(v.startswith(f"p{k}-".encode()) for v in val)
