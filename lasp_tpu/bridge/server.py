@@ -579,6 +579,12 @@ class _Conn:
                         and self._locks.get(name) is self
                     ):
                         self._locks.pop(name, None)
+                    # a failure after the HostStore opened (e.g. corrupt
+                    # manifest) must not leak the log file handle; _release
+                    # closes it (self._name is already None or the old
+                    # name here, so the just-claimed `name` needs the
+                    # explicit pop above either way)
+                    self._release()
                     self.store = None
                     self._manifest = None
                     return (etf.ERROR, Atom(type(e).__name__), str(e).encode())

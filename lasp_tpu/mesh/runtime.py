@@ -596,8 +596,13 @@ class ReplicatedRuntime:
         applies NOTHING of itself (an undo log rewinds its partial
         presence writes) while every op before it persists — then the
         error is raised, exactly the per-op ``update_at`` loop's
-        observable state. Malformed shapes (unknown verbs, non-positive
-        counter increments) raise up front, before anything applies."""
+        observable state — for DATA-dependent failures. Malformed shapes
+        (unknown verbs, unknown field names, non-positive counter
+        increments) are batch-level errors instead: they raise up front
+        with NOTHING applied, where the per-op loop would have applied
+        the ops preceding the malformed one. A schema violation is a
+        programming error, not a data race, so all-or-nothing is the
+        safer contract there."""
         from ..store.store import PreconditionError
 
         spec = var.spec
@@ -1557,7 +1562,16 @@ class ReplicatedRuntime:
         self.trace.record_round(0 if code == 0 else -1, t.elapsed)
         if code == 0:
             row = self.read_at(replica, var_id, threshold)
-            assert row is not None  # met on-device must be met on-host
+            if row is None:
+                # met on-device must be met on-host; a mismatch means the
+                # device predicate and the host codec disagree — surface it
+                # even under ``python -O`` (a bare assert would vanish and
+                # silently return None)
+                raise RuntimeError(
+                    f"read_until({var_id!r}): device wait reported the "
+                    "threshold met but the host re-check disagrees — "
+                    "device/host threshold predicate mismatch"
+                )
             return row
         raise TimeoutError(
             f"threshold not met at replica {replica} within {rounds} rounds"

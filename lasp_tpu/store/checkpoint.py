@@ -183,11 +183,32 @@ def load_store(path: str) -> Store:
             counters = loads_manifest(counters)
             store.metrics.update(counters.get("metrics", {}))
             store.mutations = counters.get("mutations", 0)
-        for var_id in header["var_ids"]:
-            raw_entry = hs.get(_varmeta_key(var_id))
-            if raw_entry is None:
-                raise IOError(f"checkpoint missing varmeta for {var_id!r}")
-            entry = loads_manifest(raw_entry)
+        if header.get("kind") == "runtime":
+            raise IOError(
+                f"{path} is a runtime checkpoint (replicated [R, ...] "
+                "states); use load_runtime, not load_store"
+            )
+        if "var_ids" in header:
+            entries = []
+            for var_id in header["var_ids"]:
+                raw_entry = hs.get(_varmeta_key(var_id))
+                if raw_entry is None:
+                    raise IOError(f"checkpoint missing varmeta for {var_id!r}")
+                entries.append((var_id, loads_manifest(raw_entry)))
+        elif "vars" in header:
+            # pre-round-3 layout: per-variable entries AND the counters
+            # inline in the manifest instead of varmeta/<id> + "counters"
+            # records (leaf keys are unchanged, so states load the same)
+            entries = list(header["vars"].items())
+            store.metrics.update(header.get("metrics", {}))
+            store.mutations = header.get("mutations", store.mutations)
+        else:
+            raise IOError(
+                f"unrecognized checkpoint manifest in {path}: has neither "
+                "'var_ids' (current) nor 'vars' (legacy inline) — not a "
+                "store snapshot?"
+            )
+        for var_id, entry in entries:
             store.declare(id=var_id, type=entry["type_name"], spec=entry["spec"])
             var = store.variable(var_id)
             _restore_interners(var, entry)

@@ -117,6 +117,67 @@ def test_store_checkpoint_roundtrip(tmp_path):
     assert loaded.value(o) == frozenset({"b", "c"})
 
 
+def test_store_checkpoint_legacy_inline_manifest(tmp_path):
+    # pre-round-3 save_store inlined per-variable entries in
+    # manifest["vars"] (no varmeta/<id> records); load_store must still
+    # read that layout (the leaf records never changed)
+    import pickle
+
+    from lasp_tpu.store.host_store import HostStore
+
+    store = Store(n_actors=4)
+    o = store.declare(type="lasp_orset", n_elems=8)
+    c = store.declare(type="riak_dt_gcounter")
+    store.update(o, ("add_all", ["a", "b"]), "w1")
+    store.update(c, ("increment", 3), "w2")
+    path = str(tmp_path / "legacy.log")
+    save_store(store, path)
+    with HostStore(path) as hs:
+        from lasp_tpu.store.checkpoint import _varmeta_key, loads_manifest
+
+        header = loads_manifest(hs.get("manifest"))
+        header["vars"] = {
+            vid: loads_manifest(hs.get(_varmeta_key(vid)))
+            for vid in header.pop("var_ids")
+        }
+        # genuine pre-round-3 files inline the counters in the manifest
+        # and have NO "counters" record
+        header["metrics"] = dict(store.metrics)
+        header["mutations"] = store.mutations
+        hs.delete("counters")
+        hs.put("manifest", pickle.dumps(header))
+    loaded = load_store(path)
+    assert loaded.value(o) == frozenset({"a", "b"})
+    assert loaded.value(c) == 3
+    assert loaded.mutations == store.mutations  # inline counters restored
+    assert loaded.metrics == store.metrics
+
+
+def test_load_store_refuses_runtime_checkpoint(tmp_path):
+    from lasp_tpu.store.checkpoint import save_runtime
+
+    store = Store(n_actors=4)
+    g = store.declare(type="riak_dt_gcounter")
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    rt.update_at(0, g, ("increment", 2), "w")
+    path = str(tmp_path / "rt.log")
+    save_runtime(rt, path)
+    with pytest.raises(IOError, match="runtime checkpoint"):
+        load_store(path)
+
+
+def test_store_checkpoint_unrecognized_manifest_is_clear_error(tmp_path):
+    import pickle
+
+    from lasp_tpu.store.host_store import HostStore
+
+    path = str(tmp_path / "bad.log")
+    with HostStore(path) as hs:
+        hs.put("manifest", pickle.dumps({"kind": "store", "n_actors": 2}))
+    with pytest.raises(IOError, match="neither 'var_ids'"):
+        load_store(path)
+
+
 def test_store_resume_with_dataflow_outputs(tmp_path):
     # the documented workflow: save a store whose combinator outputs hold
     # values, load it, re-register the same edges, keep going — covers every
