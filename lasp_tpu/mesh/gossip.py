@@ -47,6 +47,28 @@ def gossip_round(codec, spec, states, neighbors, edge_mask=None):
     return acc
 
 
+def gossip_round_shift(codec, spec, states, offsets, edge_mask=None):
+    """:func:`gossip_round` for shift-structured topologies (every neighbor
+    column a constant offset — ``topology.shift_offsets``): the per-column
+    gather ``x[(r + off) % R]`` becomes ``jnp.roll(x, -off)``. Semantically
+    identical on the equivalent neighbor table; the payoff is the lowering —
+    under a block-sharded replica axis XLA turns each roll into a local
+    slice + one boundary ``collective-permute`` with the adjacent device,
+    where the gather form all-gathers the full population per column (the
+    ``mesh_comm`` design of SURVEY.md §2.5, now on the ENGINE step's own
+    path, not just the side ``shard_gossip`` entry points)."""
+    vmerge = jax.vmap(lambda a, b: codec.merge(spec, a, b))
+    acc = states
+    for k, off in enumerate(offsets):
+        nbr = jax.tree_util.tree_map(
+            lambda x: jnp.roll(x, -off, axis=0), states
+        )
+        if edge_mask is not None:
+            nbr = _tree_where(edge_mask[:, k], nbr, states)
+        acc = vmerge(acc, nbr)
+    return acc
+
+
 def join_all(codec, spec, states):
     """Full join over the replica axis — the coverage-query merge
     (``src/lasp_execute_coverage_fsm.erl:57-71``) and the quorum-merge

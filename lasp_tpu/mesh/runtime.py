@@ -11,10 +11,16 @@ topology — subsuming read-repair anti-entropy (``src/lasp_update_fsm.erl:
 
 Sharding: ``shard(mesh)`` places every state on a ``jax.sharding.Mesh`` with
 the replica axis split over the ``"replicas"`` mesh axis (data parallelism
-over simulated replicas — strategy (i)/(ii) of the SURVEY census). Gossip
-gathers then ride the ICI; for ring topologies they lower to ``ppermute``.
-Element/token axes of very large variables can additionally be split over a
-``"state"`` mesh axis (the tensor-parallel analogue for this framework).
+over simulated replicas — strategy (i)/(ii) of the SURVEY census). For
+shift-structured topologies (``topology.shift_offsets``, e.g. ``ring``) the
+step's gossip uses ``jnp.roll``, which the SPMD partitioner lowers to
+boundary ``collective-permute`` exchanges (asserted on the compiled HLO by
+``tests/mesh/test_shard_gossip.py``); irregular topologies (random /
+scale-free) keep the dynamic gather, which lowers to an ``all-gather`` of
+the population per neighbor column — the honest cost of arbitrary-graph
+gossip on a dense replica axis. Element/token axes of very large variables
+can additionally be split over a ``"state"`` mesh axis (the tensor-parallel
+analogue for this framework).
 """
 
 from __future__ import annotations
@@ -26,7 +32,14 @@ import numpy as np
 from ..lattice.base import Threshold, replicate
 from ..ops.flatpack import FlatORSet, FlatORSetSpec
 from ..utils.metrics import StepTrace, Timer
-from .gossip import divergence, gossip_round, join_all, quorum_read
+from .gossip import (
+    divergence,
+    gossip_round,
+    gossip_round_shift,
+    join_all,
+    quorum_read,
+)
+from .topology import shift_offsets
 
 #: store types held flat-bit-packed on the mesh when ``packed=True``
 _PACKABLE = ("lasp_orset", "lasp_orset_gbtree")
@@ -97,6 +110,10 @@ class ReplicatedRuntime:
         self.graph = graph
         self.n_replicas = n_replicas
         self.neighbors = jnp.asarray(neighbors)
+        # shift-structured topologies (ring & friends) route gossip through
+        # jnp.roll inside the step: collective-permute under sharding
+        # instead of a full-population all-gather per neighbor column
+        self._shift_offsets = shift_offsets(neighbors, n_replicas)
         self.packed = packed
         #: donate step inputs on accelerators (one fewer store-population
         #: copy of HBM per step). Trade-off: if a donated dispatch FAILS
@@ -981,6 +998,7 @@ class ReplicatedRuntime:
         variables ride through the whole step in wire form."""
         graph = self.graph
         edges = bool(graph.edges)
+        offsets = self._shift_offsets
         meta = {v: self._mesh_meta(v) for v in self.var_ids}
         dense_meta = {
             v: (self.store.variable(v).codec, self.store.variable(v).spec)
@@ -1046,7 +1064,18 @@ class ReplicatedRuntime:
             residual = jnp.zeros((), dtype=jnp.int32)
             for v in self.var_ids:
                 codec, spec = meta[v]
-                new = gossip_round(codec, spec, states[v], neighbors, edge_mask)
+                if offsets is not None:
+                    # shift-structured topology: rolls lower to
+                    # collective-permute under a sharded replica axis
+                    # (the gather form all-gathers the population);
+                    # `neighbors` stays a traced arg but is unused here
+                    new = gossip_round_shift(
+                        codec, spec, states[v], offsets, edge_mask
+                    )
+                else:
+                    new = gossip_round(
+                        codec, spec, states[v], neighbors, edge_mask
+                    )
                 # residual measures the WHOLE step (pre-sweep -> post-gossip)
                 # as ANY state change, not strict inflation: vclock types
                 # (ORSWOT/Map) can change dots under equal clocks and equal
@@ -1693,6 +1722,7 @@ class ReplicatedRuntime:
                 )
         self.n_replicas = new_n
         self.neighbors = jnp.asarray(new_neighbors)
+        self._shift_offsets = shift_offsets(new_neighbors, new_n)
         self._step = None
         self._fused_steps_cache.clear()
 

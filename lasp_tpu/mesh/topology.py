@@ -35,6 +35,32 @@ def ring(n_replicas: int, k: int = 2) -> np.ndarray:
     return np.stack(cols, axis=1).astype(np.int32)
 
 
+def shift_offsets(neighbors, n_replicas: int):
+    """Detect shift structure: if every column ``k`` of the neighbor table
+    satisfies ``neighbors[r, k] == (r + off_k) % R`` for a constant
+    ``off_k``, return ``(off_0, ..., off_{K-1})``; else ``None``.
+
+    Shift-structured tables (``ring`` and friends) let the engine step
+    replace its per-column dynamic gather with ``jnp.roll`` — which XLA's
+    SPMD partitioner lowers to ``collective-permute`` (nearest-neighbor ICI
+    bandwidth) on a block-sharded replica axis, where the equivalent gather
+    lowers to an ``all-gather`` of the WHOLE population per column (measured
+    on the 8-device virtual mesh; see tests/mesh/test_shard_gossip.py)."""
+    nbrs = np.asarray(neighbors)
+    if nbrs.ndim != 2 or nbrs.shape[0] != n_replicas or n_replicas == 0:
+        return None
+    r = np.arange(n_replicas, dtype=np.int64)
+    offs = []
+    for k in range(nbrs.shape[1]):
+        d = (nbrs[:, k].astype(np.int64) - r) % n_replicas
+        if not (d == d[0]).all():
+            return None
+        off = int(d[0])
+        # canonicalize to the symmetric range so roll distances stay short
+        offs.append(off - n_replicas if off > n_replicas // 2 else off)
+    return tuple(offs)
+
+
 def random_regular(n_replicas: int, k: int = 3, seed: int = 0) -> np.ndarray:
     """``k`` independent random permutations: every replica pulls from k
     peers AND is pulled by exactly k peers per round. The BASELINE "random

@@ -126,3 +126,113 @@ def test_sharded_join_all_hlo_contains_all_gather():
     fn = jax.jit(lambda s: sharded_join_all(GSet, spec, s, mesh))
     hlo = fn.lower(sharded).compile().as_text()
     assert "all-gather" in hlo, "coverage join must lower to all-gather"
+
+
+# -- the REAL engine step under shard() (VERDICT r3 ask #4) -------------------
+
+def _sharded_step(topology, n=64):
+    """Build a ReplicatedRuntime on `topology`, shard it over the 8-device
+    mesh, and return (rt, compiled-HLO text of the jitted engine step)."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=8)
+    s = store.declare(id="s", type="lasp_orset", n_elems=16)
+    rt = ReplicatedRuntime(store, Graph(store), n, topology)
+    rt.update_at(0, s, ("add", "seed"), "a0")
+    rt.shard(Mesh(np.array(jax.devices()[:8]), ("replicas",)), axis="replicas")
+    tables = rt._ensure_step()
+    hlo = (
+        jax.jit(rt._step_pure)
+        .lower(rt.states, rt.neighbors, None, tables)
+        .compile()
+        .as_text()
+    )
+    return rt, hlo
+
+
+def test_engine_step_ring_lowers_to_collective_permute():
+    # the flagship sharded step itself — not a side entry point — must ride
+    # nearest-neighbor ICI bandwidth on ring topologies
+    _rt, hlo = _sharded_step(ring(64, 2))
+    assert "collective-permute" in hlo
+    assert "all-gather" not in hlo, (
+        "ring-topology engine gossip regressed to full-population all-gather"
+    )
+
+
+def test_engine_step_random_topology_lowers_to_all_gather():
+    # irregular topologies keep the dynamic gather: the partitioner must
+    # materialize the population (documented cost, runtime.py module doc)
+    from lasp_tpu.mesh.topology import random_regular
+
+    _rt, hlo = _sharded_step(random_regular(64, 3, seed=2))
+    assert "all-gather" in hlo
+
+
+def test_engine_step_shift_path_matches_gather_path():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime
+    from lasp_tpu.store import Store
+
+    def build(force_gather):
+        store = Store(n_actors=8)
+        s = store.declare(id="s", type="lasp_orset", n_elems=16)
+        rt = ReplicatedRuntime(store, Graph(store), 48, ring(48, 3),
+                               donate_steps=False)
+        if force_gather:
+            rt._shift_offsets = None  # pretend detection failed
+        for r in range(0, 48, 5):
+            rt.update_at(r, s, ("add", f"e{r}"), f"a{r % 8}")
+        return rt, s
+
+    rt_shift, s = build(False)
+    rt_gather, _ = build(True)
+    assert rt_shift._shift_offsets == (1, -1, 2)
+    # identical evolution round by round, including under an edge mask
+    rng = np.random.RandomState(9)
+    mask = jnp.asarray(rng.rand(48, 3) < 0.7)
+    for em in (None, mask):
+        rs = rt_shift.step(edge_mask=em)
+        rg = rt_gather.step(edge_mask=em)
+        assert rs == rg
+        for a, b in zip(
+            jax.tree_util.tree_leaves(rt_shift.states["s"]),
+            jax.tree_util.tree_leaves(rt_gather.states["s"]),
+        ):
+            assert jnp.array_equal(a, b)
+
+
+def test_shift_offsets_detection():
+    from lasp_tpu.mesh.topology import random_regular, shift_offsets
+
+    assert shift_offsets(ring(64, 2), 64) == (1, -1)
+    assert shift_offsets(ring(10, 4), 10) == (1, -1, 2, -2)
+    assert shift_offsets(random_regular(64, 3, seed=0), 64) is None
+    # a hand-built constant-shift table that isn't literally ring()'s
+    r = np.arange(12)
+    tbl = np.stack([(r + 5) % 12, (r + 11) % 12], axis=1)
+    assert shift_offsets(tbl, 12) == (5, -1)
+    assert shift_offsets(tbl, 11) is None  # wrong population size
+
+
+def test_resize_redetects_shift_structure():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime
+    from lasp_tpu.mesh.topology import random_regular
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=4)
+    g = store.declare(id="g", type="riak_dt_gcounter")
+    rt = ReplicatedRuntime(store, Graph(store), 16, ring(16, 2))
+    assert rt._shift_offsets == (1, -1)
+    rt.update_at(0, g, ("increment", 3), "w")
+    rt.resize(24, random_regular(24, 3, seed=1))
+    assert rt._shift_offsets is None
+    rt.run_to_convergence(max_rounds=32)
+    assert rt.coverage_value("g") == 3
+    rt.resize(20, ring(20, 2), graceful=True)
+    assert rt._shift_offsets == (1, -1)
+    rt.run_to_convergence(max_rounds=32)
+    assert rt.coverage_value("g") == 3

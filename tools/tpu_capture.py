@@ -60,7 +60,9 @@ def run(cmd, timeout, outfile=None, env=None):
             out, err = proc.communicate()
         rc = -1
     if outfile and out and out.strip():
-        with open(outfile, "w") as f:
+        # a timed-out/failed child's stdout must not masquerade as a
+        # finished artifact
+        with open(outfile if rc == 0 else outfile + ".partial", "w") as f:
             f.write(out)
     if err and err.strip():
         with open((outfile or os.path.join(OUT, "misc")) + ".stderr", "w") as f:
@@ -79,13 +81,27 @@ def probe() -> bool:
     return False
 
 
-def capture() -> None:
+def capture() -> bool:
+    """One capture pass. Success == bench.py produced a parseable artifact
+    that actually ran on the TPU (its internal CPU fallback exits 0 too —
+    that must not end the watch)."""
+    import json
+
     log("TPU healthy — starting captures")
-    rc, tail = run(
-        [sys.executable, "bench.py"], 2500,
-        outfile=os.path.join(OUT, "bench.json"),
-    )
+    bench_out = os.path.join(OUT, "bench.json")
+    rc, tail = run([sys.executable, "bench.py"], 2500, outfile=bench_out)
     log(f"bench.py rc={rc} tail={tail[-200:]!r}")
+    bench_on_tpu = False
+    if rc == 0:
+        try:
+            with open(bench_out) as f:
+                rec = json.loads(f.read().strip().splitlines()[-1])
+            bench_on_tpu = rec.get("detail", {}).get("device") not in (
+                None, "cpu",
+            )
+            log(f"bench device={rec.get('detail', {}).get('device')!r}")
+        except Exception as e:
+            log(f"bench.json unparseable: {e}")
     rc, tail = run(
         [sys.executable, "bench_pallas.py"], 1500,
         outfile=os.path.join(OUT, "pallas.jsonl"),
@@ -97,6 +113,7 @@ def capture() -> None:
         outfile=os.path.join(OUT, "scenario_1m.json"),
     )
     log(f"scenario packed_vs_dense rc={rc} tail={tail[-200:]!r}")
+    return bench_on_tpu
 
 
 def main() -> int:
@@ -107,9 +124,12 @@ def main() -> int:
         attempt += 1
         log(f"probe attempt {attempt}")
         if probe():
-            capture()
-            log("capture pass done")
-            return 0
+            if capture():
+                log("capture pass done (bench ran on TPU)")
+                return 0
+            # the tunnel re-wedged mid-capture (the known failure mode):
+            # keep watching — later attempts may land a full pass
+            log("capture pass incomplete; continuing to watch")
         time.sleep(PROBE_INTERVAL_S)
     log("deadline reached with no healthy TPU")
     return 1
