@@ -128,6 +128,8 @@ class ReplicatedRuntime:
         self.states: dict = {}
         self._packed_specs: dict[str, FlatORSetSpec] = {}
         self._triggers: list = []
+        self._programs: dict = {}
+        self._program_session = None
         self._step = None
         self._fused_steps_cache: dict[int, object] = {}
         self._n_edges = -1
@@ -202,6 +204,81 @@ class ReplicatedRuntime:
         )
         self._step = None
         self._fused_steps_cache.clear()
+
+    # -- mesh-level programs (L5 over L2, src/lasp_vnode.erl:276-366) --------
+    def _session(self):
+        if self._program_session is None:
+            from .programs import MeshSession
+
+            self._program_session = MeshSession(self)
+        return self._program_session
+
+    def register(self, name: str, program_cls, *args, **kwargs) -> str:
+        """Deploy a program over the replica population —
+        ``lasp:register/4 global`` (``src/lasp_register_global_fsm.erl:
+        103-130``). ``init`` declares the program's accumulator variable,
+        which the runtime replicates over every row (the TPU form of
+        register-on-every-partition). Idempotent, like the vnode's dets
+        check (``src/lasp_vnode.erl:283-291``)."""
+        if name in self._programs:
+            return name
+        program = program_cls(*args, **kwargs)
+        program.init(self._session())
+        self._programs[name] = program
+        return name
+
+    def process(self, object, reason, actor, replica: int = 0) -> None:
+        """Targeted object-event delivery — ``lasp:process/4`` via the
+        PROCESS_R=1 FSM (``src/lasp_process_fsm.erl:113-135``): every
+        registered program's ``process`` runs against the ONE replica row
+        named by ``replica``, whose local view it reads and writes; the
+        write spreads to the population by gossip.
+
+        Routing discipline (the reference gets it from preflist hashing):
+        deliver all events for one logical key to the SAME replica row —
+        remove-then-add programs (the 2i index) read their own earlier
+        writes from the local row."""
+        if not 0 <= replica < self.n_replicas:
+            raise IndexError(
+                f"replica {replica} out of range for {self.n_replicas}"
+            )
+        session = self._session()
+        prev = session.replica
+        session.replica = replica
+        try:
+            # snapshot: a program may register new programs (create_views);
+            # a view registered by this event first sees the NEXT event,
+            # like the reference's async spawn
+            for program in list(self._programs.values()):
+                program.process(session, object, reason, actor)
+        finally:
+            session.replica = prev
+
+    def execute(self, name: str, replicas=None):
+        """Program result over the population. ``replicas=None`` is the
+        ring-coverage execute: the program reads see the GLOBAL join of its
+        accumulator (``src/lasp_execute_coverage_fsm.erl:57-94`` merges
+        every partition's CRDT with ``Type:merge`` before ``Type:value`` +
+        ``Module:value``). A replica list is the preflist-quorum variant
+        (``src/lasp_execute_fsm.erl:135-148``): the join of just those rows
+        — a monotone lower bound that coincides with coverage once the rows
+        have gossiped."""
+        program = self._programs[name]
+        session = self._session()
+        # save/restore: a program's process callback may legitimately call
+        # execute (consulting another program's result); the row binding
+        # must survive for the rest of the delivery loop
+        prev_replica, prev_quorum = session.replica, session.quorum
+        session.replica, session.quorum = None, replicas
+        try:
+            return program.value(program.execute(session))
+        finally:
+            session.replica, session.quorum = prev_replica, prev_quorum
+
+    @property
+    def programs(self) -> dict:
+        """Registered programs by name (read-only view)."""
+        return dict(self._programs)
 
     # -- client operations ---------------------------------------------------
     def update_at(self, replica: int, var_id: str, op: tuple, actor) -> None:

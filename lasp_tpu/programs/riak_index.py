@@ -141,9 +141,12 @@ class RiakIndexProgram(Program):
         universe fills with dead entries over the view's lifetime (the
         ``waste_pct`` the reference reports but never reclaims,
         ``src/lasp_orset.erl:178-191``). Dropping an element row is safe
-        HERE because the view variable is program-private and
-        single-store: no remote replica state can reintroduce the dropped
-        tombstones. (The one observable difference: a byte-identical
+        because the view variable is program-private: under a single-store
+        session nothing else holds its state, and under mesh delivery
+        ``MeshSession``'s compact converges the population to divergence 0
+        first, so the uniform reindex covers every replica row that could
+        reintroduce the tombstones. (The one observable difference: a
+        byte-identical
         replay of a write whose entry was deleted AND compacted re-indexes
         the key; without compaction the tombstone suppresses it.) Live
         rows are kept verbatim, including their tombstoned tokens.
