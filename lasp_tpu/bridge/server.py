@@ -111,6 +111,18 @@ def _parse_caps(caps) -> dict:
         ks = str(k)
         if ks in _CAP_KEYS:
             kwargs[ks] = int(v)
+        elif ks == "reset_on_readd":
+            # ETF booleans arrive as the atoms true/false; anything else
+            # is rejected — a silently-coerced typo would flip the map's
+            # remove/re-add semantics with no error anywhere
+            if v is True or str(v) == "true":
+                kwargs[ks] = True
+            elif v is False or str(v) == "false":
+                kwargs[ks] = False
+            else:
+                raise ValueError(
+                    f"reset_on_readd must be true or false, got {v!r}"
+                )
         elif ks == "fields":
             kwargs["fields"] = [
                 (
@@ -240,7 +252,8 @@ def _export_state(var, state=None) -> Any:
         # divergence documented in lattice/map.py) a (key, presence-dots,
         # embedded-portable) triple. Embedded contents ride even for
         # absent fields: they are join-monotone across remove/re-add
-        # here, so a faithful round-trip must carry them.
+        # here, so a faithful round-trip must carry them. reset_on_readd
+        # maps append a third component of nonzero (key, epoch) pairs.
         clock = np.asarray(state.clock)
         dots = np.asarray(state.dots)
         actors = var.actors.terms()
@@ -255,7 +268,14 @@ def _export_state(var, state=None) -> Any:
             ]
             inner = _export_state(var.map_aux[f], state=state.fields[f])
             fields_part.append((_from_key(key), fdots, inner))
-        return (clock_part, fields_part)
+        if state.epochs is None:
+            return (clock_part, fields_part)
+        epochs = np.asarray(state.epochs)
+        epoch_part = [
+            (_from_key(var.spec.fields[f][0]), int(epochs[f]))
+            for f in np.flatnonzero(epochs)
+        ]
+        return (clock_part, fields_part, epoch_part)
     raise ValueError(f"bridge: unsupported type {tn!r}")
 
 
@@ -311,7 +331,8 @@ def _validate_portable(var, portable: Any) -> None:
             var.elems, [_to_key(e) for e, _d in entries], "elem"
         )
     elif tn == "riak_dt_map":
-        clock_part, fields_part = portable if portable else ([], [])
+        parts = _split_map_portable(var, portable)
+        clock_part, fields_part, epoch_part = parts
         pclock = {_to_key(a): int(c) for a, c in clock_part}
         for key, fdots, inner in fields_part:
             f = spec.field_index(_to_key(key))  # KeyError if unknown field
@@ -323,6 +344,10 @@ def _validate_portable(var, portable: Any) -> None:
                         f"state's own clock ({seen}) — not a valid map state"
                     )
             _validate_portable(var.map_aux[f], inner)
+        for key, epoch in epoch_part:
+            spec.field_index(_to_key(key))  # KeyError if unknown field
+            if int(epoch) < 0:
+                raise ValueError(f"negative field epoch for {key!r}")
         _check_capacity(var.actors, pclock, "actor")
 
 
@@ -376,7 +401,7 @@ def _import_state(var, portable: Any, *, _validated: bool = False):
             clock=jnp.asarray(clock), dots=jnp.asarray(dots)
         )
     if tn == "riak_dt_map":
-        clock_part, fields_part = portable if portable else ([], [])
+        clock_part, fields_part, epoch_part = _split_map_portable(var, portable)
         clock = np.zeros((spec.n_actors,), dtype=np.int32)
         dots = np.zeros((spec.n_fields, spec.n_actors), dtype=np.int32)
         for actor, count in clock_part:
@@ -387,12 +412,47 @@ def _import_state(var, portable: Any, *, _validated: bool = False):
             for actor, count in fdots:
                 dots[f, var.actors.intern(_to_key(actor))] = int(count)
             fields[f] = _import_state(var.map_aux[f], inner, _validated=True)
-        return state._replace(
+        out = state._replace(
             clock=jnp.asarray(clock),
             dots=jnp.asarray(dots),
             fields=tuple(fields),
         )
+        if state.epochs is not None:
+            epochs = np.zeros((spec.n_fields,), dtype=np.int32)
+            for key, epoch in epoch_part:
+                epochs[spec.field_index(_to_key(key))] = int(epoch)
+            out = out._replace(epochs=jnp.asarray(epochs))
+        return out
     raise ValueError(f"bridge: unsupported type {tn!r}")
+
+
+def _split_map_portable(var, portable):
+    """Normalize a portable map to (clock, fields, epochs). The epoch
+    component exists only for reset_on_readd maps; its presence must match
+    the variable's mode (silent epoch loss would resurrect removed
+    contents on a later merge)."""
+    if not portable:
+        return [], [], []
+    resets = getattr(var.spec, "reset_on_readd", False)
+    if len(portable) == 2:
+        if resets:
+            # reset-mode exports ALWAYS carry the epoch component (even
+            # all-zero); a 2-tuple can only come from a plain-mode source,
+            # whose era-0 contents this variable's epoch gate would treat
+            # incoherently (silently resurrected or silently dropped)
+            raise ValueError(
+                "portable map state has no epoch component but "
+                f"{var.id!r} was declared with reset_on_readd"
+            )
+        return portable[0], portable[1], []
+    if len(portable) == 3:
+        if not resets:
+            raise ValueError(
+                "portable map state carries field epochs but "
+                f"{var.id!r} was not declared with reset_on_readd"
+            )
+        return portable
+    raise ValueError("portable map state must be a 2- or 3-tuple")
 
 
 def _export_value(store: Store, var_id) -> Any:

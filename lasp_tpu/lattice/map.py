@@ -12,11 +12,26 @@ strict inflation = dominating clock or equal clocks with removed fields.
 Dense encoding: the field *schema is static* — a ``MapSpec`` fixes the
 ordered tuple of (key, embedded codec, embedded spec) — so a Map state is
 ``clock: int32[A]``, ``dots: int32[F, A]`` (presence, exactly the ORSWOT
-dot matrix over field slots) and a tuple of embedded states. Dense-shape
-divergence (documented): the reference resets a field's contents when the
-field is removed and re-added; here contents are join-monotone across
-remove/re-add (presence controls visibility only) — the trade that keeps
-merge a pure elementwise lattice join over fixed shapes.
+dot matrix over field slots) and a tuple of embedded states.
+
+Remove/re-add semantics — two modes:
+
+- default (``reset_on_readd=False``): contents are join-monotone across
+  remove/re-add (presence controls visibility only) — the trade that
+  keeps merge a pure elementwise lattice join over fixed shapes.
+- ``reset_on_readd=True``: ``riak_dt_map``'s observable KVS semantics
+  (``riak_test/lasp_kvs_replica_test.erl:61-129``) — a remove resets the
+  field's embedded contents to bottom and bumps a per-field *epoch*
+  (``epochs: int32[F]``); merge joins embedded contents only between
+  equal epochs, a lower-epoch side contributing bottom. Sequential
+  remove-then-re-add therefore yields fresh contents, and a propagated
+  remove resets every replica. Documented divergence under CONCURRENCY:
+  an update concurrent with a remove keeps the field present (its fresh
+  presence dot survives the ORSWOT rule) but its era's contents are
+  dropped by the epoch gate — where riak_dt's reset-remove would keep
+  the concurrent update's own contribution. Dot-tracking every embedded
+  element would close that gap at the cost of a dots plane per embedded
+  slot; the epoch gate is the dense-shape compromise.
 """
 
 from __future__ import annotations
@@ -36,6 +51,9 @@ class MapSpec:
     #: ordered static schema: ((key, codec_cls, embedded_spec), ...)
     fields: tuple
     n_actors: int
+    #: riak_dt re-add semantics: remove resets embedded contents via a
+    #: per-field epoch (see module docstring)
+    reset_on_readd: bool = False
 
     @property
     def n_fields(self) -> int:
@@ -48,10 +66,19 @@ class MapSpec:
         raise KeyError(f"riak_dt_map: unknown field {key!r} (static schema)")
 
 
+def _resets(spec: MapSpec) -> bool:
+    # getattr, not attribute access: MapSpec instances unpickled from
+    # pre-round-4 checkpoints lack the field entirely (pickle restores
+    # __dict__ verbatim; dataclass defaults do not backfill)
+    return bool(getattr(spec, "reset_on_readd", False))
+
+
 class MapState(NamedTuple):
     clock: jax.Array  # int32[A]
     dots: jax.Array  # int32[F, A] — field-presence dots (ORSWOT logic)
     fields: tuple  # embedded states, schema order
+    #: int32[F] reset eras (reset_on_readd mode), else None
+    epochs: "jax.Array | None" = None
 
 
 class CrdtMap(CrdtType):
@@ -63,6 +90,11 @@ class CrdtMap(CrdtType):
             clock=jnp.zeros((spec.n_actors,), dtype=jnp.int32),
             dots=jnp.zeros((spec.n_fields, spec.n_actors), dtype=jnp.int32),
             fields=tuple(codec.new(espec) for _k, codec, espec in spec.fields),
+            epochs=(
+                jnp.zeros((spec.n_fields,), dtype=jnp.int32)
+                if _resets(spec)
+                else None
+            ),
         )
 
     # -- updates ------------------------------------------------------------
@@ -71,33 +103,63 @@ class CrdtMap(CrdtType):
         """Mark a field present with a fresh dot (the presence half of
         ``{update, Key, Op}``); the embedded op is applied by the caller."""
         clock, dots = mint_dot(state.clock, state.dots, field_idx, actor_idx)
-        return MapState(clock=clock, dots=dots, fields=state.fields)
+        return state._replace(clock=clock, dots=dots)
 
     @staticmethod
     def set_field(spec: MapSpec, state: MapState, field_idx: int, fstate) -> MapState:
         fields = list(state.fields)
         fields[field_idx] = fstate
-        return MapState(clock=state.clock, dots=state.dots, fields=tuple(fields))
+        return state._replace(fields=tuple(fields))
 
     @staticmethod
     def remove(spec: MapSpec, state: MapState, field_idx: int) -> MapState:
         """``{remove, Key}``: drop the presence dots; the clock witnesses
-        them so merges cannot resurrect the removal."""
-        return MapState(
-            clock=state.clock,
-            dots=state.dots.at[field_idx].set(0),
-            fields=state.fields,
+        them so merges cannot resurrect the removal. In reset mode the
+        embedded contents go to bottom and the field's epoch advances —
+        the reference drops its local entry outright."""
+        out = state._replace(dots=state.dots.at[field_idx].set(0))
+        if not _resets(spec):
+            return out
+        _k, codec, espec = spec.fields[field_idx]
+        fields = list(out.fields)
+        fields[field_idx] = codec.new(espec)
+        return out._replace(
+            fields=tuple(fields),
+            epochs=out.epochs.at[field_idx].add(1),
         )
 
     # -- lattice ------------------------------------------------------------
     @staticmethod
     def merge(spec: MapSpec, a: MapState, b: MapState) -> MapState:
         clock, dots = merge_dots(a.clock, a.dots, b.clock, b.dots)
-        fields = tuple(
-            codec.merge(espec, fa, fb)
-            for (_k, codec, espec), fa, fb in zip(spec.fields, a.fields, b.fields)
+        if not _resets(spec):
+            fields = tuple(
+                codec.merge(espec, fa, fb)
+                for (_k, codec, espec), fa, fb in zip(
+                    spec.fields, a.fields, b.fields
+                )
+            )
+            return MapState(clock=clock, dots=dots, fields=fields)
+        # epoch gate: embedded contents join only between equal eras; the
+        # side that has observed fewer resets contributes bottom
+        epochs = jnp.maximum(a.epochs, b.epochs)
+        fields = []
+        for f, ((_k, codec, espec), fa, fb) in enumerate(
+            zip(spec.fields, a.fields, b.fields)
+        ):
+            bottom = codec.new(espec)
+            fa = jax.tree_util.tree_map(
+                lambda x, bot: jnp.where(a.epochs[f] == epochs[f], x, bot),
+                fa, bottom,
+            )
+            fb = jax.tree_util.tree_map(
+                lambda x, bot: jnp.where(b.epochs[f] == epochs[f], x, bot),
+                fb, bottom,
+            )
+            fields.append(codec.merge(espec, fa, fb))
+        return MapState(
+            clock=clock, dots=dots, fields=tuple(fields), epochs=epochs
         )
-        return MapState(clock=clock, dots=dots, fields=fields)
 
     @staticmethod
     def value(spec: MapSpec, state: MapState) -> jax.Array:
@@ -107,16 +169,28 @@ class CrdtMap(CrdtType):
     @staticmethod
     def equal(spec: MapSpec, a: MapState, b: MapState) -> jax.Array:
         acc = jnp.all(a.clock == b.clock) & jnp.all(a.dots == b.dots)
+        if _resets(spec):
+            acc = acc & jnp.all(a.epochs == b.epochs)
         for (_k, codec, espec), fa, fb in zip(spec.fields, a.fields, b.fields):
             acc = acc & codec.equal(espec, fa, fb)
         return acc
 
     @staticmethod
     def is_inflation(spec: MapSpec, prev: MapState, cur: MapState) -> jax.Array:
-        # clock descends (src/lasp_lattice.erl:166-167)
-        return clock_inflation(prev.clock, cur.clock)
+        # clock descends (src/lasp_lattice.erl:166-167); reset eras only
+        # ever advance
+        out = clock_inflation(prev.clock, cur.clock)
+        if _resets(spec):
+            out = out & jnp.all(prev.epochs <= cur.epochs)
+        return out
 
     @staticmethod
     def is_strict_inflation(spec: MapSpec, prev: MapState, cur: MapState) -> jax.Array:
-        # src/lasp_lattice.erl:264-271 (same rule as orswot)
-        return strict_clock_inflation(prev.clock, prev.dots, cur.clock, cur.dots)
+        # src/lasp_lattice.erl:264-271 (same rule as orswot); in reset
+        # mode an epoch advance under an unchanged clock (a remove whose
+        # dots were already absorbed) still counts as change
+        out = strict_clock_inflation(prev.clock, prev.dots, cur.clock, cur.dots)
+        if _resets(spec):
+            grew = jnp.any(cur.epochs > prev.epochs)
+            out = out | (clock_inflation(prev.clock, cur.clock) & grew)
+        return out

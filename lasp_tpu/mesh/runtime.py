@@ -487,14 +487,16 @@ class ReplicatedRuntime:
         elif tn == "riak_dt_map" and all(
             fcodec.name in self._MAP_FIELD_BATCH
             for _k, fcodec, _s in var.spec.fields
-        ):
+        ) and not self._map_reset_remove_batch(var, ops):
             self.states[var_id] = self._map_batch(var, states, ops)
         else:
             # maps embedding field types without a pure batch kernel
-            # (orset/orswot/map-in-map fields): fall back to per-op
-            # update_at, preserving exact sequential semantics at O(batch)
-            # device dispatches. Loud enough to never hide a
-            # population-scale perf cliff.
+            # (orset/orswot/map-in-map fields), or reset_on_readd batches
+            # containing removes (epoch bumps + embedded bottom-resets
+            # interleave with inner ops in ways the two-pass batch cannot
+            # express): fall back to per-op update_at, preserving exact
+            # sequential semantics at O(batch) device dispatches. Loud
+            # enough to never hide a population-scale perf cliff.
             import warnings
 
             warnings.warn(
@@ -506,6 +508,20 @@ class ReplicatedRuntime:
             )
             for r, op, actor in ops:
                 self.update_at(r, var_id, op, actor)
+
+    @staticmethod
+    def _map_reset_remove_batch(var, ops) -> bool:
+        """True iff the map is in reset_on_readd mode AND the batch holds a
+        field remove (the combination the vectorized two-pass batch cannot
+        express — see ``_dispatch_batch``'s fallback comment)."""
+        if not getattr(var.spec, "reset_on_readd", False):
+            return False
+        for _r, op, _actor in ops:
+            subs = op[1] if op[0] == "update" and len(op) == 2 else [op]
+            for sub in subs:
+                if isinstance(sub, tuple) and sub and sub[0] == "remove":
+                    return True
+        return False
 
     def _orset_batch(self, var, ops) -> None:
         """Batched OR-Set adds/removes with SEQUENTIAL semantics: ops are

@@ -71,11 +71,11 @@ ALLOWED_CAPS = {
     "lasp_orset_gbtree": {"n_elems", "n_actors", "tokens_per_actor"},
     "riak_dt_gcounter": {"n_actors"},
     "riak_dt_orswot": {"n_elems", "n_actors"},
-    "riak_dt_map": {"fields", "n_actors"},
+    "riak_dt_map": {"fields", "n_actors", "reset_on_readd"},
 }
 
 
-def build_map_spec(fields, n_actors: int) -> MapSpec:
+def build_map_spec(fields, n_actors: int, reset_on_readd: bool = False) -> MapSpec:
     """Build a static Map schema from ``[(key, type_name, caps_dict), ...]``
     (the dense analogue of riak_dt_map's dynamic ``{Name, Type}`` keys —
     fields are declared up front so shapes stay fixed)."""
@@ -108,7 +108,11 @@ def build_map_spec(fields, n_actors: int) -> MapSpec:
                 )
             caps["n_actors"] = n_actors
         resolved.append((key, get_type(type_name), DEFAULT_SPECS[type_name](**caps)))
-    return MapSpec(fields=tuple(resolved), n_actors=n_actors)
+    return MapSpec(
+        fields=tuple(resolved),
+        n_actors=n_actors,
+        reset_on_readd=reset_on_readd,
+    )
 
 
 class PreconditionError(RuntimeError):
@@ -213,7 +217,9 @@ class Store:
                 caps.setdefault("n_actors", self.n_actors)
             if type == "riak_dt_map":
                 spec = build_map_spec(
-                    caps.get("fields", ()), caps.get("n_actors", self.n_actors)
+                    caps.get("fields", ()),
+                    caps.get("n_actors", self.n_actors),
+                    reset_on_readd=caps.get("reset_on_readd", False),
                 )
             else:
                 spec = DEFAULT_SPECS[type](**caps)

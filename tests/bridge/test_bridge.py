@@ -475,6 +475,51 @@ def test_map_bridge_declare_update_read_roundtrip():
             assert resp[0] == Atom("error")
 
 
+def test_map_bridge_reset_mode_epochs_roundtrip():
+    """reset_on_readd maps over the wire: caps flag parsed, remove-then-
+    re-add resets contents, and the portable state carries the epoch
+    component — whose presence must match the target's mode."""
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("v")
+            fields = [(b"tags", Atom("lasp_gset"), {Atom("n_elems"): 4})]
+            caps = {Atom("fields"): fields, Atom("n_actors"): 4,
+                    Atom("reset_on_readd"): Atom("true")}
+            resp = c.call((Atom("declare"), b"m", Atom("riak_dt_map"), caps))
+            assert resp == (Atom("ok"), b"m")
+            c.update(b"m", (Atom("update"), b"tags", (Atom("add"), b"t1")), b"w")
+            c.update(b"m", (Atom("remove"), b"tags"), b"w")
+            ok, val = c.update(b"m", (Atom("update"), b"tags",
+                                      (Atom("add"), b"t2")), b"w")
+            assert ok == Atom("ok")
+            assert val == [(b"tags", [b"t2"])]  # t1 reset away
+            ok, (type_atom, portable) = c.get(b"m")
+            assert len(portable) == 3  # (clock, fields, epochs)
+            assert portable[2] == [(b"tags", 1)]
+            # round-trip into a twin of the same mode
+            resp = c.call((Atom("put"), b"m2",
+                           (Atom("riak_dt_map"), portable, caps)))
+            assert resp == Atom("ok")
+            assert c.read(b"m2") == (Atom("ok"), [(b"tags", [b"t2"])])
+            # a NON-reset twin must refuse the epoch-bearing state
+            caps_plain = {Atom("fields"): fields, Atom("n_actors"): 4}
+            resp = c.call((Atom("put"), b"m3",
+                           (Atom("riak_dt_map"), portable, caps_plain)))
+            assert resp[0] == Atom("error")
+            # ... and a reset twin must refuse an epoch-LESS state (it can
+            # only come from a plain-mode source)
+            resp = c.call((Atom("put"), b"m4",
+                           (Atom("riak_dt_map"),
+                            (portable[0], portable[1]), caps)))
+            assert resp[0] == Atom("error")
+            # a malformed flag value is rejected at declare, not coerced
+            bad_caps = {Atom("fields"): fields, Atom("n_actors"): 4,
+                        Atom("reset_on_readd"): 1}
+            resp = c.call((Atom("declare"), b"m5", Atom("riak_dt_map"),
+                           bad_caps))
+            assert resp[0] == Atom("error")
+
+
 def test_map_bridge_durable(tmp_path):
     import time
 

@@ -101,4 +101,10 @@ def decode_map(spec, state, elems):
         sname: decode_gset(sspec, state.fields[0], elems),
         cname: decode_gcounter(cspec, state.fields[1]),
     }
-    return (cdict, fdots, fields)
+    if state.epochs is None:
+        return (cdict, fdots, fields)
+    epochs = {
+        f[0]: int(e)
+        for f, e in zip(spec.fields, np.asarray(state.epochs))
+    }
+    return (cdict, fdots, fields, epochs)

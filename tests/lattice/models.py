@@ -306,3 +306,48 @@ class PyMap:
     @classmethod
     def value(cls, state):
         return frozenset(state[1])
+
+
+class PyResetMap(PyMap):
+    """Oracle for ``reset_on_readd`` semantics (lattice/map.py): a remove
+    resets the field's contents to bottom and bumps its epoch; merge joins
+    contents only between equal (max) epochs — a lower-epoch side
+    contributes bottom. State = (clock, fdots, fields, epochs)."""
+
+    @classmethod
+    def new(cls):
+        return (
+            {},
+            {},
+            {f: m.new() for f, m in cls.SCHEMA},
+            {f: 0 for f, _m in cls.SCHEMA},
+        )
+
+    @classmethod
+    def update(cls, state, fname, actor, inner_fn):
+        clock, fdots, fields, epochs = state
+        c, fd, fl = PyMap.update((clock, fdots, fields), fname, actor, inner_fn)
+        return (c, fd, fl, dict(epochs))
+
+    @classmethod
+    def remove(cls, state, fname):
+        clock, fdots, fields, epochs = state
+        c, fd, _fl = PyMap.remove((clock, fdots, fields), fname)
+        fields = dict(fields)
+        fields[fname] = dict(cls.SCHEMA)[fname].new()
+        epochs = dict(epochs)
+        epochs[fname] += 1
+        return (c, fd, fields, epochs)
+
+    @classmethod
+    def merge(cls, a, b):
+        ca, fa, ia, ea = a
+        cb, fb, ib, eb = b
+        clock, fdots = merge_dot_entries(ca, fa, cb, fb)
+        epochs = {f: max(ea[f], eb[f]) for f, _m in cls.SCHEMA}
+        fields = {}
+        for f, m in cls.SCHEMA:
+            xa = ia[f] if ea[f] == epochs[f] else m.new()
+            xb = ib[f] if eb[f] == epochs[f] else m.new()
+            fields[f] = m.merge(xa, xb)
+        return (clock, fdots, fields, epochs)

@@ -246,14 +246,16 @@ def test_orset_encode_decode_roundtrip(seed):
         assert bool(GSet.equal(gspec, d, re_encoded))
 
 
-def run_map(seed):
+def run_map(seed, reset=False):
     """Statem for the dense riak_dt_map: random field updates (gset add /
     counter increment), observed-field removes, and cross-replica merges,
     against the PyMap oracle (the EQC statem hook riak_dt types provide,
-    test/crdt_statem_eqc.erl:50-106, for the composed type)."""
+    test/crdt_statem_eqc.erl:50-106, for the composed type). With
+    ``reset=True`` the same command sequences run in reset_on_readd mode
+    against the PyResetMap oracle."""
     from lasp_tpu.lattice import CrdtMap, MapSpec
 
-    from .models import PyGCounter, PyGSet, PyMap
+    from .models import PyGCounter, PyGSet, PyMap, PyResetMap
 
     rng = random.Random(seed)
     gspec = GSetSpec(n_elems=len(ELEMS))
@@ -261,10 +263,12 @@ def run_map(seed):
     spec = MapSpec(
         fields=(("s", GSet, gspec), ("c", GCounter, cspec)),
         n_actors=N_REPLICAS,
+        reset_on_readd=reset,
     )
     PyMap.SCHEMA = (("s", PyGSet), ("c", PyGCounter))
+    cls = PyResetMap if reset else PyMap
     dense = [CrdtMap.new(spec) for _ in range(N_REPLICAS)]
-    model = [PyMap.new() for _ in range(N_REPLICAS)]
+    model = [cls.new() for _ in range(N_REPLICAS)]
 
     def dense_update(st, f, r, inner_fn):
         st = CrdtMap.touch(spec, st, f, r)
@@ -278,64 +282,69 @@ def run_map(seed):
             dense[r] = dense_update(
                 dense[r], 0, r, lambda fs: GSet.add(gspec, fs, e)
             )
-            model[r] = PyMap.update(
+            model[r] = cls.update(
                 model[r], "s", r, lambda ms: PyGSet.add(ms, ELEMS[e])
             )
         elif roll < 0.55:
             dense[r] = dense_update(
                 dense[r], 1, r, lambda fs: GCounter.increment(cspec, fs, r)
             )
-            model[r] = PyMap.update(
+            model[r] = cls.update(
                 model[r], "c", r, lambda ms: PyGCounter.increment(ms, r)
             )
         elif roll < 0.7 and model[r][1]:
             fname = rng.choice(sorted(model[r][1]))
             f = 0 if fname == "s" else 1
             dense[r] = CrdtMap.remove(spec, dense[r], f)
-            model[r] = PyMap.remove(model[r], fname)
+            model[r] = cls.remove(model[r], fname)
         else:
             r2 = rng.randrange(N_REPLICAS)
             dense[r] = CrdtMap.merge(spec, dense[r], dense[r2])
-            model[r] = PyMap.merge(model[r], model[r2])
+            model[r] = cls.merge(model[r], model[r2])
     return spec, dense, model
 
 
+@pytest.mark.parametrize("reset", [False, True])
 @pytest.mark.parametrize("seed", range(8))
-def test_map_statem_converge(seed):
+def test_map_statem_converge(seed, reset):
     """prop_converge for the composed type: fold-merge of all replicas
-    decodes to the fold-merged model, and the presence value matches."""
+    decodes to the fold-merged model, and the presence value matches —
+    in both re-add modes."""
     from lasp_tpu.lattice import CrdtMap
 
     from .helpers import decode_map
-    from .models import PyMap
+    from .models import PyMap, PyResetMap
 
-    spec, dense, model = run_map(seed)
+    cls = PyResetMap if reset else PyMap
+    spec, dense, model = run_map(seed, reset=reset)
     merged_d, merged_m = dense[0], model[0]
     for d, m in zip(dense[1:], model[1:]):
         merged_d = CrdtMap.merge(spec, merged_d, d)
-        merged_m = PyMap.merge(merged_m, m)
+        merged_m = cls.merge(merged_m, m)
     assert decode_map(spec, merged_d, ELEMS) == merged_m
     present = {
         spec.fields[i][0]
         for i, v in enumerate(np.asarray(CrdtMap.value(spec, merged_d)))
         if v
     }
-    assert present == set(PyMap.value(merged_m))
+    assert present == set(cls.value(merged_m))
 
 
+@pytest.mark.parametrize("reset", [False, True])
 @pytest.mark.parametrize("seed", range(4))
-def test_map_statem_merge_schedule_independence(seed):
+def test_map_statem_merge_schedule_independence(seed, reset):
     from lasp_tpu.lattice import CrdtMap
 
     from .helpers import decode_map
 
-    spec, dense, _model = run_map(seed)
+    spec, dense, _model = run_map(seed, reset=reset)
     results = set()
     for perm in itertools.islice(itertools.permutations(range(N_REPLICAS)), 8):
         acc = dense[perm[0]]
         for i in perm[1:]:
             acc = CrdtMap.merge(spec, acc, dense[i])
-        c, fd, fs = decode_map(spec, acc, ELEMS)
+        decoded = decode_map(spec, acc, ELEMS)
+        c, fd, fs = decoded[:3]
         results.add((
             tuple(sorted(c.items())),
             tuple(sorted((f, tuple(sorted(d.items()))) for f, d in fd.items())),
@@ -343,5 +352,6 @@ def test_map_statem_merge_schedule_independence(seed):
                 (f, v if isinstance(v, frozenset) else tuple(sorted(v.items())))
                 for f, v in fs.items()
             )),
+            tuple(sorted(decoded[3].items())) if len(decoded) > 3 else (),
         ))
     assert len(results) == 1
