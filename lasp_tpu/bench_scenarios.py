@@ -530,27 +530,35 @@ def adcounter_10m(n_replicas: int = 10 * (1 << 20), threshold: int = 5) -> dict:
 
     # the server: when a replica observes an ad's view total at/over the
     # threshold it removes the ad from the publisher's set; the tombstone
-    # then flows through union -> product -> filter and gossips out
-    a_idx = rt.intern_terms(ads_a, [f"ad{i}" for i in range(n_pub)])
-    b_idx = rt.intern_terms(ads_b, [f"ad{i + n_pub}" for i in range(n_pub)])
+    # then flows through union -> product -> filter and gossips out.
+    # Builder-backed (register_trigger(builder=...)): the closure bakes
+    # interned element indices, and the builder lets a compaction_window
+    # rebuild it against a reclaimed element order mid-soak.
+    def make_server():
+        a_idx = rt.intern_terms(ads_a, [f"ad{i}" for i in range(n_pub)])
+        b_idx = rt.intern_terms(ads_b, [f"ad{i + n_pub}" for i in range(n_pub)])
 
-    def server(dense):
-        totals = jnp.stack(
-            [jnp.sum(dense[v].counts, dtype=jnp.int32) for v in views]
-        )
-        over = totals >= threshold
-        out = {}
-        for vid, idx, sl in ((ads_a, a_idx, slice(0, n_pub)),
-                             (ads_b, b_idx, slice(n_pub, n_ads))):
-            st = dense[vid]
-            mask = jnp.zeros((n_pub,), bool).at[jnp.asarray(idx)].set(over[sl])
-            out[vid] = st._replace(removed=st.removed | (st.exists & mask[:, None]))
-        return out
+        def server(dense):
+            totals = jnp.stack(
+                [jnp.sum(dense[v].counts, dtype=jnp.int32) for v in views]
+            )
+            over = totals >= threshold
+            out = {}
+            for vid, idx, sl in ((ads_a, a_idx, slice(0, n_pub)),
+                                 (ads_b, b_idx, slice(n_pub, n_ads))):
+                st = dense[vid]
+                mask = jnp.zeros((n_pub,), bool).at[jnp.asarray(idx)].set(over[sl])
+                out[vid] = st._replace(
+                    removed=st.removed | (st.exists & mask[:, None])
+                )
+            return out
+
+        return server
 
     # declared touch set: the union pipeline's packed sets stay dense only
     # where needed; the trigger reads the view counters and writes the
     # publishers' sets
-    rt.register_trigger(server, touches=[ads_a, ads_b, *views])
+    rt.register_trigger(builder=make_server, touches=[ads_a, ads_b, *views])
     # warm-up compiles the executables outside the timed loop; its
     # rounds are counted in the reported total
     warm_rounds, run = _engine_convergence_driver(rt)

@@ -433,7 +433,7 @@ def _split_map_portable(var, portable):
     contents on a later merge)."""
     if not portable:
         return [], [], []
-    resets = getattr(var.spec, "reset_on_readd", False)
+    resets = var.spec.reset_on_readd  # class-attr default on old pickles
     if len(portable) == 2:
         if resets:
             # reset-mode exports ALWAYS carry the epoch component (even

@@ -67,10 +67,19 @@ class MapSpec:
 
 
 def _resets(spec: MapSpec) -> bool:
-    # getattr, not attribute access: MapSpec instances unpickled from
-    # pre-round-4 checkpoints lack the field entirely (pickle restores
-    # __dict__ verbatim; dataclass defaults do not backfill)
-    return bool(getattr(spec, "reset_on_readd", False))
+    # works on pre-round-4 unpickled MapSpecs too: the field is absent
+    # from their __dict__, but the dataclass default is a class attribute,
+    # so plain access falls back to False
+    return bool(spec.reset_on_readd)
+
+
+def map_subs(op: tuple) -> list:
+    """Flatten a map client op to its sub-ops: the batched shape
+    ``("update", [SubOps])`` yields its list, a single field op yields
+    itself. The ONE definition of the batch grammar's outer layer — the
+    vectorized batch's shape validation and the reset-remove routing
+    check (``mesh/runtime.py``) must never parse it differently."""
+    return op[1] if op[0] == "update" and len(op) == 2 else [op]
 
 
 class MapState(NamedTuple):

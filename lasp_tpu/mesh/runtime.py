@@ -25,6 +25,8 @@ analogue for this framework).
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -181,7 +183,7 @@ class ReplicatedRuntime:
         return row
 
     # -- reactive triggers ----------------------------------------------------
-    def register_trigger(self, fn, touches=None) -> None:
+    def register_trigger(self, fn=None, touches=None, *, builder=None) -> None:
         """Register a per-replica reactive rule run inside every step:
         ``fn(dense_states: dict) -> dict[var_id, candidate_state]``.
 
@@ -198,9 +200,22 @@ class ReplicatedRuntime:
         dense planes only when the dataflow graph or some trigger needs it
         — declaring the touch set lets unrelated wide variables ride
         through gossip fully packed. ``None`` (the default) means "may
-        touch anything" and forces every variable dense."""
+        touch anything" and forces every variable dense.
+
+        ``builder`` (keyword-only alternative to ``fn``): a zero-arg
+        callable returning the trigger fn, invoked once now and again by
+        :meth:`compaction_window` after a compaction — so closures that
+        bake element indices (``intern_terms`` results) can re-intern
+        against the compacted order. Only builder-backed triggers survive
+        a compaction window."""
+        if (fn is None) == (builder is None):
+            raise ValueError(
+                "register_trigger takes exactly one of fn or builder"
+            )
+        if builder is not None:
+            fn = builder()
         self._triggers.append(
-            (fn, frozenset(touches) if touches is not None else None)
+            (fn, frozenset(touches) if touches is not None else None, builder)
         )
         self._step = None
         self._fused_steps_cache.clear()
@@ -514,11 +529,12 @@ class ReplicatedRuntime:
         """True iff the map is in reset_on_readd mode AND the batch holds a
         field remove (the combination the vectorized two-pass batch cannot
         express — see ``_dispatch_batch``'s fallback comment)."""
-        if not getattr(var.spec, "reset_on_readd", False):
+        if not var.spec.reset_on_readd:
             return False
+        from ..lattice.map import map_subs
+
         for _r, op, _actor in ops:
-            subs = op[1] if op[0] == "update" and len(op) == 2 else [op]
-            for sub in subs:
+            for sub in map_subs(op):
                 if isinstance(sub, tuple) and sub and sub[0] == "remove":
                     return True
         return False
@@ -713,6 +729,7 @@ class ReplicatedRuntime:
         the ops preceding the malformed one. A schema violation is a
         programming error, not a data race, so all-or-nothing is the
         safer contract there."""
+        from ..lattice.map import map_subs
         from ..store.store import PreconditionError
 
         spec = var.spec
@@ -720,8 +737,7 @@ class ReplicatedRuntime:
         # pass 0 — flatten + validate SHAPES up front (nothing applied yet)
         flat = []  # (op_index, replica, ("update", f, inner) | ("remove", f))
         for k, (r, op, actor) in enumerate(ops):
-            subs = op[1] if op[0] == "update" and len(op) == 2 else [op]
-            for sub in subs:
+            for sub in map_subs(op):
                 if sub[0] == "update" and len(sub) == 3:
                     f = spec.field_index(sub[1])  # KeyError: unknown field
                     inner = sub[2]
@@ -1101,11 +1117,11 @@ class ReplicatedRuntime:
         flow_ids = graph._var_ids
         triggers = tuple(self._triggers)
         # which variables need dense views inside the local round
-        if any(touch is None for _fn, touch in triggers):
+        if any(touch is None for _fn, touch, _b in triggers):
             needed = frozenset(self.var_ids)
         else:
             needed = frozenset(flow_ids) | frozenset(
-                v for _fn, touch in triggers for v in touch
+                v for _fn, touch, _b in triggers for v in touch
             )
             needed &= frozenset(self.var_ids)
 
@@ -1131,7 +1147,7 @@ class ReplicatedRuntime:
                         flow = {v: dense[v] for v in flow_ids}
                         new, _ = graph._round_fn_pure(flow, tables)
                         dense.update(new)
-                    for trig, touch in triggers:
+                    for trig, touch, _b in triggers:
                         for v, cand in trig(dense).items():
                             if v not in dense:
                                 raise KeyError(
@@ -1719,7 +1735,7 @@ class ReplicatedRuntime:
                 "run_to_convergence first (a dropped tombstone could be "
                 "resurrected by a divergent peer)"
             )
-        for _fn, touch in self._triggers:
+        for _fn, touch, _b in self._triggers:
             if touch is None or var_id in touch:
                 raise RuntimeError(
                     f"compact_orset({var_id!r}): a registered trigger "
@@ -1751,6 +1767,50 @@ class ReplicatedRuntime:
         # are spec-fixed, so the compiled step does NOT retrace)
         self.graph.refresh()
         return reclaimed
+
+    @contextlib.contextmanager
+    def compaction_window(self, max_rounds: int = 10_000, edge_mask=None,
+                          block: int = 32):
+        """Stop-the-world tombstone reclamation for long-lived populations
+        WITH registered triggers — the online story ``compact_orset``'s
+        preconditions otherwise forbid (a trigger-touched variable could
+        never compact; waste would grow unboundedly, exactly the
+        reference's ``waste_pct`` trajectory, ``src/lasp_orset.erl:
+        156-192``).
+
+        Entering the window (1) requires every registered trigger to be
+        builder-backed (a plain-fn trigger's closure may hold element
+        indices in the pre-compaction order and cannot be rebuilt), (2)
+        quiesces all triggers, and (3) runs the quiesced engine to its
+        fixed point so the divergence-0 compaction precondition holds.
+        The body then calls ``compact_orset`` on whatever variables it
+        likes. On exit — error or not — the builders are re-invoked, so
+        trigger closures re-intern their element indices against the
+        compacted order, and the rebuilt triggers resume with the next
+        step (they are per-round predicates; pausing loses nothing).
+        Failing to converge within ``max_rounds`` raises with triggers
+        restored and nothing compacted."""
+        for _fn, _touch, b in self._triggers:
+            if b is None:
+                raise RuntimeError(
+                    "compaction_window: a registered trigger has no "
+                    "builder — register it with register_trigger("
+                    "builder=...) so it can be rebuilt against the "
+                    "compacted element order"
+                )
+        saved = list(self._triggers)
+        self._triggers = []
+        self._step = None
+        self._fused_steps_cache.clear()
+        try:
+            self.run_to_convergence(
+                max_rounds=max_rounds, edge_mask=edge_mask, block=block
+            )
+            yield self
+        finally:
+            self._triggers = [(b(), touch, b) for _f, touch, b in saved]
+            self._step = None
+            self._fused_steps_cache.clear()
 
     def _to_dense_states(self, var_id: str):
         if var_id in self._packed_specs:

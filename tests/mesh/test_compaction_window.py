@@ -1,0 +1,140 @@
+"""Online compaction (VERDICT r3 ask #7): ``rt.compaction_window()`` lets a
+long-lived population WITH registered triggers reclaim tombstoned element
+slots mid-run — the reclamation the reference's ``waste_pct`` stat cues but
+never performs (``src/lasp_orset.erl:156-192``)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import Store
+
+
+def _waste_pct(rt, var_id) -> int:
+    var = rt.store.variable(var_id)
+    row0 = jax.tree_util.tree_map(
+        lambda x: x[0], rt._to_dense_states(var_id)
+    )
+    return var.codec.stats(var.spec, row0)["waste_pct"]
+
+
+def _build(n=8):
+    store = Store(n_actors=4)
+    s = store.declare(id="s", type="lasp_orset", n_elems=32)
+    flag = store.declare(id="flag", type="lasp_gset", n_elems=2)
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, 2))
+
+    # a builder-backed trigger: when the set holds the sentinel element it
+    # raises the flag — the closure bakes element indices via intern_terms
+    # exactly like the ad-counter server does
+    def make_trigger():
+        (sent_idx,) = rt.intern_terms(s, ["sentinel"])
+        (f_idx,) = rt.intern_terms(flag, ["raised"])
+
+        def trig(dense):
+            st, fl = dense[s], dense[flag]
+            live = (st.exists[sent_idx] & ~st.removed[sent_idx]).any()
+            return {flag: fl._replace(mask=fl.mask.at[f_idx].set(
+                fl.mask[f_idx] | live
+            ))}
+
+        return trig
+
+    rt.register_trigger(builder=make_trigger, touches=[s, flag])
+    return rt, s, flag
+
+
+def test_soak_waste_returns_to_zero_mid_run():
+    rt, s, flag = _build()
+    # churn phase 1: add/remove cycles fill element slots with tombstones
+    # (two keepers stay live — waste_pct is defined over a live set)
+    for k in range(2):
+        rt.update_at(k, s, ("add", f"keep{k}"), f"a{k}")
+    for i in range(12):
+        rt.update_at(i % 8, s, ("add", f"churn{i}"), f"a{i % 4}")
+    rt.run_to_convergence(max_rounds=32)
+    for i in range(12):
+        rt.update_at(0, s, ("remove", f"churn{i}"), "a0")
+    rt.run_to_convergence(max_rounds=32)
+    assert _waste_pct(rt, s) > 50  # tombstone-dominated
+    before = len(rt.store.variable(s).elems)
+
+    # the online window: quiesce -> converge -> compact -> rebuild
+    with rt.compaction_window() as w:
+        reclaimed = w.compact_orset(s)
+    # 12 churn slots + the builder's pre-interned (never-added, token-free)
+    # sentinel slot; the rebuilt builder then re-interns the sentinel, so
+    # the post-window universe is exactly {keep0, keep1, sentinel}
+    assert reclaimed == 13
+    assert before == 15
+    assert sorted(rt.store.variable(s).elems.terms()) == [
+        "keep0", "keep1", "sentinel",
+    ]
+    assert _waste_pct(rt, s) == 0  # mid-run, back to zero
+
+    # churn phase 2: the REBUILT trigger still fires with the compacted
+    # index order — the sentinel raises the flag
+    rt.update_at(3, s, ("add", "sentinel"), "a3")
+    rt.run_to_convergence(max_rounds=32)
+    assert rt.coverage_value(flag) == {"raised"}
+    assert rt.coverage_value(s) == {"keep0", "keep1", "sentinel"}
+    assert rt.divergence(s) == 0
+
+
+def test_window_refuses_plain_fn_triggers():
+    store = Store(n_actors=2)
+    s = store.declare(id="s", type="lasp_orset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    rt.register_trigger(lambda dense: {}, touches=[s])
+    with pytest.raises(RuntimeError, match="builder"):
+        with rt.compaction_window():
+            pass
+
+
+def test_window_restores_triggers_on_body_error():
+    rt, s, flag = _build()
+    with pytest.raises(ValueError, match="boom"):
+        with rt.compaction_window():
+            raise ValueError("boom")
+    assert len(rt._triggers) == 1  # rebuilt despite the error
+    rt.update_at(0, s, ("add", "sentinel"), "a0")
+    rt.run_to_convergence(max_rounds=32)
+    assert rt.coverage_value(flag) == {"raised"}
+
+
+def test_register_trigger_rejects_fn_and_builder_together():
+    store = Store(n_actors=2)
+    store.declare(id="s", type="lasp_orset", n_elems=4)
+    rt = ReplicatedRuntime(store, Graph(store), 2, ring(2, 1))
+    with pytest.raises(ValueError, match="exactly one"):
+        rt.register_trigger(lambda d: {}, builder=lambda: (lambda d: {}))
+    with pytest.raises(ValueError, match="exactly one"):
+        rt.register_trigger()
+
+
+def test_window_works_in_packed_mode():
+    store = Store(n_actors=4)
+    s = store.declare(id="s", type="lasp_orset", n_elems=16,
+                      tokens_per_actor=2)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2), packed=True)
+
+    def make_trigger():
+        def trig(dense):
+            return {}
+
+        return trig
+
+    rt.register_trigger(builder=make_trigger, touches=[s])
+    for i in range(8):
+        rt.update_at(i % 4, s, ("add", f"e{i}"), f"a{i % 4}")
+    rt.run_to_convergence(max_rounds=16)
+    for i in range(8):
+        rt.update_at(1, s, ("remove", f"e{i}"), "a1")
+    rt.run_to_convergence(max_rounds=16)
+    with rt.compaction_window() as w:
+        assert w.compact_orset(s) == 8
+    rt.update_at(2, s, ("add", "fresh"), "a2")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.coverage_value(s) == {"fresh"}
