@@ -4,7 +4,7 @@ device meshes — the TPU rebuild of the reference's riak_core distribution
 layer and request-coordination FSMs (SURVEY.md §2.5/§2.6/§7.4)."""
 
 from .gossip import converged, divergence, gossip_round, join_all, quorum_read
-from .runtime import ReplicatedRuntime
+from .runtime import ActorCollisionError, ReplicatedRuntime
 from .topology import (
     edge_failure_mask,
     partition_mask,
@@ -14,6 +14,7 @@ from .topology import (
 )
 
 __all__ = [
+    "ActorCollisionError",
     "ReplicatedRuntime",
     "converged",
     "divergence",

@@ -47,6 +47,14 @@ from .topology import shift_offsets
 _PACKABLE = ("lasp_orset", "lasp_orset_gbtree")
 
 
+class ActorCollisionError(RuntimeError):
+    """Two replica rows minted per-actor lane events under one actor
+    (raised only under the opt-in ``debug_actors`` guard). The riak_dt
+    actor requirement (SURVEY §2.1): an actor names ONE writing site;
+    colliding dot counters read as observed-and-removed, and same-lane
+    counter increments max-merge into lost counts — silently."""
+
+
 class _CapacityWalk:
     """Free-slot accounting for ONE interner across a batch walk: counts
     the new terms an op needs WITHOUT interning, so a failing op can be
@@ -107,6 +115,7 @@ class ReplicatedRuntime:
         neighbors: np.ndarray,
         packed: bool = False,
         donate_steps: bool = True,
+        debug_actors: bool = False,
     ):
         self.store = store
         self.graph = graph
@@ -132,6 +141,10 @@ class ReplicatedRuntime:
         self._triggers: list = []
         self._programs: dict = {}
         self._program_session = None
+        #: opt-in actor-collision guard (see _guard_actor); the write-site
+        #: registry maps (var_id, actor-identity) -> home replica
+        self.debug_actors = debug_actors
+        self._actor_sites: dict = {}
         self._step = None
         self._fused_steps_cache: dict[int, object] = {}
         self._n_edges = -1
@@ -295,6 +308,85 @@ class ReplicatedRuntime:
         """Registered programs by name (read-only view)."""
         return dict(self._programs)
 
+    # -- actor-collision debug guard -----------------------------------------
+    #: types whose state carries per-actor lanes that two writing replicas
+    #: would silently corrupt: vclock types (colliding dot counters read as
+    #: observed-and-removed -> disappearing elements) and the G-Counter
+    #: (same-lane increments at two rows max-merge into lost counts)
+    _ACTOR_LANE_TYPES = frozenset(
+        {"riak_dt_orswot", "riak_dt_map", "riak_dt_gcounter"}
+    )
+
+    def _actor_guard_keys(self, var, actor, fresh_offset: int = 0) -> list:
+        """Registry keys naming one physical actor lane. Term surfaces
+        (update_at / update_batch) name actors by term; seed_increments
+        names them by lane index — both spellings key the SAME lane, so
+        a term registers under its ``("lane", idx)`` alias too, and a
+        lane index resolves back to its term. A NOT-yet-interned term's
+        lane is predicted: the interner assigns slots sequentially, so it
+        will land at ``len(var.actors) + fresh_offset`` (offset = how
+        many other fresh actors precede it in the same batch) — without
+        the prediction, a seeded lane's home row would not collide with
+        the term write that later interns into that lane."""
+        keys = [(var.id, actor)]
+        if var.actors is None:
+            return keys
+        if isinstance(actor, tuple) and len(actor) == 2 and actor[0] == "lane":
+            idx = actor[1]
+            if idx < len(var.actors):
+                keys.append((var.id, var.actors.terms()[idx]))
+        elif actor in var.actors:
+            keys.append((var.id, ("lane", var.actors.index_of(actor))))
+        else:
+            keys.append((var.id, ("lane", len(var.actors) + fresh_offset)))
+        return keys
+
+    def _guard_actor_check(self, var, replica: int, actor) -> list:
+        """Opt-in (``debug_actors=True``) write-site registry, CHECK half:
+        an actor is a WRITER IDENTITY for the per-actor-lane types (the
+        riak_dt requirement documented on :meth:`update_at`); minting
+        events under one actor from two replica rows corrupts state
+        SILENTLY (the vclock rule reads colliding dots as
+        observed-and-removed). Raises at the second write site; returns
+        the registry keys for :meth:`_guard_actor_commit` AFTER the write
+        actually applies (a failed write must not register a phantom
+        site). Registry resets on membership changes (row indices move)."""
+        keys = self._actor_guard_keys(var, actor)
+        for key in keys:
+            prev = self._actor_sites.get(key)
+            if prev is not None and prev != int(replica):
+                raise ActorCollisionError(
+                    f"actor {actor!r} already minted lane events for "
+                    f"{var.id!r} at replica {prev}; writing from replica "
+                    f"{int(replica)} would collide its per-actor lane "
+                    "(vclock dots / counter lanes merge by max: silent "
+                    "element loss or lost increments). Use one actor per "
+                    "writing replica."
+                )
+        return keys
+
+    def _guard_actor_commit(self, keys, replica: int) -> None:
+        for key in keys:
+            self._actor_sites.setdefault(key, int(replica))
+
+    @staticmethod
+    def _op_mints_lane(var, op: tuple) -> bool:
+        """Does this client op mint per-actor lane events? (Removes read
+        lanes but mint nothing — two-site removes are safe.)"""
+        tn = var.type_name
+        if tn == "riak_dt_gcounter":
+            return op[0] == "increment"
+        if tn == "riak_dt_orswot":
+            return op[0] in ("add", "add_all")
+        if tn == "riak_dt_map":
+            from ..lattice.map import map_subs
+
+            return any(
+                isinstance(s, tuple) and s and s[0] == "update"
+                for s in map_subs(op)
+            )
+        return False
+
     # -- client operations ---------------------------------------------------
     def update_at(self, replica: int, var_id: str, op: tuple, actor) -> None:
         """Apply a store op at one replica row — the client write of the
@@ -316,13 +408,28 @@ class ReplicatedRuntime:
         the same actor produce colliding counters that the vclock
         domination rule reads as observed-and-removed (silent element
         loss). Use one actor per writing replica, exactly as riak_dt
-        requires of the reference."""
+        requires of the reference. Construct the runtime with
+        ``debug_actors=True`` to turn that misuse into a loud
+        :class:`ActorCollisionError` at the second write site."""
         var = self.store.variable(var_id)
+        guard_keys = None
+        if (
+            self.debug_actors
+            and var.type_name in self._ACTOR_LANE_TYPES
+            and self._op_mints_lane(var, op)
+        ):
+            guard_keys = self._guard_actor_check(var, replica, actor)
         wire_row = jax.tree_util.tree_map(
             lambda x: x[replica], self._population(var_id)
         )
         row = self._to_dense_row(var_id, wire_row)
         candidate = self.store._apply_op(var, row, op, actor)
+        if guard_keys is not None:
+            # the apply interned the actor, so re-derive keys to pick up
+            # the ("lane", idx) alias, then register the site
+            self._guard_actor_commit(
+                self._actor_guard_keys(var, actor), replica
+            )
         merged = var.codec.merge(var.spec, row, candidate)
         if bool(var.codec.is_inflation(var.spec, row, merged)):
             new_row = self._from_dense_row(var_id, merged)
@@ -366,12 +473,46 @@ class ReplicatedRuntime:
         tn = var.type_name
         if not ops:
             return
+        # guard BEFORE any mutation: a debug-mode violation is a
+        # batch-level programming error, all-or-nothing like shape errors
+        # (nothing applied, registry not extended)
+        if self.debug_actors and tn in self._ACTOR_LANE_TYPES:
+            staged = dict()
+            fresh: dict = {}  # not-yet-interned actors -> arrival order
+            for r, op, actor in ops:
+                if not self._op_mints_lane(var, op):
+                    continue
+                if var.actors is not None and actor not in var.actors:
+                    fresh.setdefault(actor, len(fresh))
+                off = fresh.get(actor, 0)
+                for key in self._actor_guard_keys(var, actor, off):
+                    prev = self._actor_sites.get(key, staged.get(key))
+                    if prev is None:
+                        staged[key] = int(r)
+                    elif prev != int(r):
+                        raise ActorCollisionError(
+                            f"update_batch({var_id!r}): actor {actor!r} "
+                            f"mints lane events at replicas {prev} and "
+                            f"{int(r)} — one actor per writing replica "
+                            "(see debug_actors/_guard_actor_check)"
+                        )
         # interner overflow must follow the same per-op prefix semantics as
         # pool/precondition failures: find the longest op prefix whose NEW
         # terms/actors fit, apply only that, then raise
         n_fit, cap_err = self._capacity_prefix(var, tn, ops)
         if cap_err is not None:
             ops = ops[:n_fit]
+        guard_actors = None
+        if self.debug_actors and tn in self._ACTOR_LANE_TYPES:
+            # sites register only for the capacity-validated prefix, and
+            # only after it fully applies (below) — a failed batch extends
+            # nothing, so a caught-and-retried suffix is judged afresh
+            # rather than against phantom sites
+            guard_actors = [
+                (actor, int(r))
+                for r, op, actor in ops
+                if self._op_mints_lane(var, op)
+            ]
         try:
             if ops:
                 self._dispatch_batch(var, tn, states, ops)
@@ -381,6 +522,11 @@ class ReplicatedRuntime:
             # terms must still fold into the edge tables, or a caller that
             # catches the error sweeps with stale projections
             self.graph.refresh()
+        if guard_actors is not None:
+            # full dispatch succeeded: register the write sites (actors
+            # are interned now, so the lane aliases resolve)
+            for actor, r in guard_actors:
+                self._guard_actor_commit(self._actor_guard_keys(var, actor), r)
         if cap_err is not None:
             raise cap_err
 
@@ -1476,6 +1622,30 @@ class ReplicatedRuntime:
         — the population-scale client-view writes of the ad-counter configs
         (``riak_test/lasp_adcounter_test.erl:57-120`` client loop)."""
         states = self._population(var_id)
+        if self.debug_actors:
+            # lane index IS the actor identity on this surface; the
+            # ("lane", idx) spelling aliases to the interned term (if any)
+            # via _actor_guard_keys, so collisions with term-surface
+            # writes (update_at/update_batch) are caught too. Staged like
+            # update_batch's guard: check everything (including same-lane
+            # pairs WITHIN this call), commit only if all pass.
+            var = self.store.variable(var_id)
+            staged: dict = {}
+            for lane, row in zip(
+                np.asarray(lanes).ravel().tolist(),
+                np.asarray(rows).ravel().tolist(),
+            ):
+                for key in self._actor_guard_keys(var, ("lane", int(lane))):
+                    prev = self._actor_sites.get(key, staged.get(key))
+                    if prev is None:
+                        staged[key] = int(row)
+                    elif prev != int(row):
+                        raise ActorCollisionError(
+                            f"seed_increments({var_id!r}): lane {lane} "
+                            f"written from replicas {prev} and {int(row)}"
+                            " — one actor lane, one writing replica"
+                        )
+            self._actor_sites.update(staged)
         by = jnp.broadcast_to(jnp.asarray(by, dtype=states.counts.dtype),
                               jnp.asarray(rows).shape)
         self.states[var_id] = states._replace(
@@ -1808,9 +1978,32 @@ class ReplicatedRuntime:
             )
             yield self
         finally:
-            self._triggers = [(b(), touch, b) for _f, touch, b in saved]
+            import sys
+
+            # rebuild per-builder so one failing builder cannot take the
+            # rest down; triggers registered INSIDE the window body (now
+            # in self._triggers) are kept, not clobbered
+            rebuilt, failures = [], []
+            for _f, touch, b in saved:
+                try:
+                    rebuilt.append((b(), touch, b))
+                except Exception as exc:  # noqa: BLE001 — reported below
+                    failures.append((b, exc))
+            self._triggers = rebuilt + self._triggers
             self._step = None
             self._fused_steps_cache.clear()
+            if failures:
+                # a failed builder's OLD closure holds pre-compaction
+                # indices and must not be restored; the trigger is
+                # dropped, loudly. Don't mask an in-flight body exception.
+                msg = (
+                    "compaction_window: trigger rebuild failed for "
+                    f"{len(failures)} builder(s); those triggers were "
+                    f"DROPPED (first error: {failures[0][1]!r})"
+                )
+                if sys.exc_info()[0] is None:
+                    raise RuntimeError(msg) from failures[0][1]
+                print(f"lasp_tpu: {msg}", file=sys.stderr)
 
     def _to_dense_states(self, var_id: str):
         if var_id in self._packed_specs:
@@ -1876,6 +2069,7 @@ class ReplicatedRuntime:
         self.n_replicas = new_n
         self.neighbors = jnp.asarray(new_neighbors)
         self._shift_offsets = shift_offsets(new_neighbors, new_n)
+        self._actor_sites.clear()  # row indices moved; the guard restarts
         self._step = None
         self._fused_steps_cache.clear()
 

@@ -104,6 +104,45 @@ def test_window_restores_triggers_on_body_error():
     assert rt.coverage_value(flag) == {"raised"}
 
 
+def test_window_keeps_other_triggers_when_one_builder_fails():
+    store = Store(n_actors=2)
+    s = store.declare(id="s", type="lasp_orset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    calls = {"good": 0, "bad": 0}
+
+    def good_builder():
+        calls["good"] += 1
+        return lambda dense: {}
+
+    flaky = {"armed": False}
+
+    def bad_builder():
+        calls["bad"] += 1
+        if flaky["armed"]:
+            raise RuntimeError("re-intern failed")
+        return lambda dense: {}
+
+    rt.register_trigger(builder=good_builder, touches=[s])
+    rt.register_trigger(builder=bad_builder, touches=[s])
+    flaky["armed"] = True
+    with pytest.raises(RuntimeError, match="DROPPED"):
+        with rt.compaction_window():
+            pass
+    # the good trigger survived the bad builder; the bad one was dropped
+    assert len(rt._triggers) == 1
+    assert calls["good"] == 2  # registration + rebuild
+
+
+def test_window_keeps_triggers_registered_inside_body():
+    store = Store(n_actors=2)
+    s = store.declare(id="s", type="lasp_orset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    rt.register_trigger(builder=lambda: (lambda dense: {}), touches=[s])
+    with rt.compaction_window() as w:
+        w.register_trigger(builder=lambda: (lambda dense: {}), touches=[s])
+    assert len(rt._triggers) == 2
+
+
 def test_register_trigger_rejects_fn_and_builder_together():
     store = Store(n_actors=2)
     store.declare(id="s", type="lasp_orset", n_elems=4)
