@@ -47,6 +47,25 @@ from .topology import shift_offsets
 _PACKABLE = ("lasp_orset", "lasp_orset_gbtree")
 
 
+def _device_expressible(state) -> bool:
+    """Can this threshold state ride as a traced operand of the
+    device-parked wait? Every codec threshold (lattice states, numeric
+    counter bounds) is; a host-only payload (object-dtype leaf) is not
+    and falls back to the host-probed loop."""
+    try:
+        for leaf in jax.tree_util.tree_leaves(state):
+            # .dtype reads metadata only; np.asarray on a device array
+            # would pull it host-side just to learn its dtype
+            dt = getattr(leaf, "dtype", None)
+            if dt is None:
+                dt = np.asarray(leaf).dtype  # plain Python leaf
+            if dt == object:
+                return False
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
 class ActorCollisionError(RuntimeError):
     """Two replica rows minted per-actor lane events under one actor
     (raised only under the opt-in ``debug_actors`` guard). The riak_dt
@@ -424,15 +443,17 @@ class ReplicatedRuntime:
         )
         row = self._to_dense_row(var_id, wire_row)
         candidate = self.store._apply_op(var, row, op, actor)
-        if guard_keys is not None:
-            # the apply interned the actor, so re-derive keys to pick up
-            # the ("lane", idx) alias, then register the site
-            self._guard_actor_commit(
-                self._actor_guard_keys(var, actor), replica
-            )
         merged = var.codec.merge(var.spec, row, candidate)
         if bool(var.codec.is_inflation(var.spec, row, merged)):
             new_row = self._from_dense_row(var_id, merged)
+            if guard_keys is not None:
+                # commit only now: the write applied AND inflated (a
+                # bind-rule-ignored write minted nothing that survives),
+                # and the apply interned the actor, so re-deriving keys
+                # picks up the ("lane", idx) alias
+                self._guard_actor_commit(
+                    self._actor_guard_keys(var, actor), replica
+                )
         else:
             new_row = wire_row  # non-inflation silently ignored (bind rule)
         self.states[var_id] = jax.tree_util.tree_map(
@@ -522,11 +543,18 @@ class ReplicatedRuntime:
             # terms must still fold into the edge tables, or a caller that
             # catches the error sweeps with stale projections
             self.graph.refresh()
-        if guard_actors is not None:
-            # full dispatch succeeded: register the write sites (actors
-            # are interned now, so the lane aliases resolve)
-            for actor, r in guard_actors:
-                self._guard_actor_commit(self._actor_guard_keys(var, actor), r)
+            if guard_actors is not None:
+                # register the checked prefix's write sites even when the
+                # dispatch failed mid-batch: the ops before the failure
+                # PERSISTED (they minted lane events), and missing them
+                # would let a later cross-replica write corrupt silently.
+                # The cost is a possible phantom site for prefix ops after
+                # the failing one — the guard errs toward a false
+                # collision error, never a silent miss.
+                for actor, r in guard_actors:
+                    self._guard_actor_commit(
+                        self._actor_guard_keys(var, actor), r
+                    )
         if cap_err is not None:
             raise cap_err
 
@@ -1645,12 +1673,17 @@ class ReplicatedRuntime:
                             f"written from replicas {prev} and {int(row)}"
                             " — one actor lane, one writing replica"
                         )
-            self._actor_sites.update(staged)
+        else:
+            staged = None
         by = jnp.broadcast_to(jnp.asarray(by, dtype=states.counts.dtype),
                               jnp.asarray(rows).shape)
         self.states[var_id] = states._replace(
             counts=states.counts.at[jnp.asarray(rows), jnp.asarray(lanes)].add(by)
         )
+        if staged:
+            # register AFTER the scatter: a shape error above must not
+            # leave phantom sites for rows that were never written
+            self._actor_sites.update(staged)
 
     # -- reads ----------------------------------------------------------------
     def _population(self, var_id: str):
@@ -1723,28 +1756,40 @@ class ReplicatedRuntime:
 
     def read_until(self, replica: int, var_id: str, threshold=None,
                    max_rounds: int = 10_000, edge_mask=None, block: int = 1,
-                   on_device: bool = False):
+                   on_device: "bool | None" = None):
         """Blocking monotonic threshold read (``lasp:read/2`` semantics,
         ``src/lasp_core.erl:329-364``): steps the mesh until the threshold
         is met at the given replica, then returns that replica's state.
         The reference parks a process and wakes it on write; here the
-        bulk-synchronous loop IS the scheduler. ``block > 1`` runs the
-        rounds in fused dispatches between threshold checks (the wake-up
-        granularity coarsens to the block — thresholds are monotonic, so
-        overshooting rounds never unmeets one). Once the population
-        quiesces with the threshold still unmet, it can never be met (no
-        client ops land inside this loop), so the wait fails fast instead
-        of burning the remaining round budget.
+        bulk-synchronous loop IS the scheduler.
 
-        ``on_device=True`` parks the WHOLE wait on the chip: a
-        ``lax.while_loop`` whose condition re-evaluates the threshold
-        predicate at the replica's row every round and also exits on
-        quiescence or the budget — one dispatch, zero host syncs, and the
-        loop stops on exactly the round that meets the threshold (the
-        "wakes exactly when met" contract of the parked reader,
-        ``src/lasp_core.erl:352-364``, as device control flow). Replica
-        index, budget, and the threshold state ride as traced operands,
-        so one compiled executable serves every wait on the variable."""
+        ``on_device`` (default ``None`` = auto) picks the wait engine:
+
+        - **device-parked** (the default whenever the threshold state is
+          device-expressible, which every codec threshold is): a
+          ``lax.while_loop`` whose condition re-evaluates the threshold
+          predicate at the replica's row every round and also exits on
+          quiescence or the budget — ONE dispatch, zero host syncs, zero
+          per-probe row pulls (at wide packed rows the host path's
+          per-probe unpack + device->host row transfer dominates the
+          wait), stopping on exactly the round that meets the threshold
+          (the "wakes exactly when met" contract of the parked reader,
+          ``src/lasp_core.erl:352-364``, as device control flow). Replica
+          index, budget, and the threshold state ride as traced operands,
+          so one compiled executable serves every wait on the variable.
+        - **host-probed** (``on_device=False``, or auto-fallback for a
+          threshold whose state the device cannot trace): rounds run in
+          fused blocks of ``block`` between host probes (the wake-up
+          granularity coarsens to the block — thresholds are monotonic,
+          so overshooting rounds never unmeets one).
+
+        Either way, once the population quiesces with the threshold still
+        unmet it can never be met (no client ops land inside this loop),
+        so the wait fails fast instead of burning the round budget."""
+        if on_device is None:
+            var = self.store.variable(var_id)
+            thr = self.store._resolve_threshold(var, threshold)
+            on_device = _device_expressible(thr.state)
         if on_device:
             return self._read_until_on_device(
                 replica, var_id, threshold, max_rounds, edge_mask
@@ -1972,11 +2017,13 @@ class ReplicatedRuntime:
         self._triggers = []
         self._step = None
         self._fused_steps_cache.clear()
+        body_ok = False
         try:
             self.run_to_convergence(
                 max_rounds=max_rounds, edge_mask=edge_mask, block=block
             )
             yield self
+            body_ok = True
         finally:
             import sys
 
@@ -1995,13 +2042,16 @@ class ReplicatedRuntime:
             if failures:
                 # a failed builder's OLD closure holds pre-compaction
                 # indices and must not be restored; the trigger is
-                # dropped, loudly. Don't mask an in-flight body exception.
+                # dropped, loudly. The explicit body_ok flag (NOT
+                # sys.exc_info, which also sees exceptions merely being
+                # HANDLED in a caller's frame) decides whether raising
+                # here would mask the body's own propagating exception.
                 msg = (
                     "compaction_window: trigger rebuild failed for "
                     f"{len(failures)} builder(s); those triggers were "
                     f"DROPPED (first error: {failures[0][1]!r})"
                 )
-                if sys.exc_info()[0] is None:
+                if body_ok:
                     raise RuntimeError(msg) from failures[0][1]
                 print(f"lasp_tpu: {msg}", file=sys.stderr)
 

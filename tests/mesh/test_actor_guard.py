@@ -153,6 +153,32 @@ def test_partial_batch_failure_registers_no_phantom_sites():
     assert rt._actor_sites.get(("s", "w0")) == 0
 
 
+def test_precondition_failure_still_registers_persisted_prefix():
+    # _orswot_batch persists ops before a PreconditionError; their minted
+    # lane events MUST register, or a later cross-replica write under the
+    # same actor would pass the guard and corrupt silently (the guard
+    # errs toward false collisions, never silent misses)
+    from lasp_tpu.store import PreconditionError
+
+    rt, s = _rt()
+    with pytest.raises(PreconditionError):
+        rt.update_batch(s, [
+            (0, ("add", "x"), "w"),
+            (1, ("remove", "missing"), "a"),  # fails; the add persisted
+        ])
+    assert rt.replica_value(s, 0) == {"x"}  # the prefix really applied
+    with pytest.raises(ActorCollisionError):
+        rt.update_at(2, s, ("add", "y"), "w")
+
+
+def test_seed_increments_shape_error_leaves_no_phantom_sites():
+    rt, c = _rt("riak_dt_gcounter")
+    with pytest.raises(Exception):
+        rt.seed_increments(c, [0, 1], [0, 1], by=[[1, 2, 3]])  # bad shape
+    assert not rt._actor_sites  # nothing was written, nothing registered
+    rt.seed_increments(c, [3], [0])  # lane 0 free to home anywhere
+
+
 def test_resize_resets_registry():
     rt, s = _rt()
     rt.update_at(0, s, ("add", "x"), "w")

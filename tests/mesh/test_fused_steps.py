@@ -225,6 +225,63 @@ def test_compact_refuses_trigger_touched_variable():
         rt.compact_orset("s")
 
 
+def test_read_until_auto_defaults_to_device_parked(monkeypatch):
+    # VERDICT r3 ask #9: the default wait does ZERO per-probe row pulls —
+    # read_at (the host probe that unpacks + pulls a row) runs exactly
+    # once, for the final met-row return, not once per round. Wide packed
+    # rows make the per-probe pull the dominant cost of the host path.
+    from lasp_tpu.lattice import Threshold
+
+    def build():
+        store = Store(n_actors=4)
+        s = store.declare(id="w", type="lasp_orset", n_elems=64,
+                          tokens_per_actor=4)
+        rt = ReplicatedRuntime(store, Graph(store), 32, ring(32, 2),
+                               packed=True)
+        rt.update_at(0, s, ("add", "seed"), "a0")
+        # threshold = replica 0's seeded row (dense): unmet anywhere else
+        # until gossip carries it over
+        return rt, s, rt.read_at(0, s)
+
+    calls = {"n": 0}
+    orig = ReplicatedRuntime.read_at
+
+    def counting_read_at(self, *a, **kw):
+        calls["n"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(ReplicatedRuntime, "read_at", counting_read_at)
+    # replica 16 is ~8 ring hops away: many rounds pass before the wait
+    # completes, but the host probe still runs exactly once
+    rt, s, want = build()
+    calls["n"] = 0  # build() itself probed row 0 for the threshold
+    row = rt.read_until(16, s, Threshold(want), max_rounds=64)
+    assert row is not None
+    assert calls["n"] == 1
+
+    # explicit opt-out still host-probes (one probe per round)
+    rt2, s2, want2 = build()
+    calls["n"] = 0
+    row = rt2.read_until(16, s2, Threshold(want2), max_rounds=64,
+                         on_device=False)
+    assert row is not None
+    assert calls["n"] > 2
+
+
+def test_read_until_auto_falls_back_for_host_only_threshold():
+    # an object-dtype threshold leaf cannot ride as a traced operand;
+    # auto must pick the host loop (which the codec also cannot compare —
+    # asserting the ROUTING, with a threshold the device check rejects)
+    import numpy as np
+
+    from lasp_tpu.mesh.runtime import _device_expressible
+
+    assert _device_expressible(5)
+    assert _device_expressible((np.zeros(3), np.ones((2, 2), bool)))
+    assert not _device_expressible(np.array([object()], dtype=object))
+    assert not _device_expressible({"not", "arrayable"})
+
+
 def test_read_until_fused_blocks():
     from lasp_tpu.dataflow import Graph
     from lasp_tpu.lattice import Threshold
