@@ -658,6 +658,73 @@ def packed_vs_dense(n_replicas: int = 1 << 20, blocks: int = 4, block: int = 8) 
     }
 
 
+def bridge_throughput(n_ops: int = 1500) -> dict:
+    """ETF codec + loopback bridge throughput — the north-star
+    integration's hot path (SURVEY.md §7 stage 6): a BEAM node delegating
+    its ``lasp_backend`` behaviour pays one ETF decode + dispatch + ETF
+    encode per op, and bulk anti-entropy pays it per ``merge_batch``
+    frame. Reports raw codec rates on representative frames (a small
+    client op; a 16-store OR-Set merge_batch) and end-to-end loopback
+    round-trips/s, plus which codec implementation served them
+    (``etf_impl``) — the measured gate for the native C codec."""
+    from .bridge import BridgeClient, BridgeServer, etf
+    from .bridge.etf import Atom
+
+    op_frame = (Atom("update"), b"counter", (Atom("increment"), 5), b"w0")
+    orset_state = [
+        (f"elem{i}".encode(), [(t, t % 3 == 0) for t in range(8)])
+        for i in range(32)
+    ]
+    caps = {Atom("n_elems"): 64, Atom("n_actors"): 4,
+            Atom("tokens_per_actor"): 16}
+    batch_frame = (
+        Atom("merge_batch"),
+        [(f"s{i}".encode(), (Atom("lasp_orset"), orset_state, caps))
+         for i in range(16)],
+    )
+
+    codec = {}
+    for name, frame in (("small_op", op_frame),
+                        ("merge_batch_16x32elem", batch_frame)):
+        raw = etf.encode(frame)
+        reps = max(100, min(20_000, 4_000_000 // max(1, len(raw))))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            etf.encode(frame)
+        enc_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            etf.decode(raw)
+        dec_s = time.perf_counter() - t0
+        codec[name] = {
+            "frame_bytes": len(raw),
+            "encodes_per_s": round(reps / enc_s, 1),
+            "decodes_per_s": round(reps / dec_s, 1),
+            "decode_MBps": round(len(raw) * reps / dec_s / 1e6, 1),
+        }
+
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("bench")
+            c.declare(b"counter", "riak_dt_gcounter", n_actors=8)
+            ok, _ = c.update(b"counter", (Atom("increment"),), b"w0")
+            assert ok == Atom("ok")
+            t0 = time.perf_counter()
+            for _ in range(n_ops):
+                c.update(b"counter", (Atom("increment"),), b"w0")
+            loop_s = time.perf_counter() - t0
+            _ok, total = c.read(b"counter")
+            assert total == n_ops + 1
+
+    return {
+        "scenario": f"bridge_throughput_{n_ops}",
+        "etf_impl": etf.IMPL,
+        "codec": codec,
+        "loopback_roundtrips_per_s": round(n_ops / loop_s, 1),
+        "check": "counter total == ops sent",
+    }
+
+
 SCENARIOS = {
     "adcounter_6": adcounter_6,
     "gset_1k": gset_1k,
@@ -665,4 +732,5 @@ SCENARIOS = {
     "pipeline_1m": pipeline_1m,
     "adcounter_10m": adcounter_10m,
     "packed_vs_dense": packed_vs_dense,
+    "bridge_throughput": bridge_throughput,
 }

@@ -19,6 +19,11 @@ from __future__ import annotations
 import struct
 from typing import Any
 
+#: which codec implementation is active ("python" | "native"); the
+#: native C extension (native/laspetf.cpp) swaps the module-level
+#: encode/decode when it loads — see _try_native() at the bottom
+IMPL = "python"
+
 _VERSION = 131
 _NEW_FLOAT = 70
 _SMALL_INT = 97
@@ -136,13 +141,20 @@ def _enc(t: Any, out: bytearray) -> None:
         raise TypeError(f"cannot encode {type(t).__name__} as ETF: {t!r}")
 
 
+#: decode nesting bound — the SAME constant as the native codec's
+#: MAX_DEPTH (native/laspetf.cpp), so both codecs accept the identical
+#: wire language; without it a hostile deeply-nested frame would escape
+#: as RecursionError past the server's ETFDecodeError handler
+_MAX_DEPTH = 512
+
+
 def decode(data: bytes) -> Any:
     """``term_to_binary`` bytes -> Python term."""
     if not data or data[0] != _VERSION:
         raise ETFDecodeError("missing ETF version byte")
     try:
         term, off = _dec(data, 1)
-    except (struct.error, IndexError, UnicodeDecodeError) as e:
+    except (struct.error, IndexError, UnicodeDecodeError, RecursionError) as e:
         # malformed frames must surface as ETFDecodeError, never leak the
         # parser's internal exceptions (the server's error-term contract)
         raise ETFDecodeError(f"malformed term: {e}") from e
@@ -151,7 +163,9 @@ def decode(data: bytes) -> Any:
     return term
 
 
-def _dec(b: bytes, off: int):
+def _dec(b: bytes, off: int, depth: int = 0):
+    if depth > _MAX_DEPTH:
+        raise ETFDecodeError("term nesting too deep")
     try:
         tag = b[off]
     except IndexError as e:
@@ -206,9 +220,9 @@ def _dec(b: bytes, off: int):
         off += 4
         items = []
         for _ in range(n):
-            x, off = _dec(b, off)
+            x, off = _dec(b, off, depth + 1)
             items.append(x)
-        tail, off = _dec(b, off)
+        tail, off = _dec(b, off, depth + 1)
         if tail != []:
             raise ETFDecodeError("improper list")
         return items, off
@@ -220,7 +234,7 @@ def _dec(b: bytes, off: int):
             off += 4
         items = []
         for _ in range(n):
-            x, off = _dec(b, off)
+            x, off = _dec(b, off, depth + 1)
             items.append(x)
         return tuple(items), off
     if tag == _MAP:
@@ -228,8 +242,104 @@ def _dec(b: bytes, off: int):
         off += 4
         d = {}
         for _ in range(n):
-            k, off = _dec(b, off)
-            v, off = _dec(b, off)
+            k, off = _dec(b, off, depth + 1)
+            v, off = _dec(b, off, depth + 1)
             d[k] = v
         return d, off
     raise ETFDecodeError(f"unsupported ETF tag {tag}")
+
+
+# -- native codec (BEAM does ETF in C; so does this bridge) ------------------
+
+#: the Python implementations stay importable under these names whatever
+#: codec is active — the conformance tests cross-check native against them
+py_encode = encode
+py_decode = decode
+
+#: the loaded C extension module when IMPL == "native", else None
+native_module = None
+
+#: self-check corpus: one term per wire shape the protocol uses. The
+#: native codec ships ONLY if it byte-matches the Python encoder and
+#: round-trips identically on every entry — a mismatch silently falls
+#: back to Python (the bridge must keep speaking correct ETF even if the
+#: .so is stale or miscompiled).
+_SELFCHECK = [
+    Atom("ok"),
+    (Atom("error"), Atom("badarg"), b"detail"),
+    None, True, False,
+    0, 255, 256, -1, -(1 << 31), (1 << 31) - 1,
+    (1 << 31), -(1 << 31) - 1, (1 << 62), 1 << 80, -(1 << 80),
+    3.14159, -0.0,
+    b"", b"bytes", "a str crosses as binary", "é中",
+    [], [1, [2, [3, []]], (4, 5)], list(range(300)),
+    (), (1,), tuple(range(300)),
+    {Atom("n_elems"): 64, b"k": [1, 2]},
+    [(b"elem0", [(0, False), (1, True)]), (b"elem1", [])],
+    Atom("a" * 300),  # ATOM_UTF8 (2-byte length) path
+]
+
+
+def _try_native() -> None:
+    global IMPL, encode, decode
+    import importlib.machinery
+    import importlib.util
+    import os
+
+    # exact vocabulary of LaspConfig.etf ("auto" | "python", case-
+    # sensitive): any other value is left for get_config() to reject
+    # loudly rather than being guessed at here
+    if os.environ.get("LASP_ETF") == "python":
+        return
+    so = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..", "native",
+        "lasp_etf.so",
+    )
+    if not os.path.exists(so):
+        return
+    try:
+        loader = importlib.machinery.ExtensionFileLoader("lasp_etf", so)
+        spec = importlib.util.spec_from_loader("lasp_etf", loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        mod.set_classes(Atom, ETFDecodeError)
+        for term in _SELFCHECK:
+            raw = py_encode(term)
+            if mod.encode(term) != raw:
+                return
+            # type-exact comparison: the atom/binary/str distinction (and
+            # bool vs int) must survive, which plain == would conflate
+            if _type_shape(mod.decode(raw)) != _type_shape(py_decode(raw)):
+                return
+        # malformed input must raise the codec's error type, not segfault
+        # or leak a foreign exception
+        for bad in (b"", b"\x00", b"\x83", b"\x83\x6a\x6a", b"\x83\xff",
+                    b"\x83\x6c\xff\xff\xff\xff\x6a"):
+            try:
+                mod.decode(bad)
+                return  # accepted garbage: do not ship
+            except ETFDecodeError:
+                pass
+    except Exception:
+        return
+    global native_module
+    native_module = mod
+    encode, decode = mod.encode, mod.decode
+    IMPL = "native"
+
+
+def _type_shape(t):
+    if isinstance(t, Atom):
+        return ("atom", str(t))
+    if isinstance(t, tuple):
+        return ("t",) + tuple(_type_shape(x) for x in t)
+    if isinstance(t, list):
+        return ("l",) + tuple(_type_shape(x) for x in t)
+    if isinstance(t, dict):
+        return ("m",) + tuple(
+            (_type_shape(k), _type_shape(v)) for k, v in t.items()
+        )
+    return (type(t).__name__, t)
+
+
+_try_native()

@@ -45,6 +45,11 @@ class LaspConfig:
     #: extent of the tensor-parallel "state" axis in build_mesh
     mesh_state_axis: int = 1
 
+    # -- bridge -------------------------------------------------------------
+    #: wire codec selection: auto (native .so when present AND it passes
+    #: the byte-conformance self-check, else python) | python (forced)
+    etf: str = "auto"
+
     @classmethod
     def field_env_name(cls, field_name: str) -> str:
         return f"LASP_{field_name.upper()}"
@@ -88,6 +93,8 @@ class LaspConfig:
     def validate(self) -> "LaspConfig":
         if self.gossip_impl not in ("auto", "xla", "pallas"):
             raise ValueError(f"gossip_impl: {self.gossip_impl!r}")
+        if self.etf not in ("auto", "python"):
+            raise ValueError(f"etf: {self.etf!r} (auto | python)")
         for name in ("n_actors", "fanout", "fused_block", "mesh_state_axis",
                      "bench_block"):
             if getattr(self, name) < 1:

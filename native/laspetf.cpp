@@ -1,0 +1,563 @@
+// Native Erlang External Term Format codec for the bridge hot path.
+//
+// The reference's wire codec is BEAM's own term_to_binary/binary_to_term
+// (C, inside the VM); the Python fallback in lasp_tpu/bridge/etf.py is
+// the semantic source of truth. This CPython extension implements the
+// SAME subset byte-for-byte (etf.py gates it behind a corpus self-check
+// at import and falls back to Python on any mismatch):
+//   ints (incl. bignums), floats, atoms (SMALL/UTF8/old-latin1),
+//   binaries, strings(STRING_EXT -> list[int]), lists, tuples, maps.
+//
+// Untrusted input: decode enforces a nesting-depth bound (the Python
+// path is bounded by the interpreter's recursion limit; C recursion
+// must bound itself) and length-checks every read.
+//
+// Build: make -C native  (lasp_etf.so, CPython extension module).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+namespace {
+
+constexpr uint8_t VERSION = 131;
+constexpr uint8_t NEW_FLOAT = 70;
+constexpr uint8_t SMALL_INT = 97;
+constexpr uint8_t INT = 98;
+constexpr uint8_t SMALL_BIG = 110;
+constexpr uint8_t LARGE_BIG = 111;
+constexpr uint8_t ATOM_UTF8 = 118;
+constexpr uint8_t SMALL_ATOM_UTF8 = 119;
+constexpr uint8_t ATOM_OLD = 100;  // ATOM_EXT, latin-1
+constexpr uint8_t BINARY = 109;
+constexpr uint8_t STRING = 107;
+constexpr uint8_t LIST = 108;
+constexpr uint8_t NIL = 106;
+constexpr uint8_t SMALL_TUPLE = 104;
+constexpr uint8_t LARGE_TUPLE = 105;
+constexpr uint8_t MAP = 116;
+
+constexpr int MAX_DEPTH = 512;
+
+// set_classes() installs these from the Python module
+PyObject *g_atom_cls = nullptr;
+PyObject *g_err_cls = nullptr;
+
+void set_decode_error(const char *msg) {
+  PyErr_SetString(g_err_cls ? g_err_cls : PyExc_ValueError, msg);
+}
+
+// ---------------------------------------------------------------- encode
+
+struct Buf {
+  char *data = nullptr;
+  Py_ssize_t len = 0, cap = 0;
+  ~Buf() { PyMem_Free(data); }
+  bool reserve(Py_ssize_t extra) {
+    if (len + extra <= cap) return true;
+    Py_ssize_t ncap = cap ? cap : 256;
+    while (ncap < len + extra) ncap *= 2;
+    char *nd = static_cast<char *>(PyMem_Realloc(data, ncap));
+    if (!nd) {
+      PyErr_NoMemory();
+      return false;
+    }
+    data = nd;
+    cap = ncap;
+    return true;
+  }
+  bool put(const void *src, Py_ssize_t n) {
+    if (!reserve(n)) return false;
+    std::memcpy(data + len, src, n);
+    len += n;
+    return true;
+  }
+  bool u8(uint8_t v) { return put(&v, 1); }
+  bool u16be(uint16_t v) {
+    uint8_t b[2] = {uint8_t(v >> 8), uint8_t(v)};
+    return put(b, 2);
+  }
+  bool u32be(uint32_t v) {
+    uint8_t b[4] = {uint8_t(v >> 24), uint8_t(v >> 16), uint8_t(v >> 8),
+                    uint8_t(v)};
+    return put(b, 4);
+  }
+  bool u64be(uint64_t v) {
+    uint8_t b[8];
+    for (int i = 0; i < 8; i++) b[i] = uint8_t(v >> (56 - 8 * i));
+    return put(b, 8);
+  }
+};
+
+bool enc(PyObject *t, Buf &out, int depth);
+
+bool enc_atom_bytes(const char *raw, Py_ssize_t n, Buf &out) {
+  if (n < 256) {
+    if (!out.u8(SMALL_ATOM_UTF8) || !out.u8(uint8_t(n))) return false;
+  } else {
+    if (n > 0xFFFF) {
+      PyErr_SetString(PyExc_TypeError, "atom too long for ETF");
+      return false;
+    }
+    if (!out.u8(ATOM_UTF8) || !out.u16be(uint16_t(n))) return false;
+  }
+  return out.put(raw, n);
+}
+
+bool enc_bignum(PyObject *t, Buf &out) {
+  // arbitrary-precision path: mirror the Python encoder exactly via the
+  // int's own bit_length/to_bytes (rare on the hot path)
+  PyObject *zero = PyLong_FromLong(0);
+  if (!zero) return false;
+  int sign = PyObject_RichCompareBool(t, zero, Py_LT);
+  Py_DECREF(zero);
+  if (sign < 0) return false;
+  PyObject *mag = sign ? PyNumber_Negative(t) : Py_NewRef(t);
+  if (!mag) return false;
+  PyObject *bl = PyObject_CallMethod(mag, "bit_length", nullptr);
+  if (!bl) {
+    Py_DECREF(mag);
+    return false;
+  }
+  long nbits = PyLong_AsLong(bl);
+  Py_DECREF(bl);
+  Py_ssize_t nbytes = (nbits + 7) / 8;
+  PyObject *raw =
+      PyObject_CallMethod(mag, "to_bytes", "ns", nbytes, "little");
+  Py_DECREF(mag);
+  if (!raw) return false;
+  bool ok;
+  if (nbytes < 256) {
+    ok = out.u8(SMALL_BIG) && out.u8(uint8_t(nbytes));
+  } else {
+    ok = out.u8(LARGE_BIG) && out.u32be(uint32_t(nbytes));
+  }
+  ok = ok && out.u8(uint8_t(sign)) &&
+       out.put(PyBytes_AS_STRING(raw), PyBytes_GET_SIZE(raw));
+  Py_DECREF(raw);
+  return ok;
+}
+
+bool enc(PyObject *t, Buf &out, int depth) {
+  if (depth > MAX_DEPTH) {
+    PyErr_SetString(PyExc_TypeError, "ETF term nesting too deep");
+    return false;
+  }
+  // Atom BEFORE str (Atom subclasses str); bool BEFORE int
+  if (g_atom_cls && PyObject_TypeCheck(
+                        t, reinterpret_cast<PyTypeObject *>(g_atom_cls))) {
+    Py_ssize_t n;
+    const char *raw = PyUnicode_AsUTF8AndSize(t, &n);
+    if (!raw) return false;
+    return enc_atom_bytes(raw, n, out);
+  }
+  if (PyBool_Check(t)) {
+    const char *name = (t == Py_True) ? "true" : "false";
+    return enc_atom_bytes(name, std::strlen(name), out);
+  }
+  if (t == Py_None) {
+    return enc_atom_bytes("undefined", 9, out);
+  }
+  if (PyLong_Check(t)) {
+    int overflow = 0;
+    long long v = PyLong_AsLongLongAndOverflow(t, &overflow);
+    if (!overflow) {
+      if (0 <= v && v <= 255) {
+        return out.u8(SMALL_INT) && out.u8(uint8_t(v));
+      }
+      if (-(1LL << 31) <= v && v < (1LL << 31)) {
+        return out.u8(INT) && out.u32be(uint32_t(int32_t(v)));
+      }
+      // fits int64 but not INT_EXT: still the bignum wire format
+      int sign = v < 0;
+      uint64_t mag = sign ? uint64_t(-(v + 1)) + 1 : uint64_t(v);
+      int nbytes = 0;
+      for (uint64_t m = mag; m; m >>= 8) nbytes++;
+      if (!out.u8(SMALL_BIG) || !out.u8(uint8_t(nbytes)) ||
+          !out.u8(uint8_t(sign)))
+        return false;
+      for (int i = 0; i < nbytes; i++) {
+        if (!out.u8(uint8_t(mag >> (8 * i)))) return false;
+      }
+      return true;
+    }
+    return enc_bignum(t, out);
+  }
+  if (PyFloat_Check(t)) {
+    double d = PyFloat_AS_DOUBLE(t);
+    uint64_t bits;
+    std::memcpy(&bits, &d, 8);
+    return out.u8(NEW_FLOAT) && out.u64be(bits);
+  }
+  if (PyBytes_Check(t)) {
+    Py_ssize_t n = PyBytes_GET_SIZE(t);
+    return out.u8(BINARY) && out.u32be(uint32_t(n)) &&
+           out.put(PyBytes_AS_STRING(t), n);
+  }
+  if (PyByteArray_Check(t)) {
+    Py_ssize_t n = PyByteArray_GET_SIZE(t);
+    return out.u8(BINARY) && out.u32be(uint32_t(n)) &&
+           out.put(PyByteArray_AS_STRING(t), n);
+  }
+  if (PyUnicode_Check(t)) {  // plain str crosses as a binary
+    Py_ssize_t n;
+    const char *raw = PyUnicode_AsUTF8AndSize(t, &n);
+    if (!raw) return false;
+    return out.u8(BINARY) && out.u32be(uint32_t(n)) && out.put(raw, n);
+  }
+  if (PyTuple_Check(t)) {
+    Py_ssize_t n = PyTuple_GET_SIZE(t);
+    if (n < 256) {
+      if (!out.u8(SMALL_TUPLE) || !out.u8(uint8_t(n))) return false;
+    } else {
+      if (!out.u8(LARGE_TUPLE) || !out.u32be(uint32_t(n))) return false;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (!enc(PyTuple_GET_ITEM(t, i), out, depth + 1)) return false;
+    }
+    return true;
+  }
+  if (PyList_Check(t)) {
+    Py_ssize_t n = PyList_GET_SIZE(t);
+    if (n == 0) return out.u8(NIL);
+    if (!out.u8(LIST) || !out.u32be(uint32_t(n))) return false;
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (!enc(PyList_GET_ITEM(t, i), out, depth + 1)) return false;
+    }
+    return out.u8(NIL);
+  }
+  if (PyDict_Check(t)) {
+    Py_ssize_t n = PyDict_Size(t);
+    if (!out.u8(MAP) || !out.u32be(uint32_t(n))) return false;
+    PyObject *k, *v;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(t, &pos, &k, &v)) {
+      if (!enc(k, out, depth + 1) || !enc(v, out, depth + 1)) return false;
+    }
+    return true;
+  }
+  PyErr_Format(PyExc_TypeError, "cannot encode %s as ETF",
+               Py_TYPE(t)->tp_name);
+  return false;
+}
+
+PyObject *py_encode(PyObject *, PyObject *arg) {
+  Buf out;
+  if (!out.u8(VERSION)) return nullptr;
+  if (!enc(arg, out, 0)) return nullptr;
+  return PyBytes_FromStringAndSize(out.data, out.len);
+}
+
+// ---------------------------------------------------------------- decode
+
+struct Reader {
+  const uint8_t *b;
+  Py_ssize_t len, off = 0;
+  bool need(Py_ssize_t n) {
+    if (off + n > len) {
+      set_decode_error("truncated term");
+      return false;
+    }
+    return true;
+  }
+  bool u8(uint8_t *v) {
+    if (!need(1)) return false;
+    *v = b[off++];
+    return true;
+  }
+  bool u16be(uint32_t *v) {
+    if (!need(2)) return false;
+    *v = (uint32_t(b[off]) << 8) | b[off + 1];
+    off += 2;
+    return true;
+  }
+  bool u32be(uint32_t *v) {
+    if (!need(4)) return false;
+    *v = (uint32_t(b[off]) << 24) | (uint32_t(b[off + 1]) << 16) |
+         (uint32_t(b[off + 2]) << 8) | b[off + 3];
+    off += 4;
+    return true;
+  }
+};
+
+PyObject *dec(Reader &r, int depth);
+
+PyObject *make_atom(const char *raw, Py_ssize_t n, bool latin1) {
+  // the protocol's special atoms decode to Python singletons
+  if (n == 9 && std::memcmp(raw, "undefined", 9) == 0) Py_RETURN_NONE;
+  if (n == 4 && std::memcmp(raw, "true", 4) == 0) Py_RETURN_TRUE;
+  if (n == 5 && std::memcmp(raw, "false", 5) == 0) Py_RETURN_FALSE;
+  PyObject *s = latin1 ? PyUnicode_DecodeLatin1(raw, n, nullptr)
+                       : PyUnicode_DecodeUTF8(raw, n, nullptr);
+  if (!s) {
+    // surface as the codec's error type (etf.py decode() contract)
+    PyErr_Clear();
+    set_decode_error("malformed atom bytes");
+    return nullptr;
+  }
+  PyObject *atom = PyObject_CallFunctionObjArgs(g_atom_cls, s, nullptr);
+  Py_DECREF(s);
+  return atom;
+}
+
+PyObject *dec(Reader &r, int depth) {
+  if (depth > MAX_DEPTH) {
+    set_decode_error("term nesting too deep");
+    return nullptr;
+  }
+  uint8_t tag;
+  if (!r.u8(&tag)) return nullptr;
+  switch (tag) {
+    case SMALL_INT: {
+      uint8_t v;
+      if (!r.u8(&v)) return nullptr;
+      return PyLong_FromLong(v);
+    }
+    case INT: {
+      uint32_t v;
+      if (!r.u32be(&v)) return nullptr;
+      return PyLong_FromLong(int32_t(v));
+    }
+    case SMALL_BIG:
+    case LARGE_BIG: {
+      uint32_t n;
+      if (tag == SMALL_BIG) {
+        uint8_t n8;
+        if (!r.u8(&n8)) return nullptr;
+        n = n8;
+      } else if (!r.u32be(&n)) {
+        return nullptr;
+      }
+      uint8_t sign;
+      if (!r.u8(&sign) || !r.need(n)) return nullptr;
+      const uint8_t *p = r.b + r.off;
+      r.off += n;
+      if (n <= 8) {
+        uint64_t mag = 0;
+        for (uint32_t i = 0; i < n; i++) mag |= uint64_t(p[i]) << (8 * i);
+        if (!sign) return PyLong_FromUnsignedLongLong(mag);
+        if (mag <= uint64_t(INT64_MAX))
+          return PyLong_FromLongLong(-int64_t(mag));
+      }
+      // large magnitude: int.from_bytes(p, "little"), negated if signed
+      PyObject *raw = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char *>(p), n);
+      if (!raw) return nullptr;
+      PyObject *mag = PyObject_CallMethod(
+          reinterpret_cast<PyObject *>(&PyLong_Type), "from_bytes", "Os",
+          raw, "little");
+      Py_DECREF(raw);
+      if (!mag) return nullptr;
+      if (!sign) return mag;
+      PyObject *negv = PyNumber_Negative(mag);
+      Py_DECREF(mag);
+      return negv;
+    }
+    case NEW_FLOAT: {
+      if (!r.need(8)) return nullptr;
+      uint64_t bits = 0;
+      for (int i = 0; i < 8; i++)
+        bits = (bits << 8) | r.b[r.off + i];
+      r.off += 8;
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return PyFloat_FromDouble(d);
+    }
+    case SMALL_ATOM_UTF8:
+    case ATOM_UTF8:
+    case ATOM_OLD: {
+      uint32_t n;
+      if (tag == SMALL_ATOM_UTF8) {
+        uint8_t n8;
+        if (!r.u8(&n8)) return nullptr;
+        n = n8;
+      } else if (!r.u16be(&n)) {
+        return nullptr;
+      }
+      if (!r.need(n)) return nullptr;
+      const char *p = reinterpret_cast<const char *>(r.b + r.off);
+      r.off += n;
+      return make_atom(p, n, tag == ATOM_OLD);
+    }
+    case BINARY: {
+      uint32_t n;
+      if (!r.u32be(&n) || !r.need(n)) return nullptr;
+      PyObject *out = PyBytes_FromStringAndSize(
+          reinterpret_cast<const char *>(r.b + r.off), n);
+      r.off += n;
+      return out;
+    }
+    case STRING: {  // list of bytes, surfaces as list[int]
+      uint32_t n;
+      if (!r.u16be(&n) || !r.need(n)) return nullptr;
+      PyObject *out = PyList_New(n);
+      if (!out) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        PyObject *v = PyLong_FromLong(r.b[r.off + i]);
+        if (!v) {
+          Py_DECREF(out);
+          return nullptr;
+        }
+        PyList_SET_ITEM(out, i, v);
+      }
+      r.off += n;
+      return out;
+    }
+    case NIL:
+      return PyList_New(0);
+    case LIST: {
+      uint32_t n;
+      if (!r.u32be(&n)) return nullptr;
+      // length-check before allocating: a hostile frame must not make
+      // PyList_New reserve gigabytes from a 4-byte claim
+      if (Py_ssize_t(n) > r.len - r.off) {
+        set_decode_error("truncated term");
+        return nullptr;
+      }
+      PyObject *out = PyList_New(n);
+      if (!out) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        PyObject *x = dec(r, depth + 1);
+        if (!x) {
+          Py_DECREF(out);
+          return nullptr;
+        }
+        PyList_SET_ITEM(out, i, x);
+      }
+      uint8_t tail;
+      if (!r.u8(&tail)) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+      if (tail != NIL) {
+        Py_DECREF(out);
+        set_decode_error("improper list");
+        return nullptr;
+      }
+      return out;
+    }
+    case SMALL_TUPLE:
+    case LARGE_TUPLE: {
+      uint32_t n;
+      if (tag == SMALL_TUPLE) {
+        uint8_t n8;
+        if (!r.u8(&n8)) return nullptr;
+        n = n8;
+      } else if (!r.u32be(&n)) {
+        return nullptr;
+      }
+      if (Py_ssize_t(n) > r.len - r.off) {
+        set_decode_error("truncated term");
+        return nullptr;
+      }
+      PyObject *out = PyTuple_New(n);
+      if (!out) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        PyObject *x = dec(r, depth + 1);
+        if (!x) {
+          Py_DECREF(out);
+          return nullptr;
+        }
+        PyTuple_SET_ITEM(out, i, x);
+      }
+      return out;
+    }
+    case MAP: {
+      uint32_t n;
+      if (!r.u32be(&n)) return nullptr;
+      if (Py_ssize_t(n) > (r.len - r.off) / 2 + 1) {
+        set_decode_error("truncated term");
+        return nullptr;
+      }
+      PyObject *out = PyDict_New();
+      if (!out) return nullptr;
+      for (uint32_t i = 0; i < n; i++) {
+        PyObject *k = dec(r, depth + 1);
+        if (!k) {
+          Py_DECREF(out);
+          return nullptr;
+        }
+        PyObject *v = dec(r, depth + 1);
+        if (!v) {
+          Py_DECREF(k);
+          Py_DECREF(out);
+          return nullptr;
+        }
+        int rc = PyDict_SetItem(out, k, v);
+        Py_DECREF(k);
+        Py_DECREF(v);
+        if (rc < 0) {
+          Py_DECREF(out);
+          return nullptr;
+        }
+      }
+      return out;
+    }
+    default: {
+      char msg[64];
+      std::snprintf(msg, sizeof msg, "unsupported ETF tag %u", tag);
+      set_decode_error(msg);
+      return nullptr;
+    }
+  }
+}
+
+PyObject *py_decode(PyObject *, PyObject *arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  Reader r{static_cast<const uint8_t *>(view.buf), view.len};
+  if (r.len == 0 || r.b[0] != VERSION) {
+    PyBuffer_Release(&view);
+    set_decode_error("missing ETF version byte");
+    return nullptr;
+  }
+  r.off = 1;
+  PyObject *out = dec(r, 0);
+  if (out && r.off != r.len) {
+    Py_DECREF(out);
+    char msg[64];
+    std::snprintf(msg, sizeof msg, "trailing bytes after term (%zd)",
+                  r.len - r.off);
+    set_decode_error(msg);
+    out = nullptr;
+  }
+  PyBuffer_Release(&view);
+  return out;
+}
+
+PyObject *py_set_classes(PyObject *, PyObject *args) {
+  PyObject *atom_cls, *err_cls;
+  if (!PyArg_ParseTuple(args, "OO", &atom_cls, &err_cls)) return nullptr;
+  if (!PyType_Check(atom_cls) || !PyType_Check(err_cls)) {
+    PyErr_SetString(PyExc_TypeError, "set_classes expects two classes");
+    return nullptr;
+  }
+  Py_INCREF(atom_cls);
+  Py_INCREF(err_cls);
+  Py_XDECREF(g_atom_cls);
+  Py_XDECREF(g_err_cls);
+  g_atom_cls = atom_cls;
+  g_err_cls = err_cls;
+  Py_RETURN_NONE;
+}
+
+PyMethodDef methods[] = {
+    {"encode", py_encode, METH_O, "Python term -> ETF bytes"},
+    {"decode", py_decode, METH_O, "ETF bytes -> Python term"},
+    {"set_classes", py_set_classes, METH_VARARGS,
+     "install the Atom and ETFDecodeError classes"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "lasp_etf",
+    "Native ETF codec (see lasp_tpu/bridge/etf.py for the contract)",
+    -1, methods, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_lasp_etf(void) { return PyModule_Create(&moduledef); }

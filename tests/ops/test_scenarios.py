@@ -23,6 +23,7 @@ def test_scenario_registry_complete():
         "pipeline_1m",
         "adcounter_10m",
         "packed_vs_dense",
+        "bridge_throughput",
     }
 
 
