@@ -1,0 +1,89 @@
+"""Worker for the two-process jax.distributed smoke test (launched by
+test_multiprocess.py with JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID in the env; 4 virtual CPU devices per process form one
+8-device global mesh — the CPU stand-in for a DCN-spanned pod).
+
+SPMD discipline: every process executes the SAME host program; all math
+on globally-sharded arrays happens inside jit (eager indexing of a
+non-fully-addressable array is illegal), which is exactly how a real
+multi-host deployment drives the engine."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from lasp_tpu.mesh.comm import (  # noqa: E402
+    build_mesh,
+    init_distributed,
+    n_slices,
+)
+
+assert init_distributed(), "env wiring should trigger initialization"
+assert len(jax.devices()) == 8, jax.devices()
+assert len(jax.local_devices()) == 4
+
+# one "slice" per DCN island (= OS process here): the canonical mesh
+# puts that axis outermost so gossip rides the intra-process axis
+mesh = build_mesh(slice_of=lambda d: d.process_index)
+assert mesh.shape == {"slices": 2, "replicas": 4, "state": 1}, mesh.shape
+assert n_slices(slice_of=lambda d: d.process_index) == 2
+
+import jax.numpy as jnp  # noqa: E402
+
+from lasp_tpu.dataflow import Graph  # noqa: E402
+from lasp_tpu.lattice import GCounter  # noqa: E402
+from lasp_tpu.mesh import ReplicatedRuntime, divergence, ring  # noqa: E402
+from lasp_tpu.store import Store  # noqa: E402
+
+R = 64
+store = Store(n_actors=4)
+c = store.declare(id="c", type="riak_dt_gcounter")
+rt = ReplicatedRuntime(store, Graph(store), R, ring(R, 2))
+rt.shard(mesh)  # canonical (slices, replicas) population split
+
+var = store.variable(c)
+
+# seeds land inside jit: rows 0 (slice 0's block) and 37 (slice 1's)
+rt.apply_batch(c, jax.jit(
+    lambda s: s._replace(
+        counts=s.counts.at[0, 0].add(5).at[37, 1].add(2)
+    )
+))
+
+rounds = rt.run_to_convergence(max_rounds=R + 4, block=8)
+assert rounds >= 1
+
+# verification stays jitted (SPMD-safe reductions, replicated scalars)
+div = int(jax.jit(
+    lambda s: divergence(var.codec, var.spec, s)
+)(rt.states[c]))
+assert div == 0, div
+total = int(jax.jit(lambda s: s.counts[13].sum())(rt.states[c]))
+assert total == 7, total
+
+# the explicit-collective ring path works across the process boundary too
+from lasp_tpu.mesh.shard_gossip import ring_gossip_rounds  # noqa: E402
+from lasp_tpu.ops import PackedORSet, PackedORSetSpec  # noqa: E402
+from lasp_tpu.lattice.base import replicate  # noqa: E402
+
+spec = PackedORSetSpec(n_elems=4, n_actors=4, tokens_per_actor=1)
+pop = replicate(PackedORSet.new(spec), R)
+flat = jax.sharding.Mesh(mesh.devices.reshape(-1), ("replicas",))
+pop = jax.tree_util.tree_map(
+    lambda x: jax.device_put(
+        x, jax.sharding.NamedSharding(
+            flat, jax.sharding.PartitionSpec("replicas")
+        )
+    ), pop,
+)
+out, _changed = ring_gossip_rounds(PackedORSet, spec, pop, flat, 1, k=2)
+jax.block_until_ready(jax.tree_util.tree_leaves(out))
+
+print(f"WORKER-OK process={jax.process_index()}", flush=True)
+sys.exit(0)

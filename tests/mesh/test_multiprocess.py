@@ -1,0 +1,62 @@
+"""Two-process jax.distributed smoke test — the multi-host communication
+backend (SURVEY.md §2.5 "disterl role" / VERDICT r3 component #32's "as
+far as verifiable without a pod" caveat) exercised across a REAL process
+boundary: two OS processes × 4 virtual CPU devices join through
+``comm.init_distributed`` into one 8-device global mesh, the canonical
+``build_mesh`` lays slices outermost, and the ENGINE's sharded step runs
+cross-process collectives (Gloo here; ICI/DCN on a pod) to convergence."""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_engine():
+    port = _free_port()
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+    worker = os.path.join(os.path.dirname(__file__), "multiprocess_worker.py")
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PYTHONPATH=repo + os.pathsep + env.get("PYTHONPATH", ""),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, worker],
+            env=env,
+            cwd=repo,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            outs.append((p.returncode, out, err))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        raise
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"worker {i} rc={rc}\nstdout:{out}\nstderr:{err}"
+        assert f"WORKER-OK process={i}" in out, (out, err)
