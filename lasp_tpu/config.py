@@ -71,6 +71,8 @@ class LaspConfig:
             "LASP_BENCH_TOTAL_BUDGET",
             "LASP_BENCH_CHILD_BUDGET",
             "LASP_DRYRUN",
+            "LASP_STATEM",  # test-suite soak depth (tests/lattice)
+            "LASP_WATCH",  # tools/tpu_capture.py watcher knobs
         )
         for key, raw in env.items():
             if not key.startswith("LASP_"):

@@ -28,7 +28,12 @@ from .helpers import decode_gcounter, decode_gset, decode_orset, decode_orswot
 from .models import PyGCounter, PyGSet, PyORSWOT, PyORSet
 
 N_REPLICAS = 5
-N_OPS = 40
+#: ops per sequence; LASP_STATEM_OPS deepens a soak run toward the
+#: reference's EQC scale (1000 random sequences per type,
+#: test/crdt_statem_eqc.erl:34) without slowing every CI pass
+import os as _os  # noqa: E402
+
+N_OPS = int(_os.environ.get("LASP_STATEM_OPS", "40"))
 ELEMS = ["apple", "pear", "plum", "fig", "kiwi", "lime"]
 
 

@@ -172,6 +172,30 @@ def test_execute_during_process_preserves_row_binding():
     assert rt.execute("after") == {"k1"}
 
 
+def test_programs_survive_membership_changes():
+    # register-on-every-partition must hold across joins/leaves: the
+    # accumulator rides the population through resize (new rows at
+    # bottom, caught up by gossip; graceful leave hands state to a
+    # survivor), and delivery/execute keep working
+    rt = _rt(n=8, k=2)
+    rt.register("keylist", ExampleKeylistProgram, n_elems=16)
+    rt.process(("before", 0), "put", "a0", replica=2)
+    rt.resize(12, ring(12, 2))  # join: 4 fresh rows
+    rt.process(("after-grow", 1), "put", "a1", replica=10)  # a new row
+    assert rt.execute("keylist") == {"before", "after-grow"}
+    rt.run_to_convergence(max_rounds=32)
+    rt.resize(6, ring(6, 2), graceful=True)  # leave: survivors keep state
+    assert rt.execute("keylist") == {"before", "after-grow"}
+    rt.process(("after-shrink", 2), "put", "a2", replica=5)
+    rt.run_to_convergence(max_rounds=32)
+    pid = rt._programs["keylist"].id
+    assert rt.divergence(pid) == 0
+    for r in range(6):
+        assert rt.replica_value(pid, r) == {
+            "before", "after-grow", "after-shrink",
+        }
+
+
 def test_quorum_execute_is_monotone_lower_bound():
     rt = _rt(n=12, k=3, topo=random_regular)
     rt.register("keylist", ExampleKeylistProgram, n_elems=8)
