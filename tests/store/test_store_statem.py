@@ -1,0 +1,186 @@
+"""EQC-statem analogue of ``test/lasp_eqc.erl`` — the STORE-semantics
+model (the reference's second EQC suite, distinct from the per-CRDT
+``crdt_statem_eqc``): random declare / update / stale-rebind / threshold-
+read command sequences against a pure-Python model, with
+
+- the bind inflation-gate rule as a postcondition (non-inflations are
+  silently ignored, ``src/lasp_core.erl:305-311`` — exactly
+  ``lasp_eqc``'s ``bind_next``/``bind_ok``, :96-137),
+- data-dependent failures (absent-element removes) leaving the model
+  unchanged,
+- random sub-lattice thresholds (the :195-219 generator role — the
+  reference samples sublists of the current value): parked watches must
+  fire EXACTLY when met, never before, and monotonically stay fired.
+
+Depth scales with LASP_STATEM_OPS like tests/lattice/test_statem.py."""
+
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lasp_tpu.lattice import Threshold
+from lasp_tpu.store import PreconditionError, Store
+
+N_OPS = int(os.environ.get("LASP_STATEM_OPS", "60"))
+ELEMS = ["a", "b", "c", "d", "e", "f", "g", "h"]
+ACTORS = ["w0", "w1", "w2"]
+
+TYPES = ("lasp_gset", "lasp_orset", "riak_dt_gcounter", "lasp_ivar")
+
+
+class Model:
+    """One variable's model state. Sets track live AND ever-added
+    elements: OR-Set threshold semantics are token-coverage, and a
+    tombstoned token still counts as observed — so a set threshold,
+    once met, stays met across removes."""
+
+    def __init__(self, tname):
+        self.tname = tname
+        self.live: set = set()
+        self.ever: set = set()
+        self.counts: dict = {}
+        self.payload = None
+
+    def value(self):
+        if self.tname == "riak_dt_gcounter":
+            return sum(self.counts.values())
+        if self.tname == "lasp_ivar":
+            return self.payload
+        return frozenset(self.live)
+
+
+def met(model: Model, thr) -> bool:
+    kind, arg, strict = thr
+    if kind == "count":
+        total = sum(model.counts.values())
+        return total > arg if strict else total >= arg
+    if kind == "defined":
+        return model.payload is not None
+    # kind == "subset": token coverage over ever-observed elements
+    return set(arg) <= model.ever
+
+
+def subset_threshold_state(store, vid, subset):
+    """Threshold state = the variable's CURRENT state with every element
+    row outside ``subset`` zeroed — a random sub-lattice point, like the
+    reference's random sublists of Value0 (:205-218)."""
+    var = store.variable(vid)
+    idx = [var.elems.index_of(e) for e in subset]
+    mask = jnp.zeros((var.spec.n_elems,), bool)
+    if idx:
+        mask = mask.at[jnp.asarray(idx)].set(True)
+
+    def keep(x):
+        m = mask.reshape((var.spec.n_elems,) + (1,) * (x.ndim - 1))
+        return x & m if x.dtype == jnp.bool_ else x * m
+
+    return jax.tree_util.tree_map(keep, var.state)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_store_statem(seed):
+    rng = random.Random(seed)
+    store = Store(n_actors=len(ACTORS))
+    models: dict = {}
+    watches: list = []  # (watch, vid, thr)
+    counter = 0
+
+    def check_watches():
+        for w, vid, thr in watches:
+            should = met(models[vid], thr)
+            assert w.done == should, (
+                f"watch on {vid} thr={thr}: done={w.done}, model says "
+                f"{should}"
+            )
+
+    for step in range(N_OPS):
+        roll = rng.random()
+        if roll < 0.15 or not models:
+            tname = rng.choice(TYPES)
+            counter += 1
+            vid = store.declare(
+                id=f"v{counter}", type=tname,
+                **({"n_elems": len(ELEMS)} if tname.endswith("set") else {}),
+            )
+            models[vid] = Model(tname)
+            continue
+        vid = rng.choice(sorted(models))
+        model = models[vid]
+        tname = model.tname
+        if roll < 0.55:  # update
+            actor = rng.choice(ACTORS)
+            if tname == "riak_dt_gcounter":
+                by = rng.randint(1, 4)
+                store.update(vid, ("increment", by), actor)
+                model.counts[actor] = model.counts.get(actor, 0) + by
+            elif tname == "lasp_ivar":
+                if model.payload is None:
+                    payload = rng.choice(["x", "y", ("z", 1)])
+                    store.update(vid, ("set", payload), actor)
+                    model.payload = payload
+                else:
+                    # double-bind of the same value: idempotent no-op
+                    store.update(vid, ("set", model.payload), actor)
+            elif tname == "lasp_gset" or rng.random() < 0.75:
+                e = rng.choice(ELEMS)
+                store.update(vid, ("add", e), actor)
+                model.live.add(e)
+                model.ever.add(e)
+            else:  # lasp_orset remove: observed / tombstoned / unknown
+                e = rng.choice(ELEMS)
+                if e in model.ever:
+                    # the reference's precondition is ORDDICT MEMBERSHIP,
+                    # not liveness: removing a fully-tombstoned element
+                    # succeeds as a no-op (src/lasp_orset.erl:228-238
+                    # remove_elem finds the key and re-tombstones)
+                    store.update(vid, ("remove", e), actor)
+                    model.live.discard(e)
+                else:
+                    with pytest.raises(PreconditionError):
+                        store.update(vid, ("remove", e), actor)
+                    # data-dependent failure: model unchanged
+        elif roll < 0.7:  # stale rebind: non-inflation silently ignored
+            var = store.variable(vid)
+            prev = var.state  # snapshot BEFORE the next write
+            if tname in ("lasp_gset", "lasp_orset"):
+                e = rng.choice(ELEMS)
+                store.update(vid, ("add", e), "w0")
+                model.live.add(e)
+                model.ever.add(e)
+            # prev is now a stale lower bound: merge(current, prev) ==
+            # current, not an inflation -> bind must change NOTHING
+            # (src/lasp_core.erl:305-311; lasp_eqc bind_ok/bind_next)
+            store.bind(vid, prev)
+        else:  # threshold read
+            if tname == "riak_dt_gcounter":
+                total = sum(model.counts.values())
+                strict = rng.random() < 0.3
+                bound = rng.randint(0, total + 3)
+                thr = ("count", bound, strict)
+                w = store.read(vid, Threshold(bound, strict=strict))
+            elif tname == "lasp_ivar":
+                thr = ("defined", None, True)
+                w = store.read(vid, Threshold(None, strict=True))
+            else:
+                have = sorted(model.live)
+                k = rng.randint(0, len(have))
+                subset = rng.sample(have, k)
+                thr = ("subset", frozenset(subset), False)
+                w = store.read(
+                    vid, Threshold(subset_threshold_state(store, vid, subset))
+                )
+            assert w.done == met(model, thr)
+            watches.append((w, vid, thr))
+
+        # global invariants after every command
+        assert store.value(vid) == model.value(), (
+            f"step {step}: {vid} store={store.value(vid)!r} "
+            f"model={model.value()!r}"
+        )
+        check_watches()
+
+    for vid, model in models.items():
+        assert store.value(vid) == model.value()
