@@ -330,11 +330,17 @@ class ReplicatedRuntime:
     # -- actor-collision debug guard -----------------------------------------
     #: types whose state carries per-actor lanes that two writing replicas
     #: would silently corrupt: vclock types (colliding dot counters read as
-    #: observed-and-removed -> disappearing elements) and the G-Counter
-    #: (same-lane increments at two rows max-merge into lost counts)
-    _ACTOR_LANE_TYPES = frozenset(
-        {"riak_dt_orswot", "riak_dt_map", "riak_dt_gcounter"}
-    )
+    #: observed-and-removed -> disappearing elements), the G-Counter
+    #: (same-lane increments at two rows max-merge into lost counts), and
+    #: the OR-Sets (dense counter-based tokens allocate row-locally per
+    #: (elem, actor) pool — two rows minting under one actor reuse slots,
+    #: and a remove at either row then tombstones the OTHER row's distinct
+    #: logical add; the reference dodges this with 20-byte random tokens,
+    #: the dense encoding needs the riak_dt actor discipline instead)
+    _ACTOR_LANE_TYPES = frozenset({
+        "riak_dt_orswot", "riak_dt_map", "riak_dt_gcounter",
+        "lasp_orset", "lasp_orset_gbtree",
+    })
 
     def _actor_guard_keys(self, var, actor, fresh_offset: int = 0) -> list:
         """Registry keys naming one physical actor lane. Term surfaces
@@ -366,14 +372,26 @@ class ReplicatedRuntime:
         riak_dt requirement documented on :meth:`update_at`); minting
         events under one actor from two replica rows corrupts state
         SILENTLY (the vclock rule reads colliding dots as
-        observed-and-removed). Raises at the second write site; returns
-        the registry keys for :meth:`_guard_actor_commit` AFTER the write
-        actually applies (a failed write must not register a phantom
-        site). Registry resets on membership changes (row indices move)."""
+        observed-and-removed; OR-Set slot pools reuse token slots).
+        Raises at the second write site; returns the registry keys for
+        :meth:`_guard_actor_commit` AFTER the write actually applies (a
+        failed write must not register a phantom site). The registry
+        PERSISTS across membership changes — surviving rows keep their
+        indices; departed actors remap per :meth:`resize` (row 0 after a
+        graceful handoff, an unmatchable dead site after a crash: the
+        riak_dt never-reuse-an-actor incarnation rule)."""
         keys = self._actor_guard_keys(var, actor)
         for key in keys:
             prev = self._actor_sites.get(key)
             if prev is not None and prev != int(replica):
+                if prev < 0:
+                    raise ActorCollisionError(
+                        f"actor {actor!r} departed with a crashed row "
+                        f"(its {var.id!r} tokens may still circulate via "
+                        "gossip) and may never mint again — use a fresh "
+                        "actor name for the new incarnation (the riak_dt "
+                        "never-reuse-an-actor rule)"
+                    )
                 raise ActorCollisionError(
                     f"actor {actor!r} already minted lane events for "
                     f"{var.id!r} at replica {prev}; writing from replica "
@@ -391,11 +409,14 @@ class ReplicatedRuntime:
     @staticmethod
     def _op_mints_lane(var, op: tuple) -> bool:
         """Does this client op mint per-actor lane events? (Removes read
-        lanes but mint nothing — two-site removes are safe.)"""
+        lanes but mint nothing — two-site removes are safe. OR-Set
+        ``add_by_token`` is exempt too: its token comes from the CALLER,
+        and same-token-same-write idempotence across replicas is the
+        point — the 2i index program relies on it.)"""
         tn = var.type_name
         if tn == "riak_dt_gcounter":
             return op[0] == "increment"
-        if tn == "riak_dt_orswot":
+        if tn in ("riak_dt_orswot", "lasp_orset", "lasp_orset_gbtree"):
             return op[0] in ("add", "add_all")
         if tn == "riak_dt_map":
             from ..lattice.map import map_subs
@@ -513,8 +534,13 @@ class ReplicatedRuntime:
                     elif prev != int(r):
                         raise ActorCollisionError(
                             f"update_batch({var_id!r}): actor {actor!r} "
-                            f"mints lane events at replicas {prev} and "
-                            f"{int(r)} — one actor per writing replica "
+                            + ("departed with a crashed row and may "
+                               "never mint again (use a fresh actor "
+                               "name for the new incarnation)"
+                               if prev < 0 else
+                               f"mints lane events at replicas {prev} "
+                               f"and {int(r)}")
+                            + " — one actor per writing replica "
                             "(see debug_actors/_guard_actor_check)"
                         )
         # interner overflow must follow the same per-op prefix semantics as
@@ -2119,7 +2145,25 @@ class ReplicatedRuntime:
         self.n_replicas = new_n
         self.neighbors = jnp.asarray(new_neighbors)
         self._shift_offsets = shift_offsets(new_neighbors, new_n)
-        self._actor_sites.clear()  # row indices moved; the guard restarts
+        # guard registry across membership changes (surviving rows keep
+        # their indices — head rows on shrink, appended rows on grow):
+        # a DEPARTED actor's tokens may still circulate via gossip, so a
+        # fresh incarnation minting under the same name risks row-local
+        # slot reuse against them (the silent loss the mesh statem
+        # caught). Graceful leave joins the departing rows into row 0,
+        # which then sees ALL their tokens — the actor may continue
+        # there; a crash leaves circulating orphans, so the dead-row
+        # binding stays and any future write site collides loudly (the
+        # riak_dt never-reuse-an-actor incarnation rule).
+        if new_n < old_n:
+            for key, site in list(self._actor_sites.items()):
+                if site >= new_n:
+                    # graceful -> row 0 (it received the handoff join and
+                    # sees all the actor's tokens); crash -> -1, a site no
+                    # row can ever match (a later GROW would otherwise
+                    # reuse the dead index and silently re-legitimize the
+                    # binding against the orphaned circulating tokens)
+                    self._actor_sites[key] = 0 if graceful else -1
         self._step = None
         self._fused_steps_cache.clear()
 

@@ -179,12 +179,56 @@ def test_seed_increments_shape_error_leaves_no_phantom_sites():
     rt.seed_increments(c, [3], [0])  # lane 0 free to home anywhere
 
 
-def test_resize_resets_registry():
+def test_resize_keeps_registry_for_surviving_rows():
+    # surviving rows keep their indices, so actor bindings survive resize
     rt, s = _rt()
     rt.update_at(0, s, ("add", "x"), "w")
     rt.resize(6, ring(6, 2))
-    rt.update_at(5, s, ("add", "y"), "w")  # rows moved; guard restarted
-    rt.run_to_convergence(max_rounds=16)
+    with pytest.raises(ActorCollisionError):
+        rt.update_at(5, s, ("add", "y"), "w")  # w still homes at row 0
+    rt.update_at(0, s, ("add", "y"), "w")  # its home still works
+
+
+def test_orset_token_reuse_after_churn_is_caught():
+    # the silent loss the mesh statem caught (150-op soak): shrink drops
+    # a row whose tokens still circulate via gossip; a later grow reuses
+    # the row index, and a fresh mint under the SAME actor allocates the
+    # same row-local slot — a circulating tombstone then eats the new
+    # add. The guard must refuse the reused-actor write.
+    store = Store(n_actors=8)
+    s = store.declare(id="s", type="lasp_orset", n_elems=8,
+                      tokens_per_actor=4)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2),
+                           debug_actors=True)
+    rt.update_at(3, s, ("add", "x"), "a3")
+    rt.run_to_convergence(max_rounds=8)   # x's token circulates
+    rt.update_at(0, s, ("remove", "x"), "a0")  # tombstone circulates too
+    rt.run_to_convergence(max_rounds=8)
+    rt.resize(3, ring(3, 2), graceful=False)  # row 3 crashes away
+    rt.resize(4, ring(4, 2))                  # a new row 3 joins
+    with pytest.raises(ActorCollisionError):
+        # without the guard this add would mint (x, a3, slot 0) again and
+        # the circulating tombstone would silently absorb it
+        rt.update_at(3, s, ("add", "x"), "a3")
+    rt.update_at(3, s, ("add", "x"), "a3-incarnation2")  # fresh actor: fine
+    rt.run_to_convergence(max_rounds=8)
+    assert rt.coverage_value(s) == {"x"}
+
+
+def test_graceful_departure_remaps_actor_to_row0():
+    store = Store(n_actors=8)
+    s = store.declare(id="s", type="lasp_orset", n_elems=8,
+                      tokens_per_actor=4)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2),
+                           debug_actors=True)
+    rt.update_at(3, s, ("add", "x"), "a3")
+    rt.resize(3, ring(3, 2), graceful=True)  # row 3's state joins row 0
+    # row 0 sees ALL of a3's tokens post-handoff: continuing there is safe
+    rt.update_at(0, s, ("add", "y"), "a3")
+    with pytest.raises(ActorCollisionError):
+        rt.update_at(2, s, ("add", "z"), "a3")  # anywhere else is not
+    rt.run_to_convergence(max_rounds=8)
+    assert rt.coverage_value(s) == {"x", "y"}
 
 
 def test_guard_off_by_default():
