@@ -337,6 +337,34 @@ def _child(label: str) -> int:
     except Exception as exc:  # headline survives a north-star failure
         detail["adcounter_northstar"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- bridge wire codec (CPU-side, ~1 s): which ETF implementation is
+    # active and what it measures on the merge_batch frame — the native
+    # C++ codec's evidence rides in the same artifact ------------------------
+    try:
+        from lasp_tpu.bench_scenarios import bridge_throughput  # noqa: F401
+        from lasp_tpu.bridge import etf
+
+        frame = (
+            etf.Atom("merge_batch"),
+            [(b"s%d" % i, (etf.Atom("lasp_orset"),
+                           [(b"e%d" % j, [(t, t % 3 == 0) for t in range(8)])
+                            for j in range(32)],
+                           {etf.Atom("n_elems"): 64})) for i in range(16)],
+        )
+        raw = etf.encode(frame)
+        reps = 60
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            etf.decode(raw)
+        dec_s = time.perf_counter() - t0
+        detail["bridge_codec"] = {
+            "etf_impl": etf.IMPL,
+            "merge_batch_frame_bytes": len(raw),
+            "decode_MBps": round(len(raw) * reps / dec_s / 1e6, 1),
+        }
+    except Exception as exc:
+        detail["bridge_codec"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     _emit(
         {
             "metric": "orset_replica_merges_per_sec_per_chip",
