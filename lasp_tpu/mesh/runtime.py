@@ -1857,17 +1857,38 @@ class ReplicatedRuntime:
         return probe(), rounds, quiescent
 
     def read_any_until(self, replica: int, reads, max_rounds: int = 10_000,
-                       edge_mask=None, block: int = 1):
+                       edge_mask=None, block: int = 1,
+                       on_device: "bool | None" = None):
         """First-match-wins blocking read over ``[(var_id, threshold),
         ...]`` at one replica — ``lasp:read_any/1``
         (``src/lasp_core.erl:369-420``) at the mesh surface: steps the
         population until ANY listed threshold is met, returning
         ``(var_id, row)`` for the first match (list order breaks
         same-round ties, like the reference's first-reply wins). Fails
-        fast once the population quiesces with every threshold unmet."""
+        fast once the population quiesces with every threshold unmet.
+
+        ``on_device`` follows :meth:`read_until`'s contract: auto
+        (default) parks the whole multi-threshold wait on the chip — one
+        ``lax.while_loop`` dispatch whose condition evaluates every
+        listed predicate per round, zero per-probe row pulls — whenever
+        all threshold states are device-traceable; ``on_device=False``
+        keeps the host-probed loop."""
         reads = list(reads)  # probed every round: a one-shot iterator
         if not reads:        # would silently drain after round one
             raise ValueError("read_any_until needs at least one read")
+        if on_device is None:
+            on_device = all(
+                _device_expressible(
+                    self.store._resolve_threshold(
+                        self.store.variable(v), t
+                    ).state
+                )
+                for v, t in reads
+            )
+        if on_device:
+            return self._read_any_until_on_device(
+                replica, reads, max_rounds, edge_mask
+            )
 
         def probe():
             for var_id, threshold in reads:
@@ -1887,33 +1908,57 @@ class ReplicatedRuntime:
                if quiescent else "")
         )
 
-    def _read_until_on_device(self, replica, var_id, threshold, max_rounds,
-                              edge_mask):
+    def _read_any_until_on_device(self, replica, reads, max_rounds,
+                                  edge_mask):
         if max_rounds < 1:
             raise ValueError("max_rounds must be >= 1")
-        self._population(var_id)  # sync in a late-declared variable
-        var = self.store.variable(var_id)
-        thr = self.store._resolve_threshold(var, threshold)
+        if (max_rounds + 1) * 4 * len(reads) >= 2**31:
+            # the exit scalar packs (rounds*4 + code)*n_reads + which in
+            # int32; past this bound the decode would silently corrupt
+            raise ValueError(
+                f"max_rounds={max_rounds} with {len(reads)} reads "
+                "overflows the device wait's int32 exit protocol — "
+                "lower max_rounds or split the read list"
+            )
+        for var_id, _t in reads:
+            self._population(var_id)  # sync in late-declared variables
+        resolved = [
+            (v, self.store._resolve_threshold(self.store.variable(v), t))
+            for v, t in reads
+        ]
         tables = self._ensure_step()
-        key = ("read_until", var_id, bool(thr.strict))
+        n_reads = len(resolved)
+        key = ("read_any_until",
+               tuple((v, bool(t.strict)) for v, t in resolved))
         fn = self._fused_steps_cache.get(key)
         if fn is None:
             step = self._step_pure
-            codec, spec = var.codec, var.spec
-            strict = bool(thr.strict)
+            meta = [
+                (v, self.store.variable(v).codec, self.store.variable(v).spec,
+                 bool(t.strict))
+                for v, t in resolved
+            ]
             to_dense = self._to_dense_row
 
-            def wait(states, neighbors, mask, tables, r, mr, thr_state):
-                def met(s):
-                    row = jax.tree_util.tree_map(lambda x: x[r], s[var_id])
-                    row = to_dense(var_id, row)
-                    return codec.threshold_met(
-                        spec, row, Threshold(thr_state, strict)
-                    )
+            def wait(states, neighbors, mask, tables, r, mr, thr_states):
+                def flags(s):
+                    out = []
+                    for (v, codec, spec, strict), ts in zip(meta, thr_states):
+                        row = to_dense(
+                            v, jax.tree_util.tree_map(lambda x: x[r], s[v])
+                        )
+                        out.append(
+                            codec.threshold_met(spec, row, Threshold(ts, strict))
+                        )
+                    return jnp.stack(out)
 
                 def cond(carry):
                     s, rounds, residual = carry
-                    return ~met(s) & (residual != 0) & (rounds < mr)
+                    return (
+                        ~jnp.any(flags(s))
+                        & (residual != 0)
+                        & (rounds < mr)
+                    )
 
                 def body(carry):
                     s, rounds, _residual = carry
@@ -1923,40 +1968,62 @@ class ReplicatedRuntime:
                 out, rounds, residual = jax.lax.while_loop(
                     cond, body, (states, jnp.int32(0), jnp.int32(1))
                 )
-                # exit reason rides in the low bits: 0 met, 1 budget
-                # exhausted, 2 quiescent-unmet (threshold unreachable)
+                f = flags(out)
+                # first-met index breaks same-round ties (argmax = first
+                # True); exit code as in _read_until_on_device
+                which = jnp.argmax(f).astype(jnp.int32)
                 code = jnp.where(
-                    met(out), 0, jnp.where(residual == 0, 2, 1)
+                    jnp.any(f), 0, jnp.where(residual == 0, 2, 1)
                 )
-                return out, rounds * 4 + code
+                return out, (rounds * 4 + code) * n_reads + which
 
             fn = jax.jit(wait, donate_argnums=self._donate_argnums())
             self._fused_steps_cache[key] = fn
         with Timer() as t:
             self.states, packed = self._run_step_fn(
                 fn, edge_mask, tables, jnp.int32(replica),
-                jnp.int32(max_rounds), thr.state,
+                jnp.int32(max_rounds),
+                tuple(thr.state for _v, thr in resolved),
             )
-        rounds, code = packed // 4, packed % 4
+        which = packed % n_reads
+        rounds, code = (packed // n_reads) // 4, (packed // n_reads) % 4
         self.trace.record_round(0 if code == 0 else -1, t.elapsed)
+        verb = "read_until" if n_reads == 1 else "read_any_until"
         if code == 0:
-            row = self.read_at(replica, var_id, threshold)
+            var_id, thr = resolved[which]
+            row = self.read_at(replica, var_id, thr)
             if row is None:
                 # met on-device must be met on-host; a mismatch means the
-                # device predicate and the host codec disagree — surface it
-                # even under ``python -O`` (a bare assert would vanish and
-                # silently return None)
+                # device predicate and the host codec disagree — surfaced
+                # even under ``python -O``
                 raise RuntimeError(
-                    f"read_until({var_id!r}): device wait reported the "
+                    f"{verb}({var_id!r}): device wait reported the "
                     "threshold met but the host re-check disagrees — "
                     "device/host threshold predicate mismatch"
                 )
-            return row
+            return var_id, row
+        if n_reads == 1:
+            raise TimeoutError(
+                f"threshold not met at replica {replica} within {rounds} "
+                "rounds"
+                + (" (population quiescent: the threshold is unreachable)"
+                   if code == 2 else "")
+            )
         raise TimeoutError(
-            f"threshold not met at replica {replica} within {rounds} rounds"
-            + (" (population quiescent: the threshold is unreachable)"
+            f"no threshold met at replica {replica} within {rounds} rounds"
+            + (" (population quiescent: none is reachable)"
                if code == 2 else "")
         )
+
+    def _read_until_on_device(self, replica, var_id, threshold, max_rounds,
+                              edge_mask):
+        """The single-threshold device wait IS the n=1 case of the
+        multi-threshold one — one copy of the while_loop machinery, exit
+        protocol, and mismatch guard to keep correct."""
+        _v, row = self._read_any_until_on_device(
+            replica, [(var_id, threshold)], max_rounds, edge_mask
+        )
+        return row
 
     # -- compaction ------------------------------------------------------------
     def compact_orset(self, var_id: str) -> int:

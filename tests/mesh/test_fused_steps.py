@@ -517,6 +517,59 @@ def test_read_any_until_first_match_wins():
         )
 
 
+def test_read_any_until_device_parked_default(monkeypatch):
+    """The multi-threshold wait parks on the chip by default: exactly one
+    host probe (the final met-row return), list-order tie-breaking, and
+    host/device path agreement."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.store import Store
+
+    def build():
+        store = Store(n_actors=2)
+        store.declare(id="a", type="riak_dt_gcounter")
+        store.declare(id="b", type="riak_dt_gcounter")
+        rt = ReplicatedRuntime(store, Graph(store), 16, ring(16, 1))
+        rt.update_batch("a", [(0, ("increment", 5), "w")])
+        rt.update_batch("b", [(9, ("increment", 3), "w")])
+        return rt
+
+    calls = {"n": 0}
+    orig = ReplicatedRuntime.read_at
+
+    def counting(self, *a, **kw):
+        calls["n"] += 1
+        return orig(self, *a, **kw)
+
+    monkeypatch.setattr(ReplicatedRuntime, "read_at", counting)
+    rt = build()
+    calls["n"] = 0
+    var, row = rt.read_any_until(8, [("a", Threshold(5)), ("b", Threshold(3))])
+    assert var == "b" and int(row.counts.sum()) == 3
+    assert calls["n"] == 1  # zero per-probe pulls; one final re-check
+
+    # host opt-out agrees
+    rt2 = build()
+    var2, row2 = rt2.read_any_until(
+        8, [("a", Threshold(5)), ("b", Threshold(3))], on_device=False,
+        block=4,
+    )
+    assert (var2, int(row2.counts.sum())) == (var, 3)
+
+    # same-round tie: both already met at the reader -> list order wins
+    rt3 = build()
+    var3, _row3 = rt3.read_any_until(
+        0, [("b", Threshold(0)), ("a", Threshold(0))]
+    )
+    assert var3 == "b"
+
+    # quiescent fast-fail on the device path too
+    with pytest.raises(TimeoutError, match="none is reachable"):
+        rt3.read_any_until(
+            8, [("a", Threshold(99)), ("b", Threshold(99))], max_rounds=500
+        )
+
+
 def test_late_declared_variable_readable_on_all_paths():
     """A variable declared AFTER the runtime was built is readable via
     every surface — host reads, device-parked reads, coverage, quorum,

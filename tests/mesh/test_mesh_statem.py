@@ -39,6 +39,9 @@ from lasp_tpu.store import Store
 N_OPS = int(os.environ.get("LASP_STATEM_OPS", "50"))
 ELEMS = ["a", "b", "c", "d", "e", "f"]
 MAX_R = 16
+#: per-(row, generation) actor names scale with the op budget (a
+#: membership change per ~7 ops mints a fresh generation of writers)
+N_ACTORS = max(256, N_OPS)
 
 
 class MeshModel:
@@ -122,10 +125,10 @@ def test_mesh_statem(seed):
     rng = random.Random(seed)
     n = 12
     nbrs = random_regular(n, 2, seed=seed)
-    store = Store(n_actors=256)
+    store = Store(n_actors=N_ACTORS)
     s = store.declare(id="s", type="lasp_orset", n_elems=len(ELEMS),
-                      n_actors=256, tokens_per_actor=32)
-    c = store.declare(id="c", type="riak_dt_gcounter", n_actors=256)
+                      n_actors=N_ACTORS, tokens_per_actor=32)
+    c = store.declare(id="c", type="riak_dt_gcounter", n_actors=N_ACTORS)
     rt = ReplicatedRuntime(store, Graph(store), n, nbrs,
                            debug_actors=True, donate_steps=False)
     model = MeshModel(n, nbrs)

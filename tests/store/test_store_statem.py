@@ -101,10 +101,14 @@ def test_store_statem(seed):
         if roll < 0.15 or not models:
             tname = rng.choice(TYPES)
             counter += 1
-            vid = store.declare(
-                id=f"v{counter}", type=tname,
-                **({"n_elems": len(ELEMS)} if tname.endswith("set") else {}),
-            )
+            caps = {}
+            if tname.endswith("set"):
+                caps["n_elems"] = len(ELEMS)
+            if tname == "lasp_orset":
+                # token pools must fit the op budget: churn on one
+                # (elem, actor) pair mints a fresh slot per add
+                caps["tokens_per_actor"] = max(16, N_OPS)
+            vid = store.declare(id=f"v{counter}", type=tname, **caps)
             models[vid] = Model(tname)
             continue
         vid = rng.choice(sorted(models))
