@@ -36,6 +36,7 @@ from lasp_tpu.mesh import ReplicatedRuntime
 from lasp_tpu.mesh.topology import random_regular, ring
 from lasp_tpu.store import Store
 
+N_SEEDS = int(os.environ.get("LASP_STATEM_SEEDS", "6"))
 N_OPS = int(os.environ.get("LASP_STATEM_OPS", "50"))
 ELEMS = ["a", "b", "c", "d", "e", "f"]
 MAX_R = 16
@@ -120,7 +121,7 @@ class MeshModel:
         self.neighbors = np.asarray(new_neighbors)
 
 
-@pytest.mark.parametrize("seed", range(6))
+@pytest.mark.parametrize("seed", range(N_SEEDS))
 def test_mesh_statem(seed):
     rng = random.Random(seed)
     n = 12

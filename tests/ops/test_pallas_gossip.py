@@ -53,6 +53,27 @@ def test_pallas_round_matches_xla(n, k):
     np.testing.assert_array_equal(np.asarray(got_r), np.asarray(ref.removed))
 
 
+def test_pallas_matches_xla_at_the_headline_shape():
+    """The EXACT wide-row bench shape (128 elems x 64 actors x 4 tokens =
+    1024 words/plane, 8 KiB/replica over both planes) at a tiny
+    population — the shape the TPU autotune gate will hand the kernel
+    first. A shape assumption that only breaks at bench widths must die
+    here in interpret mode, not in Mosaic on the capture run."""
+    spec = PackedORSetSpec(n_elems=128, n_actors=64, tokens_per_actor=4)
+    n, k = 32, 3
+    states = seeded_states(spec, n)
+    nbrs = jnp.asarray(random_regular(n, k, seed=7))
+    ref = gossip_round(PackedORSet, spec, states, nbrs)
+    fe, _d = flatten_plane(states.exists)
+    fr, _ = flatten_plane(states.removed)
+    # the bench gate's block parameter (cfg.bench_block default 4)
+    oe, orr = pallas_gossip_round(fe, fr, nbrs, block=4, interpret=True)
+    got_e = unflatten_plane(oe, states.exists.shape)
+    got_r = unflatten_plane(orr, states.removed.shape)
+    np.testing.assert_array_equal(np.asarray(got_e), np.asarray(ref.exists))
+    np.testing.assert_array_equal(np.asarray(got_r), np.asarray(ref.removed))
+
+
 def test_pallas_rounds_converge():
     n, k = 64, 3
     spec = PackedORSetSpec(n_elems=16, n_actors=8, tokens_per_actor=8)

@@ -24,6 +24,7 @@ import pytest
 from lasp_tpu.lattice import Threshold
 from lasp_tpu.store import PreconditionError, Store
 
+N_SEEDS = int(os.environ.get("LASP_STATEM_SEEDS", "8"))
 N_OPS = int(os.environ.get("LASP_STATEM_OPS", "60"))
 ELEMS = ["a", "b", "c", "d", "e", "f", "g", "h"]
 ACTORS = ["w0", "w1", "w2"]
@@ -80,7 +81,7 @@ def subset_threshold_state(store, vid, subset):
     return jax.tree_util.tree_map(keep, var.state)
 
 
-@pytest.mark.parametrize("seed", range(8))
+@pytest.mark.parametrize("seed", range(N_SEEDS))
 def test_store_statem(seed):
     rng = random.Random(seed)
     store = Store(n_actors=len(ACTORS))
