@@ -172,6 +172,23 @@ def test_execute_during_process_preserves_row_binding():
     assert rt.execute("after") == {"k1"}
 
 
+def test_programs_on_packed_runtime():
+    # program accumulators are OR-Set-family -> packable: delivery and
+    # coverage execute must work through the packed wire format too
+    store = Store(n_actors=8)
+    rt = ReplicatedRuntime(store, Graph(store), 8, ring(8, 2), packed=True)
+    rt.register("keylist", ExampleKeylistProgram, n_elems=16)
+    rt.register("acc", ExampleProgram, n_elems=16)
+    for i, key in enumerate(["k1", "k2", "k3"]):
+        rt.process((key, i), "put", f"actor{i}", replica=(i * 3) % 8)
+    assert rt.execute("keylist") == {"k1", "k2", "k3"}
+    rt.run_to_convergence(max_rounds=16)
+    pid = rt._programs["keylist"].id
+    assert rt.divergence(pid) == 0
+    for r in range(8):
+        assert rt.replica_value(pid, r) == {"k1", "k2", "k3"}
+
+
 def test_programs_survive_membership_changes():
     # register-on-every-partition must hold across joins/leaves: the
     # accumulator rides the population through resize (new rows at

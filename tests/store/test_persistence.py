@@ -153,6 +153,29 @@ def test_store_checkpoint_legacy_inline_manifest(tmp_path):
     assert loaded.metrics == store.metrics
 
 
+def test_reset_mode_map_checkpoint_roundtrip(tmp_path):
+    # the epochs plane is a NEW state leaf (round 4): it must ride the
+    # generic leaf records, and the unpickled spec must carry the flag so
+    # the rebuilt template has a matching tree structure
+    store = Store(n_actors=4)
+    m = store.declare(
+        type="riak_dt_map", reset_on_readd=True,
+        fields=[(("X", "lasp_orset"), "lasp_orset", {"n_elems": 4})],
+    )
+    key = ("X", "lasp_orset")
+    store.update(m, ("update", [("update", key, ("add", "v1"))]), "r1")
+    store.update(m, ("update", [("remove", key)]), "r1")
+    store.update(m, ("update", [("update", key, ("add", "v2"))]), "r1")
+    path = str(tmp_path / "reset_map.log")
+    save_store(store, path)
+    loaded = load_store(path)
+    assert loaded.value(m) == {key: frozenset({"v2"})}
+    # the restored epoch gate still resets on the NEXT remove/re-add
+    loaded.update(m, ("update", [("remove", key)]), "r1")
+    loaded.update(m, ("update", [("update", key, ("add", "v3"))]), "r1")
+    assert loaded.value(m) == {key: frozenset({"v3"})}
+
+
 def test_load_store_refuses_runtime_checkpoint(tmp_path):
     from lasp_tpu.store.checkpoint import save_runtime
 
