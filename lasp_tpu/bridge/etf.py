@@ -69,7 +69,20 @@ def encode(term: Any) -> bytes:
     return bytes(out)
 
 
-def _enc(t: Any, out: bytearray) -> None:
+def _check_len(n: int) -> int:
+    # 4-byte wire length fields; past them the native codec would
+    # otherwise truncate and the struct.pack path would raise its own
+    # opaque error — both codecs refuse identically instead
+    if n > 0xFFFFFFFF:
+        raise ValueError("term too large for ETF (4-byte length field)")
+    return n
+
+
+def _enc(t: Any, out: bytearray, depth: int = 0) -> None:
+    if depth > _MAX_DEPTH:
+        # same bound as decode (and the native encoder): frames nested
+        # past _MAX_DEPTH could never be decoded by either codec anyway
+        raise TypeError("ETF term nesting too deep")
     if isinstance(t, Atom):
         raw = t.encode("utf-8")
         if len(raw) < 256:
@@ -80,7 +93,7 @@ def _enc(t: Any, out: bytearray) -> None:
             out += struct.pack(">H", len(raw))
         out += raw
     elif isinstance(t, bool):
-        _enc(Atom("true") if t else Atom("false"), out)
+        _enc(Atom("true") if t else Atom("false"), out, depth)
     elif isinstance(t, int):
         if 0 <= t <= 255:
             out.append(_SMALL_INT)
@@ -105,38 +118,38 @@ def _enc(t: Any, out: bytearray) -> None:
         out += struct.pack(">d", t)
     elif isinstance(t, (bytes, bytearray)):
         out.append(_BINARY)
-        out += struct.pack(">I", len(t))
+        out += struct.pack(">I", _check_len(len(t)))
         out += t
     elif isinstance(t, str):
         # plain str crosses as a binary (Elixir convention); use Atom for
         # atoms. The Erlang side reads these with binary pattern matches.
-        _enc(t.encode("utf-8"), out)
+        _enc(t.encode("utf-8"), out, depth)
     elif isinstance(t, tuple):
         if len(t) < 256:
             out.append(_SMALL_TUPLE)
             out.append(len(t))
         else:
             out.append(_LARGE_TUPLE)
-            out += struct.pack(">I", len(t))
+            out += struct.pack(">I", _check_len(len(t)))
         for x in t:
-            _enc(x, out)
+            _enc(x, out, depth + 1)
     elif isinstance(t, list):
         if not t:
             out.append(_NIL)
         else:
             out.append(_LIST)
-            out += struct.pack(">I", len(t))
+            out += struct.pack(">I", _check_len(len(t)))
             for x in t:
-                _enc(x, out)
+                _enc(x, out, depth + 1)
             out.append(_NIL)
     elif isinstance(t, dict):
         out.append(_MAP)
-        out += struct.pack(">I", len(t))
+        out += struct.pack(">I", _check_len(len(t)))
         for k, v in t.items():
-            _enc(k, out)
-            _enc(v, out)
+            _enc(k, out, depth + 1)
+            _enc(v, out, depth + 1)
     elif t is None:
-        _enc(UNDEFINED, out)
+        _enc(UNDEFINED, out, depth)
     else:
         raise TypeError(f"cannot encode {type(t).__name__} as ETF: {t!r}")
 

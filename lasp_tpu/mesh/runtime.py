@@ -246,6 +246,12 @@ class ReplicatedRuntime:
             )
         if builder is not None:
             fn = builder()
+            if not callable(fn):
+                # catch the forgotten-return builder NOW, not as a
+                # NoneType-not-callable deep inside the next step's trace
+                raise TypeError(
+                    f"trigger builder returned {fn!r}, not a callable"
+                )
         self._triggers.append(
             (fn, frozenset(touches) if touches is not None else None, builder)
         )
@@ -452,13 +458,16 @@ class ReplicatedRuntime:
         ``debug_actors=True`` to turn that misuse into a loud
         :class:`ActorCollisionError` at the second write site."""
         var = self.store.variable(var_id)
-        guard_keys = None
-        if (
+        # boolean on purpose: the commit below re-derives keys AFTER the
+        # apply interns the actor (picking up the ("lane", idx) alias);
+        # reusing the pre-intern keys here would drop it
+        guarded = (
             self.debug_actors
             and var.type_name in self._ACTOR_LANE_TYPES
             and self._op_mints_lane(var, op)
-        ):
-            guard_keys = self._guard_actor_check(var, replica, actor)
+        )
+        if guarded:
+            self._guard_actor_check(var, replica, actor)
         wire_row = jax.tree_util.tree_map(
             lambda x: x[replica], self._population(var_id)
         )
@@ -467,11 +476,9 @@ class ReplicatedRuntime:
         merged = var.codec.merge(var.spec, row, candidate)
         if bool(var.codec.is_inflation(var.spec, row, merged)):
             new_row = self._from_dense_row(var_id, merged)
-            if guard_keys is not None:
+            if guarded:
                 # commit only now: the write applied AND inflated (a
-                # bind-rule-ignored write minted nothing that survives),
-                # and the apply interned the actor, so re-deriving keys
-                # picks up the ("lane", idx) alias
+                # bind-rule-ignored write minted nothing that survives)
                 self._guard_actor_commit(
                     self._actor_guard_keys(var, actor), replica
                 )
@@ -1401,7 +1408,13 @@ class ReplicatedRuntime:
                 out[v] = new
             return out, residual
 
-        self._step_pure = step  # un-jitted; __graft_entry__ re-jits with shardings
+        # un-jitted; __graft_entry__ re-jits with shardings. CAVEAT for
+        # external consumers: on a shift-structured topology the gossip
+        # uses the offsets BAKED at build time and ignores the traced
+        # `neighbors` argument — to run a different topology, change it
+        # on the runtime (resize) and rebuild the step, don't just pass
+        # a different table
+        self._step_pure = step
         # donate the input states: both callers (step / fused_steps) rebind
         # self.states to the output immediately, so the old buffers are
         # recycled — at 10M-replica engine scale this is a full
@@ -1814,9 +1827,11 @@ class ReplicatedRuntime:
         so the wait fails fast instead of burning the round budget."""
         if on_device is None:
             var = self.store.variable(var_id)
-            thr = self.store._resolve_threshold(var, threshold)
-            on_device = _device_expressible(thr.state)
+            threshold = self.store._resolve_threshold(var, threshold)
+            on_device = _device_expressible(threshold.state)
         if on_device:
+            # resolution is idempotent: passing the resolved Threshold
+            # through avoids re-constructing default bottom states inside
             return self._read_until_on_device(
                 replica, var_id, threshold, max_rounds, edge_mask
             )
@@ -1877,14 +1892,12 @@ class ReplicatedRuntime:
         if not reads:        # would silently drain after round one
             raise ValueError("read_any_until needs at least one read")
         if on_device is None:
-            on_device = all(
-                _device_expressible(
-                    self.store._resolve_threshold(
-                        self.store.variable(v), t
-                    ).state
-                )
+            # resolve once; resolution is idempotent downstream
+            reads = [
+                (v, self.store._resolve_threshold(self.store.variable(v), t))
                 for v, t in reads
-            )
+            ]
+            on_device = all(_device_expressible(t.state) for _v, t in reads)
         if on_device:
             return self._read_any_until_on_device(
                 replica, reads, max_rounds, edge_mask
@@ -1911,7 +1924,16 @@ class ReplicatedRuntime:
     def _read_any_until_on_device(self, replica, reads, max_rounds,
                                   edge_mask):
         if max_rounds < 1:
-            raise ValueError("max_rounds must be >= 1")
+            # the host loop's max_rounds=0 idiom: probe once, never step
+            for var_id, threshold in reads:
+                row = self.read_at(replica, var_id, threshold)
+                if row is not None:
+                    return var_id, row
+            raise TimeoutError(
+                f"no threshold met at replica {replica} within 0 rounds"
+                if len(reads) > 1 else
+                f"threshold not met at replica {replica} within 0 rounds"
+            )
         if (max_rounds + 1) * 4 * len(reads) >= 2**31:
             # the exit scalar packs (rounds*4 + code)*n_reads + which in
             # int32; past this bound the decode would silently corrupt
@@ -2126,7 +2148,13 @@ class ReplicatedRuntime:
             rebuilt, failures = [], []
             for _f, touch, b in saved:
                 try:
-                    rebuilt.append((b(), touch, b))
+                    built = b()
+                    if not callable(built):
+                        raise TypeError(
+                            f"trigger builder returned {built!r}, not a "
+                            "callable"
+                        )
+                    rebuilt.append((built, touch, b))
                 except Exception as exc:  # noqa: BLE001 — reported below
                     failures.append((b, exc))
             self._triggers = rebuilt + self._triggers

@@ -94,6 +94,17 @@ struct Buf {
 
 bool enc(PyObject *t, Buf &out, int depth);
 
+bool check_len(Py_ssize_t n) {
+  // 4-byte wire length fields; refuse (like the Python codec) instead
+  // of truncating into a corrupt frame
+  if (n > Py_ssize_t(0xFFFFFFFFLL)) {
+    PyErr_SetString(PyExc_ValueError,
+                    "term too large for ETF (4-byte length field)");
+    return false;
+  }
+  return true;
+}
+
 bool enc_atom_bytes(const char *raw, Py_ssize_t n, Buf &out) {
   if (n < 256) {
     if (!out.u8(SMALL_ATOM_UTF8) || !out.u8(uint8_t(n))) return false;
@@ -194,26 +205,28 @@ bool enc(PyObject *t, Buf &out, int depth) {
   }
   if (PyBytes_Check(t)) {
     Py_ssize_t n = PyBytes_GET_SIZE(t);
-    return out.u8(BINARY) && out.u32be(uint32_t(n)) &&
+    return check_len(n) && out.u8(BINARY) && out.u32be(uint32_t(n)) &&
            out.put(PyBytes_AS_STRING(t), n);
   }
   if (PyByteArray_Check(t)) {
     Py_ssize_t n = PyByteArray_GET_SIZE(t);
-    return out.u8(BINARY) && out.u32be(uint32_t(n)) &&
+    return check_len(n) && out.u8(BINARY) && out.u32be(uint32_t(n)) &&
            out.put(PyByteArray_AS_STRING(t), n);
   }
   if (PyUnicode_Check(t)) {  // plain str crosses as a binary
     Py_ssize_t n;
     const char *raw = PyUnicode_AsUTF8AndSize(t, &n);
     if (!raw) return false;
-    return out.u8(BINARY) && out.u32be(uint32_t(n)) && out.put(raw, n);
+    return check_len(n) && out.u8(BINARY) && out.u32be(uint32_t(n)) &&
+           out.put(raw, n);
   }
   if (PyTuple_Check(t)) {
     Py_ssize_t n = PyTuple_GET_SIZE(t);
     if (n < 256) {
       if (!out.u8(SMALL_TUPLE) || !out.u8(uint8_t(n))) return false;
     } else {
-      if (!out.u8(LARGE_TUPLE) || !out.u32be(uint32_t(n))) return false;
+      if (!check_len(n) || !out.u8(LARGE_TUPLE) || !out.u32be(uint32_t(n)))
+        return false;
     }
     for (Py_ssize_t i = 0; i < n; i++) {
       if (!enc(PyTuple_GET_ITEM(t, i), out, depth + 1)) return false;
@@ -223,7 +236,8 @@ bool enc(PyObject *t, Buf &out, int depth) {
   if (PyList_Check(t)) {
     Py_ssize_t n = PyList_GET_SIZE(t);
     if (n == 0) return out.u8(NIL);
-    if (!out.u8(LIST) || !out.u32be(uint32_t(n))) return false;
+    if (!check_len(n) || !out.u8(LIST) || !out.u32be(uint32_t(n)))
+      return false;
     for (Py_ssize_t i = 0; i < n; i++) {
       if (!enc(PyList_GET_ITEM(t, i), out, depth + 1)) return false;
     }
@@ -231,7 +245,8 @@ bool enc(PyObject *t, Buf &out, int depth) {
   }
   if (PyDict_Check(t)) {
     Py_ssize_t n = PyDict_Size(t);
-    if (!out.u8(MAP) || !out.u32be(uint32_t(n))) return false;
+    if (!check_len(n) || !out.u8(MAP) || !out.u32be(uint32_t(n)))
+      return false;
     PyObject *k, *v;
     Py_ssize_t pos = 0;
     while (PyDict_Next(t, &pos, &k, &v)) {

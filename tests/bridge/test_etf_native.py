@@ -173,3 +173,21 @@ def test_unencodable_raises_typeerror():
         native.encode(object())
     with pytest.raises(TypeError):
         py_encode(object())
+
+
+def test_encode_depth_bound_matches_between_codecs():
+    # BOTH encoders refuse past _MAX_DEPTH (a frame nested deeper could
+    # never be decoded by either codec anyway) — an encode-side
+    # divergence here would make program behavior depend on whether the
+    # .so built
+    deep = []
+    for _ in range(600):
+        deep = [deep]
+    with pytest.raises(TypeError, match="deep"):
+        native.encode(deep)
+    with pytest.raises(TypeError, match="deep"):
+        py_encode(deep)
+    ok = []
+    for _ in range(400):
+        ok = [ok]
+    assert native.encode(ok) == py_encode(ok)

@@ -570,6 +570,27 @@ def test_read_any_until_device_parked_default(monkeypatch):
         )
 
 
+def test_read_until_max_rounds_zero_probes_once():
+    # the 'check once, never step' idiom must survive the device-parked
+    # default (the old host default returned the already-met row)
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    store.declare(id="c", type="riak_dt_gcounter")
+    rt = ReplicatedRuntime(store, Graph(store), 8, ring(8, 2))
+    rt.update_at(3, "c", ("increment", 5), "w")
+    row = rt.read_until(3, "c", Threshold(5), max_rounds=0)
+    assert int(row.counts.sum()) == 5
+    with pytest.raises(TimeoutError, match="within 0 rounds"):
+        rt.read_until(0, "c", Threshold(5), max_rounds=0)  # not arrived
+    var, _row = rt.read_any_until(
+        3, [("c", Threshold(5))], max_rounds=0
+    )
+    assert var == "c"
+
+
 def test_late_declared_variable_readable_on_all_paths():
     """A variable declared AFTER the runtime was built is readable via
     every surface — host reads, device-parked reads, coverage, quorum,
