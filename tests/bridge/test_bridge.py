@@ -475,6 +475,47 @@ def test_map_bridge_declare_update_read_roundtrip():
             assert resp[0] == Atom("error")
 
 
+def test_server_survives_malformed_frame_fuzz():
+    """Socket-level robustness: random garbage frames each get an error
+    TERM back (never a dropped connection, never a hang), and the
+    connection keeps serving real ops afterwards — the bridge faces an
+    untrusted network."""
+    import random
+    import socket
+    import struct
+
+    rng = random.Random(42)
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("fuzz")
+            c.declare(b"c", "riak_dt_gcounter", n_actors=4)
+            sock = c._sock
+            for i in range(200):
+                n = rng.randrange(0, 64)
+                payload = bytes(rng.randrange(256) for _ in range(n))
+                if rng.random() < 0.3:  # valid version byte, garbage body
+                    payload = b"\x83" + payload
+                sock.sendall(struct.pack(">I", len(payload)) + payload)
+                hdr = b""
+                while len(hdr) < 4:
+                    chunk = sock.recv(4 - len(hdr))
+                    assert chunk, f"server closed on fuzz frame {i}"
+                    hdr += chunk
+                (rlen,) = struct.unpack(">I", hdr)
+                body = b""
+                while len(body) < rlen:
+                    body += sock.recv(rlen - len(body))
+                resp = __import__(
+                    "lasp_tpu.bridge.etf", fromlist=["decode"]
+                ).decode(body)
+                assert isinstance(resp, tuple) and resp[0] == Atom("error"), (
+                    i, resp,
+                )
+            # the connection still serves real traffic
+            ok, total = c.update(b"c", (Atom("increment"), 7), b"w")
+            assert ok == Atom("ok") and total == 7
+
+
 def test_map_bridge_reset_mode_epochs_roundtrip():
     """reset_on_readd maps over the wire: caps flag parsed, remove-then-
     re-add resets contents, and the portable state carries the epoch

@@ -16,6 +16,11 @@ sets — valid because every CRDT here is a join of its op history:
   (per-actor lanes merge by max, and a row's own increments are
   cumulative, so seen-count == max-merged lane value under the one-home
   actor discipline — which debug_actors enforces as a bonus here).
+- OR-SWOT (the vclock family): the SAME op model as the OR-Set —
+  add-wins observe-remove is add-wins observe-remove whether the
+  implementation carries tombstoned tokens or vclock-dominated dots;
+  a remove kills the adds visible at the removing row, a concurrent
+  (unseen) add survives the merge.
 
 Membership mirrors resize: joins start empty; graceful leaves hand the
 departing rows' op sets to surviving row 0; crash leaves drop them.
@@ -52,17 +57,21 @@ class MeshModel:
         self.seen = [set() for _ in range(n)]
         self.next_id = 0
 
-    def add(self, row, elem):
-        op = ("add", self.next_id, elem)
+    def add(self, row, elem, var="s"):
+        op = ("add", self.next_id, elem, var)
         self.next_id += 1
         self.seen[row].add(op)
 
-    def member(self, row, elem) -> bool:
-        return any(o[0] == "add" and o[2] == elem for o in self.seen[row])
+    def member(self, row, elem, var="s") -> bool:
+        return any(
+            o[0] == "add" and o[2] == elem and o[3] == var
+            for o in self.seen[row]
+        )
 
-    def remove(self, row, elem):
+    def remove(self, row, elem, var="s"):
         killed = frozenset(
-            o[1] for o in self.seen[row] if o[0] == "add" and o[2] == elem
+            o[1] for o in self.seen[row]
+            if o[0] == "add" and o[2] == elem and o[3] == var
         )
         op = ("rm", self.next_id, killed)
         self.next_id += 1
@@ -90,21 +99,22 @@ class MeshModel:
         raise AssertionError("model failed to converge")
 
     @staticmethod
-    def orset_of(seen: set) -> frozenset:
+    def orset_of(seen: set, var="s") -> frozenset:
         killed = set()
         for o in seen:
             if o[0] == "rm":
                 killed |= o[2]
         return frozenset(
-            o[2] for o in seen if o[0] == "add" and o[1] not in killed
+            o[2] for o in seen
+            if o[0] == "add" and o[3] == var and o[1] not in killed
         )
 
     @staticmethod
     def counter_of(seen: set) -> int:
         return sum(o[2] for o in seen if o[0] == "inc")
 
-    def orset_value(self, row) -> frozenset:
-        return self.orset_of(self.seen[row])
+    def orset_value(self, row, var="s") -> frozenset:
+        return self.orset_of(self.seen[row], var)
 
     def counter_value(self, row) -> int:
         return self.counter_of(self.seen[row])
@@ -130,6 +140,8 @@ def test_mesh_statem(seed):
     s = store.declare(id="s", type="lasp_orset", n_elems=len(ELEMS),
                       n_actors=N_ACTORS, tokens_per_actor=32)
     c = store.declare(id="c", type="riak_dt_gcounter", n_actors=N_ACTORS)
+    w = store.declare(id="w", type="riak_dt_orswot", n_elems=len(ELEMS),
+                      n_actors=N_ACTORS)
     rt = ReplicatedRuntime(store, Graph(store), n, nbrs,
                            debug_actors=True, donate_steps=False)
     model = MeshModel(n, nbrs)
@@ -144,21 +156,35 @@ def test_mesh_statem(seed):
         )
         for r in rows:
             assert rt.replica_value(s, r) == model.orset_value(r), r
+            assert rt.replica_value(w, r) == model.orset_value(r, "w"), r
             assert rt.replica_value(c, r) == model.counter_value(r), r
 
     for _step in range(N_OPS):
         roll = rng.random()
         if roll < 0.35:  # client write at a row
             r = rng.randrange(model.n)
+            # half the set traffic targets the OR-Set, half the OR-SWOT:
+            # same observe-remove op model, two very different encodings
+            vid, tag = (s, "s") if rng.random() < 0.5 else (w, "w")
             if rng.random() < 0.5:
                 e = rng.choice(ELEMS)
-                rt.update_at(r, s, ("add", e), actor(r))
-                model.add(r, e)
+                rt.update_at(r, vid, ("add", e), actor(r))
+                model.add(r, e, tag)
             elif rng.random() < 0.6:
                 e = rng.choice(ELEMS)
-                if model.member(r, e):
-                    rt.update_at(r, s, ("remove", e), actor(r))
-                    model.remove(r, e)
+                if tag == "w" and not model.orset_value(r, "w"):
+                    pass  # orswot remove needs liveness (see below)
+                elif tag == "w":
+                    # ORSWOT remove precondition is LIVENESS (dominated
+                    # dots are dropped, not tombstoned) — unlike the
+                    # OR-Set's orddict-membership rule
+                    live = sorted(model.orset_value(r, "w"))
+                    e = rng.choice(live)
+                    rt.update_at(r, vid, ("remove", e), actor(r))
+                    model.remove(r, e, tag)
+                elif model.member(r, e, tag):
+                    rt.update_at(r, vid, ("remove", e), actor(r))
+                    model.remove(r, e, tag)
             else:
                 by = rng.randint(1, 3)
                 rt.update_at(r, c, ("increment", by), actor(r))
@@ -207,6 +233,8 @@ def test_mesh_statem(seed):
     check(rows=range(model.n))
     if all(seen == model.seen[0] for seen in model.seen):
         assert rt.divergence(s) == 0 and rt.divergence(c) == 0
+        assert rt.divergence(w) == 0
     union = set().union(*model.seen)
     assert rt.coverage_value(s) == MeshModel.orset_of(union)
+    assert rt.coverage_value(w) == MeshModel.orset_of(union, "w")
     assert rt.coverage_value(c) == MeshModel.counter_of(union)
