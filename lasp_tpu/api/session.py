@@ -136,6 +136,43 @@ class Session:
         if self.auto_propagate and self.graph.edges:
             self.graph.propagate()
 
+    # -- replication ---------------------------------------------------------
+    def replicate(self, n_replicas: int, neighbors=None, *, topology="ring",
+                  fanout: int = 3, seed: int = 0, packed: bool = False,
+                  **kwargs):
+        """Lift this session onto a replicated population — the one-call
+        path from the single-store verbs to the mesh layer (the
+        reference gets replication implicitly from riak_core; here it is
+        explicit and this is the on-ramp). Current variable state seeds
+        EVERY replica row; the session's dataflow graph becomes the
+        population's per-replica sweep; programs keep working at the
+        session level (register mesh-level programs on the returned
+        runtime). ``neighbors`` overrides ``topology`` (one of ring /
+        random / scale_free) + ``fanout`` + ``seed``; extra kwargs reach
+        :class:`~lasp_tpu.mesh.runtime.ReplicatedRuntime` (``packed``,
+        ``debug_actors``, ``donate_steps``)."""
+        from ..mesh import ReplicatedRuntime
+        from ..mesh.topology import random_regular, ring, scale_free
+
+        if neighbors is None:
+            builder = {
+                "ring": lambda: ring(n_replicas, fanout),
+                "random": lambda: random_regular(n_replicas, fanout,
+                                                 seed=seed),
+                "scale_free": lambda: scale_free(n_replicas, fanout,
+                                                 seed=seed),
+            }.get(topology)
+            if builder is None:
+                raise ValueError(
+                    f"unknown topology {topology!r} "
+                    "(ring | random | scale_free)"
+                )
+            neighbors = builder()
+        return ReplicatedRuntime(
+            self.store, self.graph, n_replicas, neighbors,
+            packed=packed, **kwargs,
+        )
+
     # -- programs (L5, src/lasp_program.erl) ---------------------------------
     def register(self, name: str, program_cls, *args, **kwargs) -> str:
         """``lasp:register/4`` (``src/lasp.erl:84-86``): instantiate a

@@ -106,3 +106,34 @@ def test_thread_runs_function():
     v = s.declare("lasp_gset", n_elems=4)
     s.thread(lambda: s.update(v, ("add", "t"), "thread"))
     assert s.value(v) == frozenset({"t"})
+
+
+def test_session_replicate_on_ramp():
+    # the one-call path from session verbs to the mesh layer: current
+    # state seeds every row, the graph sweeps per replica, mesh verbs work
+    from lasp_tpu.lattice import Threshold
+
+    s = Session(n_actors=8)
+    v = s.declare("lasp_orset", n_elems=8)
+    out = s.map(v, lambda x: x.upper())
+    s.update(v, ("add", "a"), actor="w")
+    rt = s.replicate(64, topology="random", fanout=3, seed=3)
+    # EVERY row is seeded (a pre-gossip check at a far row, not just the
+    # coverage join, which a row-0-only seeding bug would still pass)
+    assert rt.replica_value(out, 63) == {"A"}
+    assert rt.replica_value(v, 63) == {"a"}
+    rt.update_at(5, v, ("add", "b"), "w5")
+    rt.run_to_convergence(max_rounds=32)
+    assert rt.divergence(v) == 0
+    assert rt.coverage_value(out) == {"A", "B"}
+    row = rt.read_until(60, v, Threshold(rt.read_at(5, v)), max_rounds=32)
+    assert row is not None
+
+
+def test_session_replicate_rejects_unknown_topology():
+    import pytest
+
+    s = Session()
+    s.declare("lasp_gset", n_elems=4)
+    with pytest.raises(ValueError, match="unknown topology"):
+        s.replicate(8, topology="hypercube")

@@ -489,6 +489,9 @@ def test_server_survives_malformed_frame_fuzz():
         with BridgeClient("127.0.0.1", server.port) as c:
             c.start("fuzz")
             c.declare(b"c", "riak_dt_gcounter", n_actors=4)
+            from lasp_tpu.bridge import etf
+            from lasp_tpu.bridge.server import _recv_frame
+
             sock = c._sock
             for i in range(200):
                 n = rng.randrange(0, 64)
@@ -496,18 +499,9 @@ def test_server_survives_malformed_frame_fuzz():
                 if rng.random() < 0.3:  # valid version byte, garbage body
                     payload = b"\x83" + payload
                 sock.sendall(struct.pack(">I", len(payload)) + payload)
-                hdr = b""
-                while len(hdr) < 4:
-                    chunk = sock.recv(4 - len(hdr))
-                    assert chunk, f"server closed on fuzz frame {i}"
-                    hdr += chunk
-                (rlen,) = struct.unpack(">I", hdr)
-                body = b""
-                while len(body) < rlen:
-                    body += sock.recv(rlen - len(body))
-                resp = __import__(
-                    "lasp_tpu.bridge.etf", fromlist=["decode"]
-                ).decode(body)
+                body = _recv_frame(sock)  # the REAL framing reader
+                assert body is not None, f"server closed on fuzz frame {i}"
+                resp = etf.decode(body)
                 assert isinstance(resp, tuple) and resp[0] == Atom("error"), (
                     i, resp,
                 )
