@@ -246,7 +246,15 @@ class PairwiseEdge(Edge):
         rr = (right.removed & right.exists)[inv_r] & valid_r[:, None]
         if self.kind == "union":
             # left-biased orddict:merge: a shared element's contribution
-            # carries only the left tokens (src/lasp_core.erl:616-621)
+            # carries only the left tokens (src/lasp_core.erl:616-621).
+            # Observable consequence, faithful to the reference: right
+            # tokens flow into the (monotone) output only while the
+            # element is ABSENT from the left dict; once it appears
+            # there, later right-side REMOVALS never reach the output —
+            # the right-live state freezes as of the last propagation
+            # where the element was left-absent. The dataflow statem
+            # (tests/dataflow/test_dataflow_statem.py) pins this exact
+            # semantics against a snapshot-based oracle.
             lmember = jnp.any(le, axis=-1, keepdims=True)
             exists = jnp.concatenate([le, re_ & ~lmember], axis=-1)
             removed = jnp.concatenate([lr, rr & ~lmember], axis=-1)

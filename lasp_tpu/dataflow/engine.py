@@ -39,6 +39,12 @@ class PairUniverse:
         return len(self.l_elems) * len(self.r_elems)
 
     def __contains__(self, term) -> bool:
+        # a non-pair term is simply not a member: edges probe membership
+        # with arbitrary terms (e.g. an intersection between a product
+        # output and a plain set offers the plain set's elements here —
+        # caught by the dataflow statem, which crashed on the unpack)
+        if not (isinstance(term, tuple) and len(term) == 2):
+            return False
         x, y = term
         return x in self.l_elems and y in self.r_elems
 

@@ -1,0 +1,228 @@
+"""Dataflow statem: RANDOM combinator pipelines under add/remove churn
+against a REFERENCE-FAITHFUL oracle — the property tier above the fixed
+riak_test pipelines (test_combinators.py): at every propagated fixed
+point, every derived variable's live value equals the oracle's
+prediction, no matter what causal machinery (tokens, pair universes,
+tombstone flow) produced it.
+
+The oracle models Lasp's combinators, not clean set algebra — building
+it surfaced exactly the corners that differ:
+
+- ``union`` is LEFT-BIASED (``orddict:merge`` keeping left,
+  ``src/lasp_core.erl:616-621``): right-side tokens flow into the
+  monotone output only while the element is absent from the left DICT
+  (live or tombstoned); once it appears there, later right-side
+  removals are invisible — the right-live state freezes as of the last
+  propagate where the element was left-absent. The oracle tracks
+  per-propagate source snapshots to evaluate that frozen state.
+- ``intersection`` gates on membership in BOTH dicts but its causality
+  is the UNION of both token dicts (``src/lasp_lattice.erl:311-312``):
+  the output element is live iff live on EITHER side — removing it from
+  just one input does not remove it from the intersection.
+- ``product`` pairs are live iff both coordinates are live
+  (``deleted = XDel orelse YDel``) — clean algebra.
+- ``map``/``fold``/``filter`` preserve causality per element image —
+  clean algebra over live values; dict membership flows through images.
+
+Union LEFT inputs are restricted to source variables in the random DAG:
+for a derived left, the freeze point shifts by one propagation round
+(membership computed from pre-round state), which the per-propagate
+snapshot oracle cannot see. Rights are unrestricted, including chained
+unions (the freeze rule recurses through snapshots)."""
+
+import os
+import random
+
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.store import Store
+
+N_SEEDS = int(os.environ.get("LASP_STATEM_SEEDS", "8"))
+N_OPS = int(os.environ.get("LASP_STATEM_OPS", "40"))
+DOMAIN = list(range(6))
+
+FNS = {
+    "x7": lambda x: (x * 7) % 11,
+    "neg": lambda x: -x,
+    "dup": lambda x: [x, x + 10],
+    "even": lambda x: (x if isinstance(x, int) else hash(x)) % 2 == 0,
+    "small": lambda x: (x if isinstance(x, int) else hash(x)) % 3 != 0,
+}
+
+
+class Oracle:
+    """Evaluates live(node, t) and member(node, t) — the live value and
+    the dict key set of any DAG node at propagate-snapshot ``t`` — from
+    the recorded per-propagate source snapshots."""
+
+    def __init__(self):
+        #: per propagate: {src: (frozenset live, frozenset ever)}
+        self.snaps: list = []
+
+    def snapshot(self, live, ever):
+        self.snaps.append(
+            {s: (frozenset(live[s]), frozenset(ever[s])) for s in live}
+        )
+
+    def live(self, node, t) -> frozenset:
+        kind = node[0]
+        if kind == "src":
+            return self.snaps[t][node[1]][0]
+        if kind == "map":
+            return frozenset(FNS[node[1]](x) for x in self.live(node[2], t))
+        if kind == "fold":
+            out = set()
+            for x in self.live(node[2], t):
+                out.update(FNS[node[1]](x))
+            return frozenset(out)
+        if kind == "filter":
+            return frozenset(
+                x for x in self.live(node[2], t) if FNS[node[1]](x)
+            )
+        if kind == "union":
+            l, r = node[1], node[2]
+            out = set(self.live(l, t))
+            for e in self.member(r, t):
+                # freeze point: the last propagate at-or-before t where e
+                # was absent from the LEFT dict; right-live flows only
+                # through those propagates (left-biased merge)
+                pk = None
+                for tt in range(t, -1, -1):
+                    if e not in self.member(l, tt):
+                        pk = tt
+                        break
+                if pk is not None and e in self.live(r, pk):
+                    out.add(e)
+            return frozenset(out)
+        if kind == "intersection":
+            both = self.member(node[1], t) & self.member(node[2], t)
+            either_live = self.live(node[1], t) | self.live(node[2], t)
+            return frozenset(both & either_live)
+        if kind == "product":
+            return frozenset(
+                (a, b)
+                for a in self.live(node[1], t)
+                for b in self.live(node[2], t)
+            )
+        if kind == "bind_to":
+            return self.live(node[1], t)
+        raise AssertionError(kind)
+
+    def member(self, node, t) -> frozenset:
+        kind = node[0]
+        if kind == "src":
+            return self.snaps[t][node[1]][1]
+        if kind == "map":
+            return frozenset(
+                FNS[node[1]](x) for x in self.member(node[2], t)
+            )
+        if kind == "fold":
+            out = set()
+            for x in self.member(node[2], t):
+                out.update(FNS[node[1]](x))
+            return frozenset(out)
+        if kind == "filter":
+            return frozenset(
+                x for x in self.member(node[2], t) if FNS[node[1]](x)
+            )
+        if kind == "union":
+            return self.member(node[1], t) | self.member(node[2], t)
+        if kind == "intersection":
+            return self.member(node[1], t) & self.member(node[2], t)
+        if kind == "product":
+            return frozenset(
+                (a, b)
+                for a in self.member(node[1], t)
+                for b in self.member(node[2], t)
+            )
+        if kind == "bind_to":
+            return self.member(node[1], t)
+        raise AssertionError(kind)
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_dataflow_statem(seed):
+    rng = random.Random(seed)
+    store = Store(n_actors=4)
+    graph = Graph(store)
+
+    sources, live, ever = [], {}, {}
+    for i in range(3):
+        vid = store.declare(id=f"src{i}", type="lasp_orset", n_elems=16,
+                            tokens_per_actor=max(16, N_OPS))
+        sources.append(vid)
+        live[vid] = set()
+        ever[vid] = set()
+
+    def has_product(node):
+        return node[0] == "product" or any(
+            has_product(x) for x in node[1:] if isinstance(x, tuple)
+        )
+
+    nodes = {vid: ("src", vid) for vid in sources}
+    ids = list(sources)
+    for j in range(rng.randint(3, 6)):
+        kind = rng.choice(
+            ["map", "fold", "filter", "union", "intersection", "product",
+             "bind_to"]
+        )
+        a = rng.choice(ids)
+        if kind in ("map", "fold") and has_product(nodes[a]):
+            # map/fold token spaces are S*T of their input; over a
+            # product (whose token space is already Tl*Tr) the widths
+            # multiply into OOM territory at soak op budgets — only
+            # token-width-preserving edges consume products
+            a = rng.choice(sources)
+        if kind == "map":
+            fn = rng.choice(["x7", "neg"])
+            out = graph.map(a, FNS[fn], dst=f"d{j}", dst_elems=64)
+            nodes[out] = ("map", fn, nodes[a])
+        elif kind == "fold":
+            out = graph.fold(a, FNS["dup"], dst=f"d{j}", dst_elems=64)
+            nodes[out] = ("fold", "dup", nodes[a])
+        elif kind == "filter":
+            fn = rng.choice(["even", "small"])
+            out = graph.filter(a, FNS[fn], dst=f"d{j}")
+            nodes[out] = ("filter", fn, nodes[a])
+        elif kind == "bind_to":
+            out = graph.bind_to(f"d{j}", a)
+            nodes[out] = ("bind_to", nodes[a])
+        elif kind == "union":
+            left = rng.choice(sources)  # see module docstring
+            out = graph.union(left, a, dst=f"d{j}")
+            nodes[out] = ("union", nodes[left], nodes[a])
+        else:
+            b = rng.choice(ids)
+            if kind == "product":
+                # products multiply token widths: sources only
+                a, b = rng.choice(sources), rng.choice(sources)
+            out = getattr(graph, kind)(a, b, dst=f"d{j}")
+            nodes[out] = (kind, nodes[a], nodes[b])
+        ids.append(out)
+
+    oracle = Oracle()
+
+    def check():
+        graph.propagate()
+        oracle.snapshot(live, ever)
+        t = len(oracle.snaps) - 1
+        for vid, node in nodes.items():
+            assert store.value(vid) == oracle.live(node, t), (
+                seed, vid, node,
+            )
+
+    for _step in range(N_OPS):
+        src = rng.choice(sources)
+        if live[src] and rng.random() < 0.3:
+            e = rng.choice(sorted(live[src]))
+            store.update(src, ("remove", e), "w")
+            live[src].discard(e)
+        else:
+            e = rng.choice(DOMAIN)
+            store.update(src, ("add", e), "w")
+            live[src].add(e)
+            ever[src].add(e)
+        if rng.random() < 0.5:
+            check()
+    check()
