@@ -87,7 +87,31 @@ def test_store_statem(seed):
     store = Store(n_actors=len(ACTORS))
     models: dict = {}
     watches: list = []  # (watch, vid, thr)
+    #: lazy wait_needed watches on G-Counters: (watch, vid, bound|None)
+    #: where None = the default {strict, bottom} wait. Laziness fires
+    #: ONLY on reader interest (or at creation when already met / a
+    #: reader is parked) — _write never wakes the lazy list
+    #: (src/lasp_core.erl:728-758 + the reply_to_all wait clause)
+    lazies: list = []
     counter = 0
+
+    def parked_reader(vid) -> bool:
+        return any(
+            v == vid and not met(models[vid], thr) for _w, v, thr in watches
+        )
+
+    def offer_to_lazy(vid, r_bound, r_strict):
+        # the reply_to_all wait-coverage rule, numeric form
+        # (store._wait_covered): default wait fires on any read; a
+        # bounded wait fires when the read asks for no more than it
+        for entry in lazies:
+            if entry["vid"] != vid or entry["expected"]:
+                continue
+            bound = entry["bound"]
+            if bound is None or (
+                r_bound < bound if r_strict else r_bound <= bound
+            ):
+                entry["expected"] = True
 
     def check_watches():
         for w, vid, thr in watches:
@@ -95,6 +119,11 @@ def test_store_statem(seed):
             assert w.done == should, (
                 f"watch on {vid} thr={thr}: done={w.done}, model says "
                 f"{should}"
+            )
+        for entry in lazies:
+            assert entry["watch"].done == entry["expected"], (
+                f"lazy wait on {entry['vid']} bound={entry['bound']}: "
+                f"done={entry['watch'].done}"
             )
 
     for step in range(N_OPS):
@@ -159,6 +188,20 @@ def test_store_statem(seed):
             # current, not an inflation -> bind must change NOTHING
             # (src/lasp_core.erl:305-311; lasp_eqc bind_ok/bind_next)
             store.bind(vid, prev)
+        elif roll < 0.78 and tname == "riak_dt_gcounter":
+            # wait_needed (laziness): fires at creation when already met
+            # or a reader is parked; later ONLY via reader interest
+            total = sum(model.counts.values())
+            if rng.random() < 0.4:
+                bound = None
+                w = store.wait_needed(vid)
+                already = total > 0 or parked_reader(vid)
+            else:
+                bound = rng.randint(1, total + 3)
+                w = store.wait_needed(vid, Threshold(bound))
+                already = total >= bound or parked_reader(vid)
+            lazies.append({"watch": w, "vid": vid, "bound": bound,
+                           "expected": already})
         else:  # threshold read
             if tname == "riak_dt_gcounter":
                 total = sum(model.counts.values())
@@ -166,6 +209,7 @@ def test_store_statem(seed):
                 bound = rng.randint(0, total + 3)
                 thr = ("count", bound, strict)
                 w = store.read(vid, Threshold(bound, strict=strict))
+                offer_to_lazy(vid, bound, strict)
             elif tname == "lasp_ivar":
                 thr = ("defined", None, True)
                 w = store.read(vid, Threshold(None, strict=True))
