@@ -379,8 +379,21 @@ def test_cli_simulate_rejects_unsupported_type(capsys):
     from lasp_tpu.cli import main
 
     with pytest.raises(SystemExit) as exc:
-        main(["simulate", "--type", "riak_dt_gcounter", "--replicas", "8"])
+        main(["simulate", "--type", "lasp_ivar", "--replicas", "8"])
     assert exc.value.code == 2
+
+
+def test_cli_simulate_gcounter(capsys):
+    import json as _json
+
+    from lasp_tpu.cli import main
+
+    rc = main(["simulate", "--type", "riak_dt_gcounter", "--replicas", "32",
+               "--writers", "4", "--topology", "ring"])
+    assert rc == 0
+    out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # one increment per writer lane, max-merged across the population
+    assert out["value_size"] == 4
 
 
 def test_pylog_fallback_compact_and_keys(tmp_path):
