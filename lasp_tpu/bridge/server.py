@@ -45,9 +45,11 @@ terms; tokens are integers into the declared token space):
   dots; no tombstones, no deferred ops)
 - ``riak_dt_map``: ``{[{Actor, Count}, ...],
   [{Key, [{Actor, Dot}, ...], InnerState}, ...]}`` — one triple per
-  STATIC schema field (declare with caps
-  ``#{fields => [{Key, TypeAtom, Caps}, ...]}``); presence dots follow
-  OR-SWOT logic, ``InnerState`` is the field type's own portable shape.
+  schema field. The schema is DYNAMIC like the reference's: ``{Name,
+  Type}`` keys admit on first update or on state import (declaring caps
+  ``#{fields => [{Key, TypeAtom, Caps}, ...]}`` is pre-sizing only);
+  presence dots follow OR-SWOT logic, ``InnerState`` is the field
+  type's own portable shape.
   Values read back as proplists ``[{Key, Value}, ...]``
   (``riak_dt_map:value`` shape). Map update ops:
   ``{update, Key, InnerOp}``, ``{remove, Key}``, or the batched
@@ -104,7 +106,7 @@ def _convert_op(op: tuple) -> tuple:
 
 def _parse_caps(caps) -> dict:
     """Wire caps -> declare kwargs. Scalar capacities pass as ints; a
-    ``fields`` entry (riak_dt_map static schema) is a list of
+    ``fields`` entry (riak_dt_map pre-sized schema) is a list of
     ``{Key, TypeAtom, Caps}`` triples, recursively parsed."""
     kwargs = {}
     for k, v in (caps or {}).items():
@@ -248,8 +250,7 @@ def _export_state(var, state=None) -> Any:
             ))
         return (clock_part, entries)
     if tn == "riak_dt_map":
-        # {VClock, Fields}: per schema field (STATIC schema — the dense
-        # divergence documented in lattice/map.py) a (key, presence-dots,
+        # {VClock, Fields}: per schema field a (key, presence-dots,
         # embedded-portable) triple. Embedded contents ride even for
         # absent fields: they are join-monotone across remove/re-add
         # here, so a faithful round-trip must carry them. reset_on_readd
@@ -331,11 +332,32 @@ def _validate_portable(var, portable: Any) -> None:
             var.elems, [_to_key(e) for e, _d in entries], "elem"
         )
     elif tn == "riak_dt_map":
+        from ..store.store import Store
+
         parts = _split_map_portable(var, portable)
         clock_part, fields_part, epoch_part = parts
         pclock = {_to_key(a): int(c) for a, c in clock_part}
+        # dynamic schema: an incoming state may carry {Name, Type} fields
+        # this node has never admitted (the reference merges fields it has
+        # never seen). Resolve them FIRST and validate their contents
+        # against detached temporary shims; the schema grows only at the
+        # end, after the WHOLE state checks out — a rejected state must
+        # not leave a half-grown schema (same no-capacity-consumed
+        # contract as the interner rule above).
+        known = {k for k, _c, _s in spec.fields}
+        fresh, fresh_shims = [], {}
+        for key in [k for k, _fd, _i in fields_part] + [
+            k for k, _e in epoch_part
+        ]:
+            k = _to_key(key)
+            if k not in known and k not in fresh_shims:
+                triple = Store.resolve_dynamic_field(spec, k)
+                fresh.append(triple)
+                fresh_shims[k] = Store._field_shim(
+                    var.id, k, triple[1], triple[2], var
+                )
         for key, fdots, inner in fields_part:
-            f = spec.field_index(_to_key(key))  # KeyError if unknown field
+            k = _to_key(key)
             for actor, count in fdots:
                 seen = pclock.get(_to_key(actor), 0)
                 if int(count) < 1 or int(count) > seen:
@@ -343,22 +365,31 @@ def _validate_portable(var, portable: Any) -> None:
                         f"field dot ({actor!r}, {int(count)}) outside the "
                         f"state's own clock ({seen}) — not a valid map state"
                     )
-            _validate_portable(var.map_aux[f], inner)
+            shim = fresh_shims.get(k)
+            if shim is None:
+                shim = var.map_aux[spec.field_index(k)]
+            _validate_portable(shim, inner)
         for key, epoch in epoch_part:
-            spec.field_index(_to_key(key))  # KeyError if unknown field
             if int(epoch) < 0:
                 raise ValueError(f"negative field epoch for {key!r}")
         _check_capacity(var.actors, pclock, "actor")
+        if fresh:
+            # everything validated: admit for real (bottom fields, no
+            # observable change until the import lands)
+            Store.grow_map_fields(var, fresh)
 
 
 def _import_state(var, portable: Any, *, _validated: bool = False):
     import jax.numpy as jnp
 
     tn = var.type_name
+    if not _validated:
+        # may ADMIT dynamic map fields (growing var.spec) — read the spec
+        # only afterwards so the imported state is laid out for the grown
+        # schema
+        _validate_portable(var, portable)
     spec = var.spec
     state = var.codec.new(spec)
-    if not _validated:
-        _validate_portable(var, portable)
     if tn == "lasp_gset":
         idx = [var.elems.intern(_to_key(e)) for e in (portable or [])]
         if idx:

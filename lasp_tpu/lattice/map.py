@@ -9,10 +9,16 @@ keys to embedded CRDTs plus presence dots; ``{update, [{update, Key, Op} |
 logic over keys plus per-field embedded merge; inflation = clock descends,
 strict inflation = dominating clock or equal clocks with removed fields.
 
-Dense encoding: the field *schema is static* — a ``MapSpec`` fixes the
-ordered tuple of (key, embedded codec, embedded spec) — so a Map state is
-``clock: int32[A]``, ``dots: int32[F, A]`` (presence, exactly the ORSWOT
-dot matrix over field slots) and a tuple of embedded states.
+Dense encoding: a ``MapSpec`` holds the ordered tuple of (key, embedded
+codec, embedded spec) — so a Map state is ``clock: int32[A]``, ``dots:
+int32[F, A]`` (presence, exactly the ORSWOT dot matrix over field slots)
+and a tuple of embedded states. The schema is *dynamic the way the
+reference's is* (``riak_dt_map`` admits ``{Name, Type}`` keys on first
+update, ``riak_test/lasp_kvs_replica_test.erl:57-135``): the store layer
+admits unknown keys by growing the field axis — a new spec with the field
+appended plus :meth:`CrdtMap.grow` to append bottom slots to live states
+(the same grow-then-re-layout move interners use for element universes).
+Declaring fields up front remains a pre-sizing fast path, not a fence.
 
 Remove/re-add semantics — two modes:
 
@@ -48,7 +54,8 @@ from .dots import clock_inflation, merge_dots, mint_dot, strict_clock_inflation
 
 @dataclasses.dataclass(frozen=True)
 class MapSpec:
-    #: ordered static schema: ((key, codec_cls, embedded_spec), ...)
+    #: ordered schema: ((key, codec_cls, embedded_spec), ...) — grows via
+    #: ``with_fields`` when the store admits a key on first update
     fields: tuple
     n_actors: int
     #: riak_dt re-add semantics: remove resets embedded contents via a
@@ -60,10 +67,27 @@ class MapSpec:
         return len(self.fields)
 
     def field_index(self, key) -> int:
-        for i, (k, _c, _s) in enumerate(self.fields):
-            if k == key:
-                return i
-        raise KeyError(f"riak_dt_map: unknown field {key!r} (static schema)")
+        # lazy key->slot dict (dynamic admission makes F unbounded, and
+        # the batch paths look up per sub-op): cached in __dict__ via
+        # object.__setattr__ — derived data, not dataclass state, and
+        # with_fields/replace build fresh instances so it never goes stale
+        idx = self.__dict__.get("_key_index")
+        if idx is None:
+            idx = {k: i for i, (k, _c, _s) in enumerate(self.fields)}
+            object.__setattr__(self, "_key_index", idx)
+        try:
+            return idx[key]
+        except (KeyError, TypeError):
+            raise KeyError(
+                f"riak_dt_map: unknown field {key!r} (dynamic admission "
+                "requires (name, type_name) keys, riak_dt_map's {Name, Type})"
+            ) from None
+
+    def with_fields(self, new_fields) -> "MapSpec":
+        """A grown spec with ``new_fields`` ((key, codec, espec) triples)
+        appended in order — existing field indices are preserved, so live
+        states migrate by appending bottom slots (:meth:`CrdtMap.grow`)."""
+        return dataclasses.replace(self, fields=self.fields + tuple(new_fields))
 
 
 def _resets(spec: MapSpec) -> bool:
@@ -105,6 +129,41 @@ class CrdtMap(CrdtType):
                 else None
             ),
         )
+
+    @staticmethod
+    def grow(spec: MapSpec, state: MapState) -> MapState:
+        """Migrate a state laid out for a field-prefix of ``spec`` by
+        appending bottom slots for the new fields (admitted keys carry no
+        presence dots and bottom contents, so growth is observably a
+        no-op until the first update lands). Works on any leading batch
+        axes — the mesh layer grows whole replica populations in place."""
+        f_old = state.dots.shape[-2]
+        f_new = spec.n_fields
+        if f_new == f_old:
+            return state
+        batch = state.dots.shape[:-2]
+        dots = jnp.concatenate(
+            [
+                state.dots,
+                jnp.zeros(batch + (f_new - f_old, spec.n_actors), state.dots.dtype),
+            ],
+            axis=-2,
+        )
+        fields = list(state.fields)
+        for _k, codec, espec in spec.fields[f_old:]:
+            bottom = codec.new(espec)
+            if batch:
+                bottom = jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x, batch + x.shape), bottom
+                )
+            fields.append(bottom)
+        epochs = state.epochs
+        if epochs is not None:
+            epochs = jnp.concatenate(
+                [epochs, jnp.zeros(batch + (f_new - f_old,), epochs.dtype)],
+                axis=-1,
+            )
+        return state._replace(dots=dots, fields=tuple(fields), epochs=epochs)
 
     # -- updates ------------------------------------------------------------
     @staticmethod

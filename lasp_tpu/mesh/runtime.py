@@ -458,6 +458,12 @@ class ReplicatedRuntime:
         ``debug_actors=True`` to turn that misuse into a loud
         :class:`ActorCollisionError` at the second write site."""
         var = self.store.variable(var_id)
+        if var.type_name == "riak_dt_map" and self.store.admit_map_fields(
+            var, op
+        ):
+            # dynamic field admission grew the field axis: re-layout the
+            # population before gathering this replica's row
+            self._grow_map_population(var)
         # boolean on purpose: the commit below re-derives keys AFTER the
         # apply interns the actor (picking up the ("lane", idx) alias);
         # reusing the pre-intern keys here would drop it
@@ -518,8 +524,23 @@ class ReplicatedRuntime:
             for r, op, actor in ops
         ]
         var = self.store.variable(var_id)
-        states = self._population(var_id)
         tn = var.type_name
+        if tn == "riak_dt_map":
+            # dynamic schema: pre-admit every first-touched field key in the
+            # batch and re-layout the population ONCE. Sound because
+            # admission is observably a no-op until its update lands (bottom
+            # fields carry no presence) — the per-op loop's
+            # admit-at-first-touch yields byte-identical observable state.
+            # Two-phase on purpose: the scan validates EVERY op's keys
+            # before anything mutates, so a malformed key later in the
+            # batch raises with spec and population still in lock-step.
+            fresh = self.store.scan_map_admissions(
+                var, (op for _r, op, _a in ops)
+            )
+            if fresh:
+                self.store.grow_map_fields(var, fresh)
+                self._grow_map_population(var)
+        states = self._population(var_id)
         if not ops:
             return
         # guard BEFORE any mutation: a debug-mode violation is a
@@ -914,6 +935,17 @@ class ReplicatedRuntime:
             seen.add(key)
         return len(items), None
 
+    def _grow_map_population(self, var) -> None:
+        """Re-layout a map's replica population after dynamic field
+        admission (``store.admit_map_fields``): append bottom planes for
+        the new fields and drop compiled executables — the cached steps
+        traced the old field-axis shapes."""
+        from ..lattice.map import CrdtMap
+
+        self.states[var.id] = CrdtMap.grow(var.spec, self.states[var.id])
+        self._step = None
+        self._fused_steps_cache.clear()
+
     def _map_batch(self, var, states, ops):
         """Vectorized riak_dt_map batch with SEQUENTIAL, PER-OP-ATOMIC
         semantics: presence dots are host-simulated over the touched rows
@@ -982,8 +1014,14 @@ class ReplicatedRuntime:
                         )
                     flat.append((k, r, ("update", f, inner), actor))
                 elif sub[0] == "remove" and len(sub) == 2:
-                    f = spec.field_index(sub[1])
-                    flat.append((k, r, ("remove", f), actor))
+                    try:
+                        f = spec.field_index(sub[1])
+                    except KeyError:
+                        # a never-admitted field is absent: not_present at
+                        # this op's position in the sequence (pass 1), not
+                        # a batch-level schema error
+                        f = -1
+                    flat.append((k, r, ("remove", f, sub[1]), actor))
                 else:
                     raise ValueError(
                         f"update_batch: unsupported map op {sub!r}"
@@ -1017,9 +1055,8 @@ class ReplicatedRuntime:
                 t = row_of[r]
                 if sub[0] == "remove":
                     f = sub[1]
-                    if not (local_dots[t, f] > 0).any():
-                        key = spec.fields[f][0]
-                        err = PreconditionError(f"not_present: {key!r}")
+                    if f < 0 or not (local_dots[t, f] > 0).any():
+                        err = PreconditionError(f"not_present: {sub[2]!r}")
                         break
                     undo.append((t, f, local_dots[t, f].copy(), None, None))
                     local_dots[t, f] = 0

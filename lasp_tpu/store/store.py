@@ -75,39 +75,87 @@ ALLOWED_CAPS = {
 }
 
 
+def resolve_map_field(key, type_name: str, caps, n_actors: int) -> tuple:
+    """``(key, codec, espec)`` for ONE map field — the single validation
+    path shared by declared schemas (:func:`build_map_spec`) and dynamic
+    admission (:meth:`Store.admit_map_fields`), so both reject the same
+    misuses with the same exception types."""
+    caps = dict(caps or {})
+    if type_name == "riak_dt_map":
+        raise TypeError(
+            f"map field {key!r}: nested riak_dt_map fields are not "
+            "supported (flatten the schema)"
+        )
+    if type_name not in ALLOWED_CAPS:
+        raise TypeError(f"map field {key!r}: unknown type {type_name!r}")
+    unknown = set(caps) - ALLOWED_CAPS[type_name]
+    if unknown:
+        raise TypeError(
+            f"map field {key!r} ({type_name}): unknown capacity kwargs "
+            f"{sorted(unknown)} (allowed: {sorted(ALLOWED_CAPS[type_name])})"
+        )
+    if "n_actors" in ALLOWED_CAPS[type_name]:
+        # embedded writer width must EQUAL the map's: field shims share
+        # the map's actor interner (field dots and embedded actor slots
+        # name the same actors), so a narrower embedded state would turn
+        # overflow into a silently-dropped out-of-bounds scatter
+        if caps.get("n_actors", n_actors) != n_actors:
+            raise TypeError(
+                f"map field {key!r}: n_actors must match the map's "
+                f"({n_actors}); per-field writer universes are not "
+                "separable from the map clock"
+            )
+        caps["n_actors"] = n_actors
+    return (key, get_type(type_name), DEFAULT_SPECS[type_name](**caps))
+
+
+def map_key_type_name(key) -> "str | None":
+    """The embedded type a map field key self-describes, or None.
+
+    The reference's field keys are ``{Name, Type}`` pairs (``riak_dt_map``
+    keys, ``riak_test/lasp_kvs_replica_test.erl:57-58``) — the key itself
+    names the embedded type, which is what makes schemaless admission
+    well-defined. Two encodings carry that pair here:
+
+    - native callers: ``(name, "type_name")`` — a 2-tuple whose second
+      element is a type-name string;
+    - the ETF bridge's tagged terms (``bridge/server.py _to_key``):
+      ``("tuple", <name_key>, ("atom", "type_name"))``.
+
+    A bare tagged atom ``("atom", x)`` is NOT a pair and never admits
+    (it would otherwise be misread as name="atom")."""
+    if (
+        isinstance(key, tuple)
+        and len(key) == 2
+        and isinstance(key[1], str)
+        and key[0] != "atom"
+    ):
+        return key[1]
+    if (
+        isinstance(key, tuple)
+        and len(key) == 3
+        and key[0] == "tuple"
+        and isinstance(key[2], tuple)
+        and len(key[2]) == 2
+        and key[2][0] == "atom"
+    ):
+        return str(key[2][1])
+    return None
+
+
 def build_map_spec(fields, n_actors: int, reset_on_readd: bool = False) -> MapSpec:
-    """Build a static Map schema from ``[(key, type_name, caps_dict), ...]``
-    (the dense analogue of riak_dt_map's dynamic ``{Name, Type}`` keys —
-    fields are declared up front so shapes stay fixed)."""
-    resolved = []
-    for key, type_name, caps in fields:
-        caps = dict(caps or {})
-        if type_name == "riak_dt_map":
-            raise TypeError(
-                f"map field {key!r}: nested riak_dt_map fields are not "
-                "supported (flatten the schema)"
-            )
-        if type_name not in ALLOWED_CAPS:
-            raise TypeError(f"map field {key!r}: unknown type {type_name!r}")
-        unknown = set(caps) - ALLOWED_CAPS[type_name]
-        if unknown:
-            raise TypeError(
-                f"map field {key!r} ({type_name}): unknown capacity kwargs "
-                f"{sorted(unknown)} (allowed: {sorted(ALLOWED_CAPS[type_name])})"
-            )
-        if "n_actors" in ALLOWED_CAPS[type_name]:
-            # embedded writer width must EQUAL the map's: field shims share
-            # the map's actor interner (field dots and embedded actor slots
-            # name the same actors), so a narrower embedded state would turn
-            # overflow into a silently-dropped out-of-bounds scatter
-            if caps.get("n_actors", n_actors) != n_actors:
-                raise TypeError(
-                    f"map field {key!r}: n_actors must match the map's "
-                    f"({n_actors}); per-field writer universes are not "
-                    "separable from the map clock"
-                )
-            caps["n_actors"] = n_actors
-        resolved.append((key, get_type(type_name), DEFAULT_SPECS[type_name](**caps)))
+    """Build a Map schema from ``[(key, type_name, caps_dict), ...]``.
+
+    Declaring fields up front is a PRE-SIZING fast path (custom embedded
+    capacities, no mid-run re-layout), not a fence: unknown ``(name,
+    type_name)`` keys are admitted on first update exactly like the
+    reference's ``riak_dt_map`` ``{Name, Type}`` keys
+    (``riak_test/lasp_kvs_replica_test.erl:57-135`` updates keys never
+    declared anywhere) — see :meth:`Store.admit_map_fields`."""
+    resolved = [
+        resolve_map_field(key, type_name, caps, n_actors)
+        for key, type_name, caps in fields
+    ]
     return MapSpec(
         fields=tuple(resolved),
         n_actors=n_actors,
@@ -262,6 +310,89 @@ class Store:
             shim.ivar_payloads = Interner(2**31 - 1, kind="ivar payload")
         return shim
 
+    # -- dynamic map fields ---------------------------------------------------
+    @staticmethod
+    def resolve_dynamic_field(spec: MapSpec, key):
+        """(key, codec, espec) for a key being admitted on first touch.
+        Admission requires a self-describing ``{Name, Type}`` key (see
+        :func:`map_key_type_name`); capacities are the declare-time
+        defaults — pre-declare the field for custom sizing. Validation is
+        shared with the declared-schema path (:func:`resolve_map_field`),
+        so the same misuse raises the same exception either way."""
+        type_name = map_key_type_name(key)
+        if type_name is None:
+            raise KeyError(
+                f"riak_dt_map: unknown field {key!r}; admission on first "
+                "update requires (name, type_name) keys (riak_dt_map's "
+                "{Name, Type}) — or pre-declare the field"
+            )
+        return resolve_map_field(key, type_name, None, spec.n_actors)
+
+    @classmethod
+    def scan_map_admissions(cls, var: Variable, ops) -> list:
+        """Validate-only pass: the ``(key, codec, espec)`` triples for
+        every unknown field key that the update subs of ``ops`` (an
+        iterable of map client ops) touch for the first time. Raises on
+        any non-admissible key WITHOUT mutating anything — callers grow
+        atomically afterwards (:meth:`grow_map_fields`), so a bad op later
+        in a batch can never leave the spec half-grown. Removes never
+        admit — removing an absent field is a precondition error, not a
+        creation."""
+        from ..lattice.map import map_subs
+
+        spec = var.spec
+        known = {k for k, _c, _s in spec.fields}
+        fresh, seen = [], set()
+        for op in ops:
+            for sub in map_subs(op):
+                if not (
+                    isinstance(sub, tuple)
+                    and len(sub) == 3
+                    and sub[0] == "update"
+                ):
+                    continue  # removes / malformed: the normal path rules
+                key = sub[1]
+                if key in known or key in seen:
+                    continue
+                fresh.append(cls.resolve_dynamic_field(spec, key))
+                seen.add(key)
+        return fresh
+
+    def admit_map_fields(self, var: Variable, op: tuple) -> int:
+        """Admit unknown map field keys touched by ``op``'s updates (the
+        reference's dynamic schema: ``riak_dt_map`` creates a field the
+        first time ``{update, Key, Op}`` names it). Returns how many fields
+        were admitted; 0 means the layout is unchanged. Admission is
+        observably a no-op until the update itself lands (a fresh field
+        has no presence dots), so batch layers may pre-admit a whole batch
+        up front without changing sequential semantics."""
+        fresh = self.scan_map_admissions(var, (op,))
+        if not fresh:
+            return 0
+        self.grow_map_fields(var, fresh)
+        return len(fresh)
+
+    @classmethod
+    def grow_map_fields(cls, var: Variable, fresh: list) -> None:
+        """Append admitted fields: new spec, state migration (bottom slots),
+        per-field shims, and parked watch thresholds re-laid-out so
+        ``threshold_met`` keeps comparing same-shaped states. Static so
+        state-import layers (the ETF bridge) can admit against a bare
+        Variable."""
+        from ..lattice.map import CrdtMap, MapState
+
+        var.spec = var.spec.with_fields(fresh)
+        if var.state is not None:
+            var.state = CrdtMap.grow(var.spec, var.state)
+        for key, fcodec, fspec in fresh:
+            var.map_aux.append(cls._field_shim(var.id, key, fcodec, fspec, var))
+        for watch in list(var.waiting) + list(var.lazy):
+            thr = watch.threshold
+            if thr is not None and isinstance(thr.state, MapState):
+                watch.threshold = Threshold(
+                    CrdtMap.grow(var.spec, thr.state), thr.strict
+                )
+
     def redeclare_derived(self, id: str, type: str, spec: Any, elems: Any) -> str:
         """Replace a (still-bottom) variable's codec layout with a derived
         spec/universe. The dataflow layer calls this when an edge is attached
@@ -306,6 +437,11 @@ class Store:
         ``("remove_all", [E...])``, ``("increment",)``, ``("increment", N)``,
         ``("set", V)``."""
         var = self._vars[id]
+        if var.type_name == "riak_dt_map":
+            # dynamic schema: grow the field axis for keys this op names
+            # for the first time, BEFORE reading var.state (growth
+            # migrates it)
+            self.admit_map_fields(var, op)
         state = self._apply_op(var, var.state, op, actor)
         return self.bind(id, state)
 
@@ -405,7 +541,12 @@ class Store:
         (``riak_test/lasp_kvs_replica_test.erl:120-133`` shapes)."""
         spec, codec = var.spec, var.codec
         if sub[0] == "remove":
-            f = spec.field_index(sub[1])
+            try:
+                f = spec.field_index(sub[1])
+            except KeyError:
+                # a never-admitted field is absent: riak_dt_map's remove
+                # precondition, not a schema error
+                raise PreconditionError(f"not_present: {sub[1]!r}") from None
             if not bool(codec.value(spec, state)[f]):
                 raise PreconditionError(f"not_present: {sub[1]!r}")
             return codec.remove(spec, state, f)

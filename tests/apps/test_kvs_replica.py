@@ -280,3 +280,235 @@ def test_kvs_population_scale_batched():
     added = {op[2][1] for _r, op, _a in ops if op[1][0] == "X"}
     assert v[("X", "lasp_gset")] == frozenset(added)
     assert rt.divergence(m) == 0
+
+
+# -- dynamic field admission (round-5): the reference's schemaless map ------
+# riak_dt_map admits {Name, Type} keys on first update — the KVS replica
+# declares lasp:declare(riak_dt_map) with NO schema and puts to keys never
+# declared anywhere (riak_test/lasp_kvs_replica_test.erl:57-135; ordering
+# src/lasp_lattice.erl:264-271).
+
+
+def test_dynamic_declare_no_schema():
+    import pytest
+
+    store = Store(n_actors=4)
+    m = store.declare(type="riak_dt_map")  # the reference's exact declare
+    kx = ("X", "lasp_orset")
+    ky = ("Y", "riak_dt_gcounter")
+    store.update(m, ("update", [("update", kx, ("add", "Chris"))]), "r1")
+    assert store.value(m) == {kx: frozenset({"Chris"})}
+    # a later op admits a second field and updates the first in one batch
+    store.update(
+        m,
+        ("update", [("update", ky, ("increment", 3)), ("update", kx, ("add", "b"))]),
+        "r1",
+    )
+    assert store.value(m) == {kx: frozenset({"Chris", "b"}), ky: 3}
+    store.update(m, ("update", [("remove", kx)]), "r1")
+    assert store.value(m) == {ky: 3}
+    # removing a never-admitted field is the riak_dt precondition error,
+    # not a schema error — and does NOT admit the field
+    with pytest.raises(PreconditionError):
+        store.update(m, ("update", [("remove", ("Z", "lasp_orset"))]), "r1")
+    assert len(store.variable(m).spec.fields) == 2
+
+
+def test_dynamic_reset_mode_no_schema():
+    store = Store(n_actors=4)
+    m = store.declare(type="riak_dt_map", reset_on_readd=True)
+    key = ("X", "lasp_orset")
+    store.update(m, ("update", [("update", key, ("add", "Chris"))]), "r1")
+    store.update(m, ("update", [("remove", key)]), "r1")
+    store.update(m, ("update", [("update", key, ("add", "v2"))]), "r1")
+    assert store.value(m) == {key: frozenset({"v2"})}
+    # a field admitted AFTER a reset epoch advanced elsewhere starts clean
+    ky = ("Y", "riak_dt_gcounter")
+    store.update(m, ("update", [("update", ky, ("increment", 2))]), "r1")
+    assert store.value(m)[ky] == 2
+
+
+def test_dynamic_admission_key_validation():
+    import pytest
+
+    store = Store(n_actors=4)
+    m = store.declare(type="riak_dt_map")
+    # keys that are not (name, type_name) pairs cannot self-describe a type
+    with pytest.raises(KeyError):
+        store.update(m, ("update", [("update", "bare", ("add", "x"))]), "r1")
+    # unknown embedded type names are loud — same TypeError the declared-
+    # schema path raises for the same misuse (one shared validation path)
+    with pytest.raises(TypeError):
+        store.update(
+            m, ("update", [("update", ("A", "no_such_type"), ("add", "x"))]), "r1"
+        )
+    # nested maps are rejected exactly like the declared-schema path
+    with pytest.raises(TypeError):
+        store.update(
+            m,
+            ("update", [("update", ("N", "riak_dt_map"), ("update", []))]),
+            "r1",
+        )
+    assert store.variable(m).spec.fields == ()
+
+
+def test_dynamic_watch_thresholds_grow():
+    # a strict-threshold read parked BEFORE admission must keep working
+    # after the field axis grows (its parked threshold state is re-laid-out)
+    from lasp_tpu.lattice import Threshold
+
+    store = Store(n_actors=4)
+    m = store.declare(type="riak_dt_map")
+    kx = ("X", "lasp_orset")
+    store.update(m, ("update", [("update", kx, ("add", "a"))]), "r1")
+    var = store.variable(m)
+    watch = store.read(m, Threshold(var.state, strict=True))
+    assert not watch.done
+    ky = ("Y", "riak_dt_gcounter")
+    store.update(m, ("update", [("update", ky, ("increment",))]), "r1")
+    assert watch.done  # admission + update strictly inflated past the park
+
+
+def test_dynamic_mesh_growth_update_at():
+    # growth after the compiled step exists: the population re-layouts and
+    # the step recompiles for the new field axis
+    store = Store(n_actors=4)
+    m = store.declare(type="riak_dt_map")
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    kx = ("X", "lasp_orset")
+    rt.update_at(0, m, ("update", [("update", kx, ("add", "from0"))]), "r0")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.coverage_value(m) == {kx: frozenset({"from0"})}
+    # now a NEVER-seen key arrives at a different replica
+    ky = ("Y", "riak_dt_gcounter")
+    rt.update_at(2, m, ("update", [("update", ky, ("increment", 7))]), "r2")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.divergence(m) == 0
+    for r in range(4):
+        assert rt.replica_value(m, r) == {kx: frozenset({"from0"}), ky: 7}
+
+
+def test_dynamic_mesh_growth_update_batch():
+    store = Store(n_actors=8)
+    m = store.declare(type="riak_dt_map")
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    # batch over keys never declared: one pre-admission, one re-layout
+    ops = []
+    for w in range(4):
+        ops.append(
+            (w, ("update", ("S", "lasp_gset"), ("add", f"e{w}")), f"w{w}")
+        )
+        ops.append((w, ("update", ("C", "riak_dt_gcounter"), ("increment",)), f"w{w}"))
+    rt.update_batch(m, ops)
+    rt.run_to_convergence(max_rounds=16)
+    v = rt.coverage_value(m)
+    assert v[("S", "lasp_gset")] == frozenset({"e0", "e1", "e2", "e3"})
+    assert v[("C", "riak_dt_gcounter")] == 4
+    assert rt.divergence(m) == 0
+
+
+def test_dynamic_checkpoint_roundtrip(tmp_path):
+    from lasp_tpu.store.checkpoint import load_store, save_store
+
+    store = Store(n_actors=4)
+    m = store.declare(id="kvs", type="riak_dt_map")
+    kx = ("X", "lasp_orset")
+    store.update(m, ("update", [("update", kx, ("add", "a"))]), "r1")
+    path = str(tmp_path / "ckpt")
+    save_store(store, path)
+    restored = load_store(path)
+    assert restored.value(m) == {kx: frozenset({"a"})}
+    # the restored map keeps admitting: growth works on restored layouts
+    ky = ("Y", "riak_dt_gcounter")
+    restored.update(m, ("update", [("update", ky, ("increment", 9))]), "r2")
+    assert restored.value(m) == {kx: frozenset({"a"}), ky: 9}
+
+
+def test_dynamic_statem():
+    # randomized store-level statem over a DYNAMIC field set: ops draw keys
+    # from a pool larger than any declared schema (admission interleaves
+    # with updates/removes); oracle is a plain dict model with riak_dt_map
+    # observable semantics (join-monotone default mode)
+    import random
+
+    import pytest
+
+    for seed in range(6):
+        rng = random.Random(seed)
+        store = Store(n_actors=8)
+        m = store.declare(type="riak_dt_map")
+        pool = [(f"K{i}", "lasp_gset") for i in range(5)] + [
+            (f"C{i}", "riak_dt_gcounter") for i in range(3)
+        ]
+        model: dict = {}
+        for stepi in range(120):
+            key = rng.choice(pool)
+            actor = f"w{rng.randrange(8)}"
+            roll = rng.random()
+            if roll < 0.55:
+                if key[1] == "lasp_gset":
+                    e = f"e{rng.randrange(6)}"
+                    store.update(m, ("update", [("update", key, ("add", e))]), actor)
+                    cur = model.get(key)
+                    model[key] = (cur[0] if cur else frozenset()) | {e}, True
+                else:
+                    store.update(
+                        m, ("update", [("update", key, ("increment",))]), actor
+                    )
+                    cur = model.get(key)
+                    model[key] = (cur[0] if cur else 0) + 1, True
+                model[key] = (model[key][0], True)
+            elif roll < 0.75:
+                present = model.get(key, (None, False))[1]
+                if present:
+                    store.update(m, ("update", [("remove", key)]), actor)
+                    # default mode: contents survive hidden; presence drops
+                    model[key] = (model[key][0], False)
+                else:
+                    with pytest.raises(PreconditionError):
+                        store.update(m, ("update", [("remove", key)]), actor)
+            else:
+                # batched multi-key op (admits several at once)
+                k2 = rng.choice(pool)
+                if k2[1] == "lasp_gset" and key[1] == "lasp_gset":
+                    e1, e2 = f"e{rng.randrange(6)}", f"e{rng.randrange(6)}"
+                    store.update(
+                        m,
+                        ("update", [("update", key, ("add", e1)),
+                                    ("update", k2, ("add", e2))]),
+                        actor,
+                    )
+                    cur = model.get(key)
+                    model[key] = ((cur[0] if cur else frozenset()) | {e1}, True)
+                    cur = model.get(k2)
+                    model[k2] = ((cur[0] if cur else frozenset()) | {e2}, True)
+            expect = {
+                k: (v if isinstance(v, (frozenset, int)) else v)
+                for k, (v, present) in model.items()
+                if present
+            }
+            assert store.value(m) == expect, f"seed={seed} step={stepi}"
+
+
+def test_dynamic_batch_admission_is_atomic():
+    # regression (r5 review): a batch whose LATER op carries an invalid
+    # key must raise with NOTHING admitted — a half-grown spec whose
+    # population was never re-laid-out wedges the variable permanently
+    import pytest
+
+    store = Store(n_actors=8)
+    m = store.declare(type="riak_dt_map")
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    with pytest.raises(KeyError):
+        rt.update_batch(
+            m,
+            [
+                (0, ("update", ("A", "lasp_gset"), ("add", "x")), "w0"),
+                (1, ("update", "bad_key", ("add", "y")), "w1"),
+            ],
+        )
+    assert store.variable(m).spec.fields == ()  # nothing half-admitted
+    # the variable still works: the same valid key admits and applies
+    rt.update_at(0, m, ("update", ("A", "lasp_gset"), ("add", "z")), "w0")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.coverage_value(m) == {("A", "lasp_gset"): frozenset({"z"})}

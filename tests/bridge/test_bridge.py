@@ -668,3 +668,54 @@ def test_durable_bridge_concurrent_clients_stress(tmp_path):
                 ok, val = c.read(b"s")
                 assert ok == Atom("ok") and len(val) == 50
                 assert all(v.startswith(f"p{k}-".encode()) for v in val)
+
+
+def test_map_bridge_dynamic_field_admission():
+    """The reference's exact wire flow (riak_test/lasp_kvs_replica_test.erl:
+    57-135): declare riak_dt_map with NO schema, update a {Name, Type}
+    tuple key never declared anywhere. The tagged key encoding
+    (("tuple", ("atom", Name), ("atom", Type)) after _to_key) must
+    self-describe its embedded type and admit on first update — and on
+    state import (put/bind with fields this node has never seen)."""
+    with BridgeServer() as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.start("v")
+            resp = c.declare(b"m", "riak_dt_map")  # schemaless
+            assert resp == (Atom("ok"), b"m")
+            key = (Atom("X"), Atom("lasp_orset"))
+            ok, val = c.update(
+                b"m", (Atom("update"), key, (Atom("add"), b"Chris")), b"w0"
+            )
+            assert ok == Atom("ok")
+            assert val == [(key, [b"Chris"])]
+            # a second dynamic field through the batched op shape
+            ckey = (Atom("hits"), Atom("riak_dt_gcounter"))
+            ok, val = c.update(
+                b"m",
+                (Atom("update"), [(Atom("update"), ckey, (Atom("increment"), 2))]),
+                b"w1",
+            )
+            assert ok == Atom("ok")
+            assert dict(val) == {key: [b"Chris"], ckey: 2}
+            # remove of a never-admitted {Name, Type} key: precondition
+            # error (riak_dt_map not_present), NOT silent admission
+            resp = c.update(
+                b"m", (Atom("remove"), (Atom("Z"), Atom("lasp_orset"))), b"w0"
+            )
+            assert resp[0] == Atom("error")
+            # portable-state import admits unknown self-describing fields:
+            # put m's state into a twin declared with NO fields at all
+            ok, (type_atom, portable) = c.get(b"m")
+            assert type_atom == Atom("riak_dt_map")
+            resp = c.put(b"m2", "riak_dt_map", portable)
+            assert resp == Atom("ok")
+            assert dict(c.read(b"m2")[1]) == {key: [b"Chris"], ckey: 2}
+            # a non-self-describing unknown field still rejects, with
+            # nothing admitted (the twin keeps serving)
+            bad = ([(b"w9", 1)], [(b"nope", [(b"w9", 1)], [])])
+            resp = c.put(b"m3", "riak_dt_map", bad)
+            assert resp[0] == Atom("error")
+            ok, _ = c.update(
+                b"m2", (Atom("update"), ckey, (Atom("increment"),)), b"w1"
+            )
+            assert ok == Atom("ok")
