@@ -1,24 +1,26 @@
 #!/usr/bin/env python
-"""Round-4 TPU capture watcher.
+"""Round-5 TPU capture watcher: single-client-safe, one child per attempt.
 
-The axon tunnel (single-client; see bench.py's module docstring) was
-wedged at round start. This watcher probes it in bounded subprocesses
-and, the moment a probe sees a non-cpu platform, runs the three capture
-jobs back-to-back — most valuable artifact first — each in its own
-SIGTERM-first bounded child:
+Round 4 probed in one bounded subprocess and measured in another — and
+that probe->measure reconnect is exactly what wedges the single-client
+axon tunnel (the probe's lease outlives its process; the next
+interpreter's connect half-registers and hangs forever). Round 5 fixes
+the shape: ONE child (``tools/tpu_oneshot.py``) both probes and measures
+in the same interpreter, appending one JSON line per stage to
+``tools/capture_out/oneshot_r05.jsonl``. The parent NEVER imports jax;
+it watches the jsonl:
 
-  1. python bench.py                  -> tools/capture_out/bench.json
-  2. python bench_pallas.py           -> tools/capture_out/pallas.jsonl
-  3. cli scenario packed_vs_dense 1M  -> tools/capture_out/scenario_1m.json
+- no ``init`` line within ``LASP_WATCH_INIT_TIMEOUT`` (240 s): the
+  connect is wedged -> SIGTERM the child, sleep out the probe interval
+  (the wedge heals on terminal-side lease expiry, not retry pressure);
+- ``init`` seen: let the child run its full budget; success = a
+  ``headline`` stage without an ``error`` field this attempt.
 
-The parent NEVER imports jax (any backend query can hang for hours on a
-wedged tunnel). Probes are spaced minutes apart: the wedge heals on
-terminal-side lease expiry, not on retry pressure, and hammering it just
-risks stacking half-registered clients.
-"""
+SIGTERM-first always — a SIGKILLed client holds the tunnel."""
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import subprocess
@@ -28,8 +30,10 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "tools", "capture_out")
 LOG = os.path.join(OUT, "watch.log")
+JSONL = os.path.join(OUT, "oneshot_r05.jsonl")
 
-PROBE_TIMEOUT_S = 150
+INIT_TIMEOUT_S = int(os.environ.get("LASP_WATCH_INIT_TIMEOUT", "240"))
+CAPTURE_BUDGET_S = int(os.environ.get("LASP_WATCH_CAPTURE_BUDGET", "3600"))
 PROBE_INTERVAL_S = int(os.environ.get("LASP_WATCH_INTERVAL", "600"))
 TOTAL_HOURS = float(os.environ.get("LASP_WATCH_HOURS", "10"))
 
@@ -37,83 +41,88 @@ TOTAL_HOURS = float(os.environ.get("LASP_WATCH_HOURS", "10"))
 def log(msg: str) -> None:
     line = f"[{time.strftime('%H:%M:%S')}] {msg}"
     print(line, flush=True)
+    os.makedirs(OUT, exist_ok=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
 
 
-def run(cmd, timeout, outfile=None, env=None):
-    """SIGTERM-first bounded child (never leave a SIGKILLed process
-    holding the tunnel). Returns (rc, stdout_tail)."""
-    proc = subprocess.Popen(
-        cmd, cwd=REPO, env=env, text=True,
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-    )
+def _new_lines(offset: int) -> tuple[list, int]:
+    """JSON records appended to the jsonl past byte ``offset``. The offset
+    only ever advances to the end of the last NEWLINE-TERMINATED line: a
+    poll can land mid-append, and consuming the partial line's bytes
+    would drop that record forever once its tail arrives."""
+    if not os.path.exists(JSONL):
+        return [], offset
+    with open(JSONL, "rb") as f:
+        f.seek(offset)
+        chunk = f.read()
+    complete = chunk.rfind(b"\n") + 1  # 0 when no full line yet
+    records = []
+    for line in chunk[:complete].splitlines():
+        try:
+            records.append(json.loads(line.decode("utf-8", "replace")))
+        except json.JSONDecodeError:
+            pass
+    return records, offset + complete
+
+
+def _terminate(proc: subprocess.Popen) -> None:
+    proc.send_signal(signal.SIGTERM)
     try:
-        out, err = proc.communicate(timeout=timeout)
-        rc = proc.returncode
+        proc.wait(timeout=25)
     except subprocess.TimeoutExpired:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            out, err = proc.communicate(timeout=25)
-        except subprocess.TimeoutExpired:
-            proc.kill()
-            out, err = proc.communicate()
-        rc = -1
-    if outfile and out and out.strip():
-        # a timed-out/failed child's stdout must not masquerade as a
-        # finished artifact
-        with open(outfile if rc == 0 else outfile + ".partial", "w") as f:
-            f.write(out)
-    if err and err.strip():
-        with open((outfile or os.path.join(OUT, "misc")) + ".stderr", "w") as f:
-            f.write(err)
-    return rc, (out or "").strip()[-400:]
+        proc.kill()
+        proc.wait()
 
 
-def probe() -> bool:
-    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
-    rc, out = run([sys.executable, "-c", code], PROBE_TIMEOUT_S)
-    if rc == 0 and "PLATFORM=" in out:
-        platform = out.rsplit("PLATFORM=", 1)[1].strip()
-        log(f"probe: platform={platform}")
-        return platform != "cpu"
-    log(f"probe: failed rc={rc} tail={out[-120:]!r}")
-    return False
-
-
-def capture() -> bool:
-    """One capture pass. Success == bench.py produced a parseable artifact
-    that actually ran on the TPU (its internal CPU fallback exits 0 too —
-    that must not end the watch)."""
-    import json
-
-    log("TPU healthy — starting captures")
-    bench_out = os.path.join(OUT, "bench.json")
-    rc, tail = run([sys.executable, "bench.py"], 2500, outfile=bench_out)
-    log(f"bench.py rc={rc} tail={tail[-200:]!r}")
-    bench_on_tpu = False
-    if rc == 0:
-        try:
-            with open(bench_out) as f:
-                rec = json.loads(f.read().strip().splitlines()[-1])
-            bench_on_tpu = rec.get("detail", {}).get("device") not in (
-                None, "cpu",
-            )
-            log(f"bench device={rec.get('detail', {}).get('device')!r}")
-        except Exception as e:
-            log(f"bench.json unparseable: {e}")
-    rc, tail = run(
-        [sys.executable, "bench_pallas.py"], 1500,
-        outfile=os.path.join(OUT, "pallas.jsonl"),
+def attempt_once(attempt: int) -> bool:
+    """One probe+capture child. True iff the headline stage captured."""
+    offset = os.path.getsize(JSONL) if os.path.exists(JSONL) else 0
+    env = dict(os.environ)
+    env["LASP_ONESHOT_BUDGET"] = str(CAPTURE_BUDGET_S)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join("tools", "tpu_oneshot.py")],
+        cwd=REPO, env=env, text=True,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
     )
-    log(f"bench_pallas.py rc={rc} tail={tail[-200:]!r}")
-    rc, tail = run(
-        [sys.executable, "-m", "lasp_tpu.cli", "scenario",
-         "packed_vs_dense", "--replicas", "1048576"], 1500,
-        outfile=os.path.join(OUT, "scenario_1m.json"),
-    )
-    log(f"scenario packed_vs_dense rc={rc} tail={tail[-200:]!r}")
-    return bench_on_tpu
+    t0 = time.monotonic()
+    saw_init = False
+    headline_ok = False
+    while proc.poll() is None:
+        time.sleep(5)
+        records, offset = _new_lines(offset)
+        for rec in records:
+            stage = rec.get("stage")
+            if stage == "init" and "error" not in rec:
+                saw_init = True
+                log(f"attempt {attempt}: init ok — {rec.get('device_kind')}")
+            elif stage == "init":
+                log(f"attempt {attempt}: init says {rec.get('error')!r}")
+            elif stage == "headline":
+                headline_ok = "error" not in rec
+                log(f"attempt {attempt}: headline "
+                    f"{'ok' if headline_ok else rec.get('error')!r}")
+            elif stage:
+                log(f"attempt {attempt}: stage {stage} recorded")
+        now = time.monotonic()
+        if not saw_init and now - t0 > INIT_TIMEOUT_S:
+            log(f"attempt {attempt}: no init after {INIT_TIMEOUT_S}s — "
+                "wedged connect, terminating child")
+            _terminate(proc)
+            return False
+        if now - t0 > CAPTURE_BUDGET_S + 120:
+            log(f"attempt {attempt}: budget exceeded, terminating child")
+            _terminate(proc)
+            break
+    records, offset = _new_lines(offset)
+    for rec in records:
+        if rec.get("stage") == "headline":
+            headline_ok = "error" not in rec
+        if rec.get("stage"):
+            log(f"attempt {attempt}: stage {rec.get('stage')} recorded (final)")
+    log(f"attempt {attempt}: child exited rc={proc.returncode} "
+        f"headline_ok={headline_ok}")
+    return headline_ok
 
 
 def main() -> int:
@@ -122,14 +131,10 @@ def main() -> int:
     attempt = 0
     while time.monotonic() < deadline:
         attempt += 1
-        log(f"probe attempt {attempt}")
-        if probe():
-            if capture():
-                log("capture pass done (bench ran on TPU)")
-                return 0
-            # the tunnel re-wedged mid-capture (the known failure mode):
-            # keep watching — later attempts may land a full pass
-            log("capture pass incomplete; continuing to watch")
+        log(f"attempt {attempt} starting")
+        if attempt_once(attempt):
+            log("capture complete (headline on TPU) — watcher done")
+            return 0
         time.sleep(PROBE_INTERVAL_S)
     log("deadline reached with no healthy TPU")
     return 1
