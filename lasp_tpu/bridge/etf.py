@@ -293,16 +293,36 @@ _SELFCHECK = [
 ]
 
 
+def reselect() -> str:
+    """(Re)run codec selection against the active config and return the
+    resulting ``IMPL``. Runs at first import and again whenever
+    :func:`lasp_tpu.config.set_config` installs a new config — so
+    ``LaspConfig(etf="python")`` set programmatically takes effect, not
+    just the ``LASP_ETF`` env var read at first import."""
+    global IMPL, encode, decode, native_module
+    IMPL, encode, decode, native_module = "python", py_encode, py_decode, None
+    _try_native()
+    return IMPL
+
+
 def _try_native() -> None:
     global IMPL, encode, decode
     import importlib.machinery
     import importlib.util
     import os
 
-    # exact vocabulary of LaspConfig.etf ("auto" | "python", case-
-    # sensitive): any other value is left for get_config() to reject
-    # loudly rather than being guessed at here
-    if os.environ.get("LASP_ETF") == "python":
+    # selection vocabulary of LaspConfig.etf ("auto" | "python"). The
+    # config is consulted through get_config() so programmatic configs
+    # count; if the config itself cannot resolve (bogus unrelated LASP_*
+    # env), fall back to the raw env var rather than making this import
+    # raise — get_config() rejects loudly at its own call sites
+    try:
+        from ..config import get_config
+
+        choice = get_config().etf
+    except Exception:
+        choice = os.environ.get("LASP_ETF") or "auto"
+    if choice == "python":
         return
     so = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "..", "..", "native",
@@ -355,4 +375,4 @@ def _type_shape(t):
     return (type(t).__name__, t)
 
 
-_try_native()
+_try_native()  # initial selection; set_config() re-runs it via reselect()

@@ -68,12 +68,14 @@ def cmd_simulate(args) -> int:
         "rounds_to_convergence": rounds,
         "seconds": round(rt.trace.total_seconds, 4),
         "residual_path": [r["residual"] for r in rt.trace.rounds],
-        "value_size": (
-            rt.coverage_value(var)
-            if args.type == "riak_dt_gcounter"
-            else len(rt.coverage_value(var))
-        ),
     }
+    # set-like types report a cardinality; the G-Counter reports its
+    # numeric value under its own key (consumers parsing value_size as a
+    # cardinality must never misread a counter total)
+    if args.type == "riak_dt_gcounter":
+        out["value"] = rt.coverage_value(var)
+    else:
+        out["value_size"] = len(rt.coverage_value(var))
     print(json.dumps(out))
     return 0
 

@@ -73,6 +73,7 @@ class LaspConfig:
             "LASP_DRYRUN",
             "LASP_STATEM",  # test-suite soak depth (tests/lattice)
             "LASP_WATCH",  # tools/tpu_capture.py watcher knobs
+            "LASP_ONESHOT",  # tools/tpu_oneshot.py capture knobs
         )
         for key, raw in env.items():
             if not key.startswith("LASP_"):
@@ -109,6 +110,23 @@ def get_config() -> LaspConfig:
     global _CONFIG
     if _CONFIG is None:
         _CONFIG = LaspConfig.from_env().validate()
+    return _CONFIG
+
+
+def set_config(cfg: LaspConfig) -> LaspConfig:
+    """Install ``cfg`` (validated) as the process-wide config and notify
+    already-materialized dependents. Today that is the ETF wire codec:
+    its implementation choice (``cfg.etf``) is baked at first import of
+    ``lasp_tpu.bridge.etf``, so a later config change must re-run the
+    selection — without this hook, ``LaspConfig(etf="python")`` would
+    silently not take effect."""
+    global _CONFIG
+    _CONFIG = cfg.validate()
+    import sys
+
+    etf_mod = sys.modules.get("lasp_tpu.bridge.etf")
+    if etf_mod is not None:
+        etf_mod.reselect()
     return _CONFIG
 
 

@@ -543,6 +543,15 @@ class ReplicatedRuntime:
         states = self._population(var_id)
         if not ops:
             return
+        # interner overflow must follow the same per-op prefix semantics as
+        # pool/precondition failures: find the longest op prefix whose NEW
+        # terms/actors fit, apply only that, then raise. Walked BEFORE the
+        # actor guard so the guard judges exactly the ops that can apply
+        # this call — a collision hiding past the overflow point raises
+        # (if still relevant) on the retry of that suffix, not now.
+        n_fit, cap_err = self._capacity_prefix(var, tn, ops)
+        if cap_err is not None:
+            ops = ops[:n_fit]
         # guard BEFORE any mutation: a debug-mode violation is a
         # batch-level programming error, all-or-nothing like shape errors
         # (nothing applied, registry not extended)
@@ -571,26 +580,25 @@ class ReplicatedRuntime:
                             + " — one actor per writing replica "
                             "(see debug_actors/_guard_actor_check)"
                         )
-        # interner overflow must follow the same per-op prefix semantics as
-        # pool/precondition failures: find the longest op prefix whose NEW
-        # terms/actors fit, apply only that, then raise
-        n_fit, cap_err = self._capacity_prefix(var, tn, ops)
-        if cap_err is not None:
-            ops = ops[:n_fit]
         guard_actors = None
         if self.debug_actors and tn in self._ACTOR_LANE_TYPES:
             # sites register only for the capacity-validated prefix, and
-            # only after it fully applies (below) — a failed batch extends
-            # nothing, so a caught-and-retried suffix is judged afresh
-            # rather than against phantom sites
+            # only after the dispatch reports how far it got (below) — a
+            # failed batch extends nothing past its failure point, so a
+            # caught-and-retried suffix is judged afresh rather than
+            # against phantom sites
             guard_actors = [
-                (actor, int(r))
-                for r, op, actor in ops
+                (actor, int(r), k)
+                for k, (r, op, actor) in enumerate(ops)
                 if self._op_mints_lane(var, op)
             ]
+        dispatch_exc = None
         try:
             if ops:
                 self._dispatch_batch(var, tn, states, ops)
+        except BaseException as exc:
+            dispatch_exc = exc
+            raise
         finally:
             # a mid-batch CapacityError/PreconditionError persists the ops
             # before the failure (sequential semantics) — their interned
@@ -598,14 +606,21 @@ class ReplicatedRuntime:
             # catches the error sweeps with stale projections
             self.graph.refresh()
             if guard_actors is not None:
-                # register the checked prefix's write sites even when the
-                # dispatch failed mid-batch: the ops before the failure
-                # PERSISTED (they minted lane events), and missing them
-                # would let a later cross-replica write corrupt silently.
-                # The cost is a possible phantom site for prefix ops after
-                # the failing one — the guard errs toward a false
-                # collision error, never a silent miss.
-                for actor, r in guard_actors:
+                # register write sites only for ops that actually APPLIED:
+                # the batch kernels stamp the failing op's index on the
+                # error (err.batch_index), so ops at/after it commit
+                # nothing. An error without the stamp (unexpected shape)
+                # falls back to committing the whole checked prefix —
+                # erring toward a false collision error, never a silent
+                # miss.
+                fail_idx = (
+                    getattr(dispatch_exc, "batch_index", len(ops))
+                    if dispatch_exc is not None
+                    else len(ops)
+                )
+                for actor, r, k in guard_actors:
+                    if k >= fail_idx:
+                        continue
                     self._guard_actor_commit(
                         self._actor_guard_keys(var, actor), r
                     )
@@ -879,6 +894,10 @@ class ReplicatedRuntime:
         if err is None:
             return n_ok
         fail_op = items[n_ok][-1]
+        # tell the guard-commit logic (update_batch finally) exactly which
+        # op failed: ops at/after it never applied, so their write sites
+        # must not register
+        err.batch_index = fail_op
         while n_ok and items[n_ok - 1][-1] == fail_op:
             n_ok -= 1
         return n_ok
@@ -1080,6 +1099,7 @@ class ReplicatedRuntime:
                 local_dots[t, f, a] = local_clock[t, a]
                 inner_ops.setdefault(f, []).append((r, inner, actor))
             if err is not None:
+                err.batch_index = _k  # this op and everything after: unapplied
                 # rewind THIS op's partial presence + inner appends
                 for t, f, dots_old, a, clock_old in reversed(undo):
                     local_dots[t, f] = dots_old
@@ -1130,6 +1150,7 @@ class ReplicatedRuntime:
         applied over a host overlay of only the touched entries."""
         fail_op, err = self._orswot_precheck(var, ops)
         if err is not None:
+            err.batch_index = fail_op  # ops[fail_op:] never applied
             ops = ops[:fail_op]
         if not ops:
             if err is not None:
@@ -1375,9 +1396,31 @@ class ReplicatedRuntime:
         def to_wire(v, x):
             return FlatORSet.pack(packed_specs[v], x) if v in packed_specs else x
 
+        baked_neighbors = self.neighbors  # the table the offsets derive from
+
         # tables is REQUIRED (no default): an old-signature 3-arg call must
         # fail loudly rather than zip-truncate every edge away silently
         def step(states, neighbors, edge_mask, tables):
+            if offsets is not None and not isinstance(
+                neighbors, jax.core.Tracer
+            ):
+                # shift-structured gossip routes through offsets BAKED at
+                # build time; a concrete call with a different table would
+                # silently run the old topology. Guard the eager/concrete
+                # dispatch path host-side (identity first — the internal
+                # callers always pass self.neighbors — equality as the
+                # fallback). Consumers re-jitting this fn trace with
+                # Tracers and skip the check: under jit, pass the
+                # runtime's OWN table (see the caveat on _step_pure).
+                if neighbors is not baked_neighbors and not bool(
+                    jnp.array_equal(neighbors, baked_neighbors)
+                ):
+                    raise ValueError(
+                        "shift-structured step was compiled for the "
+                        "runtime's own neighbor table; to run a different "
+                        "topology use resize() (which re-derives the "
+                        "shift offsets), don't pass another table"
+                    )
             prev = states
             if edges or triggers:
 

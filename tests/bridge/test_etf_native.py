@@ -191,3 +191,25 @@ def test_encode_depth_bound_matches_between_codecs():
     for _ in range(400):
         ok = [ok]
     assert native.encode(ok) == py_encode(ok)
+
+
+def test_config_etf_reselect():
+    # LaspConfig.etf is a live selector through set_config, not an
+    # env-only latch read once at import (r4 advisor finding)
+    from lasp_tpu.bridge import etf
+    from lasp_tpu.config import LaspConfig, get_config, set_config
+
+    before = get_config()
+    initial = etf.IMPL
+    try:
+        set_config(LaspConfig(etf="python"))
+        assert etf.IMPL == "python"
+        assert etf.decode(etf.encode((etf.Atom("ok"), 1))) == (etf.Atom("ok"), 1)
+        set_config(LaspConfig(etf="auto"))
+        # auto re-runs the native self-check: native when the .so is
+        # present and conformant, python otherwise — either way it must
+        # equal a fresh selection, not the stale latch
+        assert etf.IMPL == etf.reselect()
+    finally:
+        set_config(before)
+        assert etf.IMPL == initial

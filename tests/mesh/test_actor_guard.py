@@ -235,3 +235,56 @@ def test_guard_off_by_default():
     rt, s = _rt(debug=False)
     rt.update_at(0, s, ("add", "x"), "w")
     rt.update_at(1, s, ("add", "y"), "w")  # no raise (documented caveat)
+
+
+def test_batch_failure_commits_only_applied_write_sites():
+    # r4 advisor finding: after a mid-batch dispatch failure the guard
+    # used to register write sites for every CHECKED op, including ops
+    # past the failure that never applied — a later legitimate write then
+    # hit a false ActorCollisionError. The batch kernels now stamp the
+    # failing op's index on the error and the guard commits only ops
+    # before it.
+    from lasp_tpu.store import PreconditionError
+
+    store = Store(n_actors=8)
+    s = store.declare(id="s", type="lasp_orset", n_elems=8,
+                      tokens_per_actor=4)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2),
+                           debug_actors=True)
+    with pytest.raises(PreconditionError):
+        rt.update_batch(s, [
+            (0, ("add", "a"), "w0"),
+            (1, ("remove", "never-added"), "w1"),  # fails at index 1
+            (2, ("add", "b"), "w2"),               # never applies
+        ])
+    # w0 applied and is pinned to replica 0
+    with pytest.raises(ActorCollisionError):
+        rt.update_at(3, s, ("add", "c"), "w0")
+    # w2 minted nothing: its home replica is still free to choose
+    rt.update_at(3, s, ("add", "c"), "w2")
+    rt.run_to_convergence(max_rounds=8)
+    assert rt.coverage_value(s) == {"a", "c"}
+
+
+def test_shift_step_guards_foreign_neighbor_table():
+    # r4 advisor finding: on shift-structured topologies the compiled
+    # step gossips via offsets baked at build time; a concrete call with
+    # a DIFFERENT table must raise, not silently run the old topology
+    import numpy as np
+
+    from lasp_tpu.mesh import random_regular
+
+    rt, s = _rt(debug=False)
+    rt._build_step()
+    step = rt._step_pure
+    tables = tuple(e.device_tables() for e in rt.graph.edges)
+    # the runtime's own table passes
+    step(rt.states, rt.neighbors, None, tables)
+    # an equal-valued copy passes (equality fallback)
+    import jax.numpy as jnp
+
+    step(rt.states, jnp.asarray(np.asarray(rt.neighbors).copy()), None, tables)
+    # a different topology of the same shape raises
+    other = random_regular(rt.n_replicas, rt.neighbors.shape[1], seed=9)
+    with pytest.raises(ValueError):
+        step(rt.states, jnp.asarray(other), None, tables)

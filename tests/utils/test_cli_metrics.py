@@ -85,3 +85,16 @@ def test_profile_context_emits_trace(tmp_path):
         for f in files
     ]
     assert found, "profiler trace produced no files"
+
+
+def test_cli_simulate_gcounter_value_key(capsys):
+    # the counter total rides under "value" (a number), never under
+    # "value_size" (a cardinality) — consumers must not misread totals
+    rc = cli.main(
+        ["simulate", "--replicas", "32", "--topology", "ring",
+         "--writers", "4", "--type", "riak_dt_gcounter"]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["value"] == 4  # one increment per writer lane
+    assert "value_size" not in out
