@@ -133,6 +133,137 @@ def sharded_join_all(codec, spec, states, mesh: Mesh, axis: str = "replicas"):
     )(states)
 
 
+def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
+    """Host-side boundary-exchange plan for IRREGULAR topologies under a
+    block sharding (the locality half of SURVEY §2.5's communication
+    table; pair with ``topology.locality_order`` so the plan has a small
+    cut to exploit).
+
+    The auto-sharded gossip gather lowers to one all-gather of the WHOLE
+    population per state plane (count-asserted in
+    tests/mesh/test_shard_gossip.py). This plan replaces it: each shard
+    contributes only the rows some OTHER shard actually references
+    (padded to the max ``M`` across shards), one ``all_gather`` moves the
+    ``S*M``-row union buffer, and a precomputed combined index table
+    reads each neighbor from either the local block or the buffer — wire
+    scales with the CUT (distinct remotely-needed rows), not the
+    population. A hub row referenced by thousands of edges ships once
+    per needing shard.
+
+    Returns ``{"send_idx": int32[S, M] (block-local row ids, pad 0),
+    "idx": int32[R, K] (combined index: [0, B) local block, [B, B+S*M)
+    buffer position), "n_shards", "block", "m", "stats"}``."""
+    import numpy as np
+
+    nbrs = np.asarray(neighbors).astype(np.int64)
+    R, K = nbrs.shape
+    if R % n_shards:
+        raise ValueError(f"{R} replicas do not divide over {n_shards} shards")
+    B = R // n_shards
+    src_shard = (np.arange(R) // B)[:, None]  # [R, 1]
+    owner = nbrs // B  # [R, K]
+    cross = owner != src_shard
+    send_rows = np.unique(nbrs[cross]) if cross.any() else np.empty(0, np.int64)
+    per_owner = np.bincount(send_rows // B, minlength=n_shards)
+    m = max(int(per_owner.max()) if len(send_rows) else 0, 1)
+    send_idx = np.zeros((n_shards, m), dtype=np.int64)
+    pos_of = np.zeros(R, dtype=np.int64)  # buffer position of each sent row
+    for s in range(n_shards):
+        rows = send_rows[send_rows // B == s]
+        send_idx[s, : len(rows)] = rows - s * B
+        pos_of[rows] = np.arange(len(rows)) + s * m
+    idx = np.where(cross, B + pos_of[nbrs], nbrs - src_shard * B)
+    # stats derive from the arrays just built (one walk of the table,
+    # and one definition of the cut — shard_cut_stats exists for callers
+    # that have no plan)
+    stats = {
+        "n_replicas": R,
+        "n_shards": n_shards,
+        "edges": int(R * K),
+        "cross_edges": int(cross.sum()),
+        "send_rows": int(len(send_rows)),
+        "max_send": int(per_owner.max()) if len(send_rows) else 0,
+        "allgather_rows_per_round": R,
+        "exchange_rows_per_round": n_shards * (
+            int(per_owner.max()) if len(send_rows) else 0
+        ),
+    }
+    return {
+        "send_idx": send_idx.astype(np.int32),
+        "idx": idx.astype(np.int32),
+        "n_shards": n_shards,
+        "block": B,
+        "m": m,
+        "stats": stats,
+    }
+
+
+def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
+                                axis: str = "replicas"):
+    """Build ``(states, send_idx, idx) -> states`` running ONE gossip
+    round of an irregular topology via the boundary exchange of
+    ``plan`` — semantically identical to ``gossip_round(codec, spec,
+    states, neighbors)`` for block-sharded states, but the only
+    collective is an ``all_gather`` of ``plan["m"]`` rows per shard.
+    ``send_idx``/``idx`` are ``plan``'s tables as device arrays sharded
+    ``P(axis, None)`` (callers keep them resident across rounds)."""
+    if plan["n_shards"] != mesh.shape[axis]:
+        # a mismatched plan would shard send_idx into the WRONG per-device
+        # rows and compute local indices against the wrong block size —
+        # silently wrong merges, so refuse loudly (ring's _shift_pull
+        # raises on its analogous misconfiguration)
+        raise ValueError(
+            f"plan was built for {plan['n_shards']} shards but mesh axis "
+            f"{axis!r} has {mesh.shape[axis]} devices — rebuild the plan"
+        )
+    vmerge = jax.vmap(lambda a, b: codec.merge(spec, a, b))
+    k_cols = plan["idx"].shape[1]
+
+    def local(block, send_idx, idx):
+        send = send_idx[0]  # [1, M] shard slice -> [M]
+        contrib = jax.tree_util.tree_map(lambda x: x[send], block)
+        gathered = jax.tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, axis), contrib
+        )  # [S, M, ...] per leaf
+        full = jax.tree_util.tree_map(
+            lambda b, g: jnp.concatenate(
+                [b, g.reshape((-1,) + g.shape[2:])], axis=0
+            ),
+            block, gathered,
+        )
+        acc = block
+        for k in range(k_cols):
+            nbr = jax.tree_util.tree_map(lambda f: f[idx[:, k]], full)
+            acc = vmerge(acc, nbr)
+        return acc
+
+    return _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis, None), P(axis, None)),
+        out_specs=P(axis), check_vma=False,
+    )
+
+
+def partitioned_gossip_rounds(codec, spec, states, mesh: Mesh, plan: dict,
+                              n_rounds: int, axis: str = "replicas"):
+    """``n_rounds`` boundary-exchange rounds fused in one jit. Returns
+    ``(new_states, changed)`` like :func:`ring_gossip_rounds`."""
+    round_fn = partitioned_gossip_round_fn(codec, spec, mesh, plan, axis=axis)
+    table_sharding = jax.sharding.NamedSharding(mesh, P(axis, None))
+    send_idx = jax.device_put(jnp.asarray(plan["send_idx"]), table_sharding)
+    idx = jax.device_put(jnp.asarray(plan["idx"]), table_sharding)
+
+    @jax.jit
+    def run(s0):
+        out = jax.lax.fori_loop(
+            0, n_rounds, lambda _, s: round_fn(s, send_idx, idx), s0
+        )
+        eq = jax.vmap(lambda a, b: codec.equal(spec, a, b))(s0, out)
+        return out, ~jnp.all(eq)
+
+    return run(states)
+
+
 def ring_gossip_shardmap_dryrun(mesh: Mesh, n_replicas: int) -> None:
     """Compile-and-run proof that the explicit ppermute path works on the
     current device population (called from ``__graft_entry__``'s multi-chip

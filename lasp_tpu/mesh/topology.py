@@ -97,6 +97,84 @@ def scale_free(
     return np.concatenate([backbone[:, None], hubs], axis=1).astype(np.int32)
 
 
+def locality_order(neighbors: np.ndarray) -> tuple:
+    """Renumber replicas so irregular gossip edges become mostly
+    shard-local under block sharding (SURVEY §2.5 parallelism census; the
+    anti-entropy locality the reference gets from riak_core preflist
+    placement, ``src/lasp_update_fsm.erl:207-216``).
+
+    The move: follow the CYCLES of column 0 — the random-permutation
+    backbone of :func:`random_regular` / :func:`scale_free` — assigning
+    consecutive new indices along each cycle. A backbone edge then points
+    from new index ``p`` to ``p+1``: local within a shard block
+    everywhere except the block boundaries. The remaining columns
+    (scale-free hub picks) stay irregular, but hubs are FEW and the
+    boundary-exchange plan (``shard_gossip.partitioned_gossip_plan``)
+    ships each remote row once per needing shard, so their cost scales
+    with the number of distinct hot rows, not edges. (For
+    ``random_regular`` with k independent permutations only the backbone
+    column localizes — expander graphs genuinely have Θ(R) cuts; the win
+    there is the dedup alone.)
+
+    Returns ``(perm, new_neighbors)`` with ``perm[new_index] =
+    old_index``; relabeling is a graph isomorphism, so gossip dynamics
+    are unchanged: ``new_state[inv[r]] == old_state[r]`` at every round.
+    """
+    nbrs = np.asarray(neighbors)
+    if nbrs.ndim != 2 or nbrs.shape[0] == 0:
+        raise ValueError(f"neighbors must be [R, K], got {nbrs.shape}")
+    R = nbrs.shape[0]
+    nb0 = nbrs[:, 0].astype(np.int64).tolist()  # list: ~3x faster walk
+    perm = np.empty(R, dtype=np.int64)
+    visited = bytearray(R)
+    pos = 0
+    for start in range(R):
+        if visited[start]:
+            continue
+        cur = start
+        while not visited[cur]:
+            visited[cur] = 1
+            perm[pos] = cur
+            pos += 1
+            cur = nb0[cur]
+    inv = np.empty(R, dtype=np.int64)
+    inv[perm] = np.arange(R)
+    new_nbrs = inv[nbrs[perm]]
+    return perm.astype(np.int32), new_nbrs.astype(np.int32)
+
+
+def shard_cut_stats(neighbors: np.ndarray, n_shards: int) -> dict:
+    """Wire-cost accounting for a block sharding of ``neighbors``:
+    ``cross_edges`` (edges whose endpoint lives on another shard),
+    ``send_rows`` (GLOBALLY distinct rows referenced by at least one
+    remote shard — a hub needed by five shards counts once, because the
+    exchange ships one union buffer that every shard reads), and
+    ``max_send`` = M, the padded per-shard contribution the exchange
+    all-gathers (``S*M`` rows on the wire per round vs ``R`` for the
+    population all-gather)."""
+    nbrs = np.asarray(neighbors).astype(np.int64)
+    R, K = nbrs.shape
+    if R % n_shards:
+        raise ValueError(f"{R} replicas do not divide over {n_shards} shards")
+    B = R // n_shards
+    src = np.repeat(np.arange(R) // B, K)
+    dst = nbrs.reshape(-1)
+    cross = (dst // B) != src
+    # unique remote rows (the union buffer the exchange actually ships)
+    send_rows = np.unique(dst[cross])
+    per_owner = np.bincount(send_rows // B, minlength=n_shards)
+    return {
+        "n_replicas": R,
+        "n_shards": n_shards,
+        "edges": int(R * K),
+        "cross_edges": int(cross.sum()),
+        "send_rows": int(len(send_rows)),
+        "max_send": int(per_owner.max()) if len(send_rows) else 0,
+        "allgather_rows_per_round": int(R),
+        "exchange_rows_per_round": int(n_shards * (per_owner.max() if len(send_rows) else 0)),
+    }
+
+
 def edge_failure_mask(
     n_replicas: int, k: int, drop_rate: float, seed: int = 0
 ) -> np.ndarray:

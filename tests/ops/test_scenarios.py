@@ -24,6 +24,7 @@ def test_scenario_registry_complete():
         "adcounter_10m",
         "packed_vs_dense",
         "bridge_throughput",
+        "partitioned_gossip",
     }
 
 
