@@ -276,7 +276,26 @@ def _export_state(var, state=None) -> Any:
             (_from_key(var.spec.fields[f][0]), int(epochs[f]))
             for f in np.flatnonzero(epochs)
         ]
-        return (clock_part, fields_part, epoch_part)
+        # reset-remove tombstone baselines (round 5): per counter field
+        # with a nonempty baseline, (key, [(actor, floor), ...]) — the
+        # one tomb-carrying type (OR-Set/ORSWOT resets ride in-state;
+        # gset/ivar are epoch-gated). Losing floors on the wire would
+        # resurrect reset counts at the receiver.
+        tomb_part = []
+        if state.tombs is not None:
+            for f, (key, _fcodec, _fspec) in enumerate(var.spec.fields):
+                tomb = state.tombs[f]
+                if tomb is None:
+                    continue
+                t = np.asarray(tomb)
+                if not t.any():
+                    continue
+                payload = [
+                    (_from_key(actors[a]), int(t[a]))
+                    for a in np.flatnonzero(t)
+                ]
+                tomb_part.append((_from_key(key), payload))
+        return (clock_part, fields_part, epoch_part, tomb_part)
     raise ValueError(f"bridge: unsupported type {tn!r}")
 
 
@@ -335,7 +354,7 @@ def _validate_portable(var, portable: Any) -> None:
         from ..store.store import Store
 
         parts = _split_map_portable(var, portable)
-        clock_part, fields_part, epoch_part = parts
+        clock_part, fields_part, epoch_part, tomb_part = parts
         pclock = {_to_key(a): int(c) for a, c in clock_part}
         # dynamic schema: an incoming state may carry {Name, Type} fields
         # this node has never admitted (the reference merges fields it has
@@ -346,9 +365,11 @@ def _validate_portable(var, portable: Any) -> None:
         # contract as the interner rule above).
         known = {k for k, _c, _s in spec.fields}
         fresh, fresh_shims = [], {}
-        for key in [k for k, _fd, _i in fields_part] + [
-            k for k, _e in epoch_part
-        ]:
+        for key in (
+            [k for k, _fd, _i in fields_part]
+            + [k for k, _e in epoch_part]
+            + [k for k, _t in tomb_part]
+        ):
             k = _to_key(key)
             if k not in known and k not in fresh_shims:
                 triple = Store.resolve_dynamic_field(spec, k)
@@ -372,7 +393,26 @@ def _validate_portable(var, portable: Any) -> None:
         for key, epoch in epoch_part:
             if int(epoch) < 0:
                 raise ValueError(f"negative field epoch for {key!r}")
-        _check_capacity(var.actors, pclock, "actor")
+        tomb_actors: list = []
+        for key, payload in tomb_part:
+            k = _to_key(key)
+            fcodec = (
+                fresh_shims[k].codec
+                if k in fresh_shims
+                else spec.fields[spec.field_index(k)][1]
+            )
+            if fcodec.name != "riak_dt_gcounter":
+                raise ValueError(
+                    f"field {key!r} ({fcodec.name}) carries no tombstone "
+                    "baseline on the wire (only counter floors do)"
+                )
+            for actor, floor in payload:
+                if int(floor) < 1:
+                    raise ValueError(
+                        f"non-positive counter tomb floor for {key!r}"
+                    )
+                tomb_actors.append(_to_key(actor))
+        _check_capacity(var.actors, list(pclock) + tomb_actors, "actor")
         if fresh:
             # everything validated: admit for real (bottom fields, no
             # observable change until the import lands)
@@ -432,7 +472,9 @@ def _import_state(var, portable: Any, *, _validated: bool = False):
             clock=jnp.asarray(clock), dots=jnp.asarray(dots)
         )
     if tn == "riak_dt_map":
-        clock_part, fields_part, epoch_part = _split_map_portable(var, portable)
+        clock_part, fields_part, epoch_part, tomb_part = (
+            _split_map_portable(var, portable)
+        )
         clock = np.zeros((spec.n_actors,), dtype=np.int32)
         dots = np.zeros((spec.n_fields, spec.n_actors), dtype=np.int32)
         for actor, count in clock_part:
@@ -452,18 +494,32 @@ def _import_state(var, portable: Any, *, _validated: bool = False):
             epochs = np.zeros((spec.n_fields,), dtype=np.int32)
             for key, epoch in epoch_part:
                 epochs[spec.field_index(_to_key(key))] = int(epoch)
-            out = out._replace(epochs=jnp.asarray(epochs))
+            tombs = list(out.tombs)
+            for key, payload in tomb_part:  # counter floors only
+                f = spec.field_index(_to_key(key))
+                t = np.asarray(tombs[f]).copy()
+                for actor, floor in payload:
+                    t[var.actors.intern(_to_key(actor))] = int(floor)
+                tombs[f] = jnp.asarray(t)
+            out = out._replace(
+                epochs=jnp.asarray(epochs), tombs=tuple(tombs)
+            )
         return out
     raise ValueError(f"bridge: unsupported type {tn!r}")
 
 
 def _split_map_portable(var, portable):
-    """Normalize a portable map to (clock, fields, epochs). The epoch
-    component exists only for reset_on_readd maps; its presence must match
-    the variable's mode (silent epoch loss would resurrect removed
-    contents on a later merge)."""
+    """Normalize a portable map to (clock, fields, epochs, tombs). The
+    epoch/tomb components exist only for reset_on_readd maps; their
+    presence must match the variable's mode. A 3-tuple (an epoch-bearing
+    state WITHOUT the tombs component, the pre-round-5 epoch-gate wire
+    shape) is REJECTED: under round-5 merge rules (contents join plainly
+    for non-epoch-gated types) importing it with empty baselines would
+    let a remove the sender performed resurrect contents the RECEIVER
+    still holds — the baselines are exactly the information that
+    prevents that, and the sender never recorded them."""
     if not portable:
-        return [], [], []
+        return [], [], [], []
     resets = var.spec.reset_on_readd  # class-attr default on old pickles
     if len(portable) == 2:
         if resets:
@@ -475,15 +531,21 @@ def _split_map_portable(var, portable):
                 "portable map state has no epoch component but "
                 f"{var.id!r} was declared with reset_on_readd"
             )
-        return portable[0], portable[1], []
+        return portable[0], portable[1], [], []
     if len(portable) == 3:
+        raise ValueError(
+            "portable reset-map state carries no tombstone-baseline "
+            "component (pre-round-5 wire shape); re-export it from a "
+            "current node — importing it could resurrect reset contents"
+        )
+    if len(portable) == 4:
         if not resets:
             raise ValueError(
                 "portable map state carries field epochs but "
                 f"{var.id!r} was not declared with reset_on_readd"
             )
         return portable
-    raise ValueError("portable map state must be a 2- or 3-tuple")
+    raise ValueError("portable map state must be a 2-, 3- or 4-tuple")
 
 
 def _export_value(store: Store, var_id) -> Any:

@@ -25,19 +25,42 @@ Remove/re-add semantics — two modes:
 - default (``reset_on_readd=False``): contents are join-monotone across
   remove/re-add (presence controls visibility only) — the trade that
   keeps merge a pure elementwise lattice join over fixed shapes.
-- ``reset_on_readd=True``: ``riak_dt_map``'s observable KVS semantics
-  (``riak_test/lasp_kvs_replica_test.erl:61-129``) — a remove resets the
-  field's embedded contents to bottom and bumps a per-field *epoch*
-  (``epochs: int32[F]``); merge joins embedded contents only between
-  equal epochs, a lower-epoch side contributing bottom. Sequential
-  remove-then-re-add therefore yields fresh contents, and a propagated
-  remove resets every replica. Documented divergence under CONCURRENCY:
-  an update concurrent with a remove keeps the field present (its fresh
-  presence dot survives the ORSWOT rule) but its era's contents are
-  dropped by the epoch gate — where riak_dt's reset-remove would keep
-  the concurrent update's own contribution. Dot-tracking every embedded
-  element would close that gap at the cost of a dots plane per embedded
-  slot; the epoch gate is the dense-shape compromise.
+- ``reset_on_readd=True``: ``riak_dt_map``'s observable semantics
+  (``riak_test/lasp_kvs_replica_test.erl:61-129``), including riak_dt's
+  *reset-remove* under concurrency (round 5 — closing the r4 epoch-gate
+  divergence): a remove erases exactly what the remover OBSERVED; an
+  update concurrent with the remove keeps its own contribution. The
+  reset is expressed per embedded type, always as a lattice join:
+
+  * OR-Set-family fields: remove tombstones the observed tokens
+    (``removed |= exists``) — concurrent adds mint unseen tokens and
+    survive; a re-add yields fresh contents. Exactly riak_dt. COST: the
+    tombstones pin their token slots, so remove/re-add cycling a field
+    exhausts the fixed per-actor pool after ``tokens_per_actor`` cycles
+    with a loud ``CapacityError`` — the same bounded-shape trade as
+    top-level OR-Set removes (size ``tokens_per_actor`` for the
+    workload's churn; compaction reclaims top-level sets, embedded
+    fields currently only grow).
+  * OR-SWOT fields: remove drops the observed birth dots (clock kept) —
+    the standard orswot remove-all; concurrent adds' fresh dots escape
+    the remover's clock and survive. Exactly riak_dt.
+  * G-Counter fields: the state cannot express removal, so the map
+    carries a per-field *tombstone baseline* (``tombs``: the observed
+    counts at remove, lane-joined by max); the observable value
+    subtracts the baseline (``CrdtMap.effective_field``). Concurrent
+    increments exceed the baseline on their own lane and survive —
+    riak_dt_emcntr's observable.
+  * G-Set / IVar fields (NOT riak_dt embedded types — this framework's
+    extensions): neither state can distinguish a re-add from a merged
+    old copy (no tokens, no dots), so a baseline would drop SEQUENTIAL
+    re-adds forever. They reset to bottom behind the per-field *epoch*
+    gate instead (``epochs: int32[F]``; merge joins their contents only
+    between equal eras) — sequential remove/re-add yields fresh
+    contents; an update concurrent with a remove keeps presence but
+    loses its era's contents (the r4-documented trade, now scoped to
+    these two types only). Epochs are bumped on EVERY remove
+    regardless of type: they witness resets for the strict-inflation
+    rule.
 """
 
 from __future__ import annotations
@@ -112,6 +135,24 @@ class MapState(NamedTuple):
     fields: tuple  # embedded states, schema order
     #: int32[F] reset eras (reset_on_readd mode), else None
     epochs: "jax.Array | None" = None
+    #: reset-remove tombstone baselines (reset mode), schema order: per
+    #: field an observed-counts / observed-mask plane joined by max/OR,
+    #: or None for types that express reset in-state (see module doc)
+    tombs: "tuple | None" = None
+
+
+#: embedded types whose reset rides the per-field epoch gate (see the
+#: module doc: no tokens/dots to distinguish re-adds from merged copies)
+_EPOCH_GATED = ("lasp_ivar", "lasp_gset")
+
+
+def _tomb_bottom(codec, espec):
+    """The tombstone-baseline bottom for one embedded field, or None for
+    types that need none (reset rides in-state or behind the epoch
+    gate)."""
+    if codec.name == "riak_dt_gcounter":
+        return jnp.zeros((espec.n_actors,), dtype=espec.dtype)
+    return None
 
 
 class CrdtMap(CrdtType):
@@ -125,6 +166,14 @@ class CrdtMap(CrdtType):
             fields=tuple(codec.new(espec) for _k, codec, espec in spec.fields),
             epochs=(
                 jnp.zeros((spec.n_fields,), dtype=jnp.int32)
+                if _resets(spec)
+                else None
+            ),
+            tombs=(
+                tuple(
+                    _tomb_bottom(codec, espec)
+                    for _k, codec, espec in spec.fields
+                )
                 if _resets(spec)
                 else None
             ),
@@ -163,7 +212,18 @@ class CrdtMap(CrdtType):
                 [epochs, jnp.zeros(batch + (f_new - f_old,), epochs.dtype)],
                 axis=-1,
             )
-        return state._replace(dots=dots, fields=tuple(fields), epochs=epochs)
+        tombs = state.tombs
+        if tombs is not None:
+            grown = list(tombs)
+            for _k, codec, espec in spec.fields[f_old:]:
+                bt = _tomb_bottom(codec, espec)
+                if batch and bt is not None:
+                    bt = jnp.broadcast_to(bt, batch + bt.shape)
+                grown.append(bt)
+            tombs = tuple(grown)
+        return state._replace(
+            dots=dots, fields=tuple(fields), epochs=epochs, tombs=tombs
+        )
 
     # -- updates ------------------------------------------------------------
     @staticmethod
@@ -183,18 +243,46 @@ class CrdtMap(CrdtType):
     def remove(spec: MapSpec, state: MapState, field_idx: int) -> MapState:
         """``{remove, Key}``: drop the presence dots; the clock witnesses
         them so merges cannot resurrect the removal. In reset mode the
-        embedded contents go to bottom and the field's epoch advances —
-        the reference drops its local entry outright."""
+        embedded contents are reset-removed — erasing exactly what this
+        replica observed, per the type-specific rules in the module doc —
+        and the field's epoch advances (a reset witness for strict
+        inflation; the merge gate for ivar fields only)."""
         out = state._replace(dots=state.dots.at[field_idx].set(0))
         if not _resets(spec):
             return out
-        _k, codec, espec = spec.fields[field_idx]
+        f = field_idx
+        _k, codec, espec = spec.fields[f]
         fields = list(out.fields)
-        fields[field_idx] = codec.new(espec)
+        tombs = list(out.tombs)
+        fs = fields[f]
+        if codec.name in ("lasp_orset", "lasp_orset_gbtree"):
+            fields[f] = fs._replace(removed=fs.removed | fs.exists)
+        elif codec.name == "riak_dt_orswot":
+            fields[f] = fs._replace(dots=jnp.zeros_like(fs.dots))
+        elif codec.name == "riak_dt_gcounter":
+            tombs[f] = jnp.maximum(tombs[f], fs.counts)
+        else:  # epoch-gated types (gset/ivar): bottom-reset
+            fields[f] = codec.new(espec)
         return out._replace(
             fields=tuple(fields),
-            epochs=out.epochs.at[field_idx].add(1),
+            tombs=tuple(tombs),
+            epochs=out.epochs.at[f].add(1),
         )
+
+    @staticmethod
+    def effective_field(spec: MapSpec, state: MapState, field_idx: int):
+        """The embedded state with reset-remove tombstone baselines
+        applied — what ``value`` decoding must read. The ONE definition
+        of the subtraction; plain-mode maps (and tomb-less field types)
+        return the raw embedded state."""
+        fs = state.fields[field_idx]
+        if state.tombs is None or state.tombs[field_idx] is None:
+            return fs
+        tomb = state.tombs[field_idx]
+        # riak_dt_gcounter (the one tomb-carrying type): a row that has
+        # not yet absorbed the counts its tomb floor witnesses must clip
+        # at zero, never go negative
+        return fs._replace(counts=fs.counts - jnp.minimum(fs.counts, tomb))
 
     # -- lattice ------------------------------------------------------------
     @staticmethod
@@ -208,25 +296,33 @@ class CrdtMap(CrdtType):
                 )
             )
             return MapState(clock=clock, dots=dots, fields=fields)
-        # epoch gate: embedded contents join only between equal eras; the
-        # side that has observed fewer resets contributes bottom
+        # reset mode: contents join plainly (resets ride in-state or in
+        # the tombs baselines, which join by max); only the epoch-gated
+        # types (gset/ivar) join between equal eras, the side that has
+        # observed fewer resets contributing bottom
         epochs = jnp.maximum(a.epochs, b.epochs)
         fields = []
-        for f, ((_k, codec, espec), fa, fb) in enumerate(
-            zip(spec.fields, a.fields, b.fields)
+        tombs = []
+        for f, ((_k, codec, espec), fa, fb, ta, tb) in enumerate(
+            zip(spec.fields, a.fields, b.fields, a.tombs, b.tombs)
         ):
-            bottom = codec.new(espec)
-            fa = jax.tree_util.tree_map(
-                lambda x, bot: jnp.where(a.epochs[f] == epochs[f], x, bot),
-                fa, bottom,
-            )
-            fb = jax.tree_util.tree_map(
-                lambda x, bot: jnp.where(b.epochs[f] == epochs[f], x, bot),
-                fb, bottom,
-            )
+            if codec.name in _EPOCH_GATED:
+                bottom = codec.new(espec)
+                fa = jax.tree_util.tree_map(
+                    lambda x, bot: jnp.where(a.epochs[f] == epochs[f], x, bot),
+                    fa, bottom,
+                )
+                fb = jax.tree_util.tree_map(
+                    lambda x, bot: jnp.where(b.epochs[f] == epochs[f], x, bot),
+                    fb, bottom,
+                )
             fields.append(codec.merge(espec, fa, fb))
+            tombs.append(
+                None if ta is None else jnp.maximum(ta, tb)
+            )
         return MapState(
-            clock=clock, dots=dots, fields=tuple(fields), epochs=epochs
+            clock=clock, dots=dots, fields=tuple(fields), epochs=epochs,
+            tombs=tuple(tombs),
         )
 
     @staticmethod
@@ -239,17 +335,23 @@ class CrdtMap(CrdtType):
         acc = jnp.all(a.clock == b.clock) & jnp.all(a.dots == b.dots)
         if _resets(spec):
             acc = acc & jnp.all(a.epochs == b.epochs)
+            for ta, tb in zip(a.tombs, b.tombs):
+                if ta is not None:
+                    acc = acc & jnp.all(ta == tb)
         for (_k, codec, espec), fa, fb in zip(spec.fields, a.fields, b.fields):
             acc = acc & codec.equal(espec, fa, fb)
         return acc
 
     @staticmethod
     def is_inflation(spec: MapSpec, prev: MapState, cur: MapState) -> jax.Array:
-        # clock descends (src/lasp_lattice.erl:166-167); reset eras only
-        # ever advance
+        # clock descends (src/lasp_lattice.erl:166-167); reset eras and
+        # tombstone baselines only ever advance
         out = clock_inflation(prev.clock, cur.clock)
         if _resets(spec):
             out = out & jnp.all(prev.epochs <= cur.epochs)
+            for tp, tc in zip(prev.tombs, cur.tombs):
+                if tp is not None:
+                    out = out & jnp.all(tp <= tc)
         return out
 
     @staticmethod

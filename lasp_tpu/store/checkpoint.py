@@ -141,7 +141,21 @@ def _get_state(hs: HostStore, var_id: str, template, manifest_entry: dict):
             raise IOError(f"checkpoint missing leaf {var_id}/{i}")
         # device arrays, not numpy views: codec ops use .at[] updates
         out.append(jnp.asarray(np.frombuffer(raw, dtype=dtype).reshape(shape)))
-    assert len(out) == len(leaves)
+    if len(out) < len(leaves):
+        # schema migration: round 5 appended the reset-remove tombs
+        # planes to MapState, which flatten AFTER every pre-existing
+        # leaf. A pre-round-5 reset-map snapshot therefore stores a
+        # strict prefix of today's leaves — the missing trailing planes
+        # take the template's bottoms (zero baselines: the old engine
+        # bottom-reset contents at the source, so nothing needs
+        # subtracting). Shape mismatches still fail loudly below.
+        out.extend(leaves[len(out):])
+    if len(out) != len(leaves):
+        raise IOError(
+            f"checkpoint leaf count mismatch for {var_id}: snapshot has "
+            f"{len(manifest_entry['leaves'])}, current layout needs "
+            f"{len(leaves)}"
+        )
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

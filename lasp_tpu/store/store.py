@@ -766,8 +766,13 @@ class Store:
             import numpy as np
 
             present = np.asarray(var.codec.value(var.spec, state))
+            # effective_field applies reset-remove tombstone baselines
+            # (riak_dt reset semantics); plain-mode maps pass through
             return {
-                key: self._decode_value(var.map_aux[f], state.fields[f])
+                key: self._decode_value(
+                    var.map_aux[f],
+                    var.codec.effective_field(var.spec, state, f),
+                )
                 for f, (key, _c, _s) in enumerate(var.spec.fields)
                 if present[f]
             }

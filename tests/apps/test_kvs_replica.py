@@ -136,10 +136,13 @@ def test_reset_mode_propagates_over_gossip():
         assert rt.replica_value(m, r) == {key: frozenset({"v2"})}
 
 
-def test_reset_mode_concurrent_update_documented_semantics():
-    # documented divergence (lattice/map.py module docstring): an update
-    # CONCURRENT with a remove keeps the field present (fresh dot
-    # survives) but its era's contents fall to the epoch gate
+def test_reset_mode_concurrent_update():
+    # riak_dt's reset-remove (src/lasp_lattice.erl:264-271 ordering over
+    # riak_dt_map): a remove erases what the remover OBSERVED; an update
+    # CONCURRENT with the remove keeps the field present (fresh dot
+    # survives the ORSWOT rule) AND keeps its own contribution (the
+    # concurrent add's token was never observed by the remover). Round 5
+    # closes the r4 epoch-gate divergence that dropped v2 here.
     from lasp_tpu.lattice import CrdtMap
 
     store, m = make_reset_store()
@@ -155,7 +158,92 @@ def test_reset_mode_concurrent_update_documented_semantics():
     present = CrdtMap.value(var.spec, merged)
     assert bool(present[var.spec.field_index(key)])  # field survives
     decoded = store._decode_value(var, merged)
-    assert decoded[key] == frozenset()  # contents fell to the epoch gate
+    assert decoded[key] == frozenset({"v2"})  # v1 reset, v2 survives
+    # merge order must not matter
+    merged2 = CrdtMap.merge(var.spec, b, a)
+    assert store._decode_value(var, merged2)[key] == frozenset({"v2"})
+
+
+def test_reset_mode_concurrent_counter_increment():
+    # counter fields reset via the observed-floor baseline: the remove
+    # erases the 5 observed increments; r2's concurrent +3 exceeds the
+    # floor on its own lane and survives
+    from lasp_tpu.lattice import CrdtMap
+
+    store, m = make_reset_store()
+    var = store.variable(m)
+    ky = ("Y", "riak_dt_gcounter")
+    store.update(m, ("update", [("update", ky, ("increment", 5))]), "r1")
+    a = var.state
+    b = var.state
+    a = store._apply_op(var, a, ("update", [("remove", ky)]), "r1")
+    b = store._apply_op(var, b, ("update", [("update", ky, ("increment", 3))]), "r2")
+    merged = CrdtMap.merge(var.spec, a, b)
+    decoded = store._decode_value(var, merged)
+    assert decoded[ky] == 3  # the 5 observed fell to the reset; +3 survives
+    # and a re-add increment on TOP of the merge counts from zero + 3
+    store.bind_raw(m, merged)
+    store.update(m, ("update", [("update", ky, ("increment", 2))]), "r3")
+    assert store.value(m)[ky] == 5
+
+
+def test_reset_mode_gset_field_is_epoch_gated():
+    # gset is NOT a riak_dt embedded type: with no tokens to tell a
+    # re-add from a merged old copy, a baseline would drop SEQUENTIAL
+    # re-adds forever — so gset fields reset behind the epoch gate
+    # (documented in lattice/map.py): sequential remove/re-add yields
+    # fresh contents; an update CONCURRENT with a remove keeps presence
+    # but loses its era's contents.
+    from lasp_tpu.lattice import CrdtMap
+
+    store = Store(n_actors=4)
+    m = store.declare(
+        id="kvs",
+        type="riak_dt_map",
+        fields=[(("S", "lasp_gset"), "lasp_gset", {"n_elems": 8})],
+        reset_on_readd=True,
+    )
+    var = store.variable(m)
+    key = ("S", "lasp_gset")
+    # sequential remove/re-add of the SAME element yields fresh contents
+    store.update(m, ("update", [("update", key, ("add", "seen"))]), "r1")
+    store.update(m, ("update", [("remove", key)]), "r1")
+    store.update(m, ("update", [("update", key, ("add", "seen"))]), "r1")
+    assert store.value(m) == {key: frozenset({"seen"})}
+    # concurrent update vs remove: presence survives, era contents fall
+    a = store._apply_op(var, var.state, ("update", [("remove", key)]), "r1")
+    b = store._apply_op(
+        var, var.state, ("update", [("update", key, ("add", "fresh"))]), "r2"
+    )
+    merged = CrdtMap.merge(var.spec, a, b)
+    assert bool(CrdtMap.value(var.spec, merged)[var.spec.field_index(key)])
+    assert store._decode_value(var, merged)[key] == frozenset()
+
+
+def test_reset_mode_orset_sequential_cycles_and_pool_cost():
+    # OR-Set fields give exact riak_dt reset-remove; the documented cost
+    # is that tombstones pin token slots — remove/re-add cycling beyond
+    # tokens_per_actor raises a LOUD CapacityError, never silent loss
+    from lasp_tpu.utils.interning import CapacityError
+
+    store = Store(n_actors=4)
+    m = store.declare(
+        id="kvs",
+        type="riak_dt_map",
+        fields=[(("X", "lasp_orset"), "lasp_orset",
+                 {"n_elems": 4, "tokens_per_actor": 3})],
+        reset_on_readd=True,
+    )
+    key = ("X", "lasp_orset")
+    for _cycle in range(3):
+        store.update(m, ("update", [("update", key, ("add", "x"))]), "r1")
+        assert store.value(m) == {key: frozenset({"x"})}
+        store.update(m, ("update", [("remove", key)]), "r1")
+        assert store.value(m) == {}
+    import pytest
+
+    with pytest.raises(CapacityError):
+        store.update(m, ("update", [("update", key, ("add", "x"))]), "r1")
 
 
 def test_reset_mode_merge_is_lattice():

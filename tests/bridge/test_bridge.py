@@ -517,25 +517,44 @@ def test_map_bridge_reset_mode_epochs_roundtrip():
     with BridgeServer() as server:
         with BridgeClient("127.0.0.1", server.port) as c:
             c.start("v")
-            fields = [(b"tags", Atom("lasp_gset"), {Atom("n_elems"): 4})]
+            fields = [(b"tags", Atom("lasp_gset"), {Atom("n_elems"): 4}),
+                      (b"hits", Atom("riak_dt_gcounter"), {})]
             caps = {Atom("fields"): fields, Atom("n_actors"): 4,
                     Atom("reset_on_readd"): Atom("true")}
             resp = c.call((Atom("declare"), b"m", Atom("riak_dt_map"), caps))
             assert resp == (Atom("ok"), b"m")
             c.update(b"m", (Atom("update"), b"tags", (Atom("add"), b"t1")), b"w")
+            c.update(b"m", (Atom("update"), b"hits",
+                            (Atom("increment"), 5)), b"w")
             c.update(b"m", (Atom("remove"), b"tags"), b"w")
+            c.update(b"m", (Atom("remove"), b"hits"), b"w")
             ok, val = c.update(b"m", (Atom("update"), b"tags",
                                       (Atom("add"), b"t2")), b"w")
+            ok, val = c.update(b"m", (Atom("update"), b"hits",
+                                      (Atom("increment"), 2)), b"w")
             assert ok == Atom("ok")
-            assert val == [(b"tags", [b"t2"])]  # t1 reset away
+            # t1 reset away (epoch gate); the counter counts 2 past its
+            # observed-floor of 5
+            assert val == [(b"hits", 2), (b"tags", [b"t2"])]
             ok, (type_atom, portable) = c.get(b"m")
-            assert len(portable) == 3  # (clock, fields, epochs)
-            assert portable[2] == [(b"tags", 1)]
+            assert len(portable) == 4  # (clock, fields, epochs, tombs)
+            assert sorted(portable[2]) == [(b"hits", 1), (b"tags", 1)]
+            # the counter's reset-remove floor rides the wire (gset
+            # resets are epoch-gated and carry no baseline): the
+            # receiver must never resurrect the 5 observed increments
+            assert portable[3] == [(b"hits", [(b"w", 5)])]
             # round-trip into a twin of the same mode
             resp = c.call((Atom("put"), b"m2",
                            (Atom("riak_dt_map"), portable, caps)))
             assert resp == Atom("ok")
-            assert c.read(b"m2") == (Atom("ok"), [(b"tags", [b"t2"])])
+            assert c.read(b"m2") == (Atom("ok"),
+                                     [(b"hits", 2), (b"tags", [b"t2"])])
+            # a floor-LESS epoch-bearing state (pre-round-5 wire shape)
+            # is rejected outright: importing it could resurrect resets
+            resp = c.call((Atom("put"), b"m2b",
+                           (Atom("riak_dt_map"),
+                            (portable[0], portable[1], portable[2]), caps)))
+            assert resp[0] == Atom("error")
             # a NON-reset twin must refuse the epoch-bearing state
             caps_plain = {Atom("fields"): fields, Atom("n_actors"): 4}
             resp = c.call((Atom("put"), b"m3",

@@ -107,4 +107,9 @@ def decode_map(spec, state, elems):
         f[0]: int(e)
         for f, e in zip(spec.fields, np.asarray(state.epochs))
     }
-    return (cdict, fdots, fields, epochs)
+    # tombs carry entries for counter fields only (gset is epoch-gated)
+    tombs = {
+        cname: decode_gcounter(cspec, GCounter.new(cspec)._replace(
+            counts=state.tombs[1])),
+    }
+    return (cdict, fdots, fields, epochs, tombs)

@@ -309,10 +309,24 @@ class PyMap:
 
 
 class PyResetMap(PyMap):
-    """Oracle for ``reset_on_readd`` semantics (lattice/map.py): a remove
-    resets the field's contents to bottom and bumps its epoch; merge joins
-    contents only between equal (max) epochs — a lower-epoch side
-    contributes bottom. State = (clock, fdots, fields, epochs)."""
+    """Oracle for riak_dt reset-remove semantics (lattice/map.py round 5),
+    per embedded type exactly as the dense codec scopes them:
+
+    - counter fields: a remove records the OBSERVED lane counts as a
+      tombstone baseline (lane-max joined); contents keep joining
+      plainly and the observable subtracts the floor — a concurrent
+      increment survives its field's reset.
+    - gset fields (epoch-gated — no tokens to tell a re-add from a
+      merged copy): a remove resets contents to bottom and bumps the
+      field's epoch; merge joins gset contents only between equal eras.
+
+    Epochs bump on every remove (the strict-inflation witness). State =
+    (clock, fdots, fields, epochs, tombs); tombs carries entries for
+    counter fields only."""
+
+    @classmethod
+    def _floored(cls, fname):
+        return dict(cls.SCHEMA)[fname] is PyGCounter
 
     @classmethod
     def new(cls):
@@ -321,33 +335,43 @@ class PyResetMap(PyMap):
             {},
             {f: m.new() for f, m in cls.SCHEMA},
             {f: 0 for f, _m in cls.SCHEMA},
+            {f: m.new() for f, m in cls.SCHEMA if cls._floored(f)},
         )
 
     @classmethod
     def update(cls, state, fname, actor, inner_fn):
-        clock, fdots, fields, epochs = state
+        clock, fdots, fields, epochs, tombs = state
         c, fd, fl = PyMap.update((clock, fdots, fields), fname, actor, inner_fn)
-        return (c, fd, fl, dict(epochs))
+        return (c, fd, fl, dict(epochs), dict(tombs))
 
     @classmethod
     def remove(cls, state, fname):
-        clock, fdots, fields, epochs = state
-        c, fd, _fl = PyMap.remove((clock, fdots, fields), fname)
-        fields = dict(fields)
-        fields[fname] = dict(cls.SCHEMA)[fname].new()
+        clock, fdots, fields, epochs, tombs = state
+        c, fd, fl = PyMap.remove((clock, fdots, fields), fname)
+        m = dict(cls.SCHEMA)[fname]
+        fl = dict(fl)
+        tombs = dict(tombs)
+        if cls._floored(fname):
+            tombs[fname] = m.merge(tombs[fname], fields[fname])  # observed
+        else:
+            fl[fname] = m.new()  # epoch-gated: bottom-reset
         epochs = dict(epochs)
         epochs[fname] += 1
-        return (c, fd, fields, epochs)
+        return (c, fd, fl, epochs, tombs)
 
     @classmethod
     def merge(cls, a, b):
-        ca, fa, ia, ea = a
-        cb, fb, ib, eb = b
+        ca, fa, ia, ea, ta = a
+        cb, fb, ib, eb, tb = b
         clock, fdots = merge_dot_entries(ca, fa, cb, fb)
         epochs = {f: max(ea[f], eb[f]) for f, _m in cls.SCHEMA}
         fields = {}
         for f, m in cls.SCHEMA:
-            xa = ia[f] if ea[f] == epochs[f] else m.new()
-            xb = ib[f] if eb[f] == epochs[f] else m.new()
-            fields[f] = m.merge(xa, xb)
-        return (clock, fdots, fields, epochs)
+            if cls._floored(f):
+                fields[f] = m.merge(ia[f], ib[f])
+            else:
+                xa = ia[f] if ea[f] == epochs[f] else m.new()
+                xb = ib[f] if eb[f] == epochs[f] else m.new()
+                fields[f] = m.merge(xa, xb)
+        tombs = {f: PyGCounter.merge(ta[f], tb[f]) for f in ta}
+        return (clock, fdots, fields, epochs, tombs)

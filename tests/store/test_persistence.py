@@ -392,8 +392,10 @@ def test_cli_simulate_gcounter(capsys):
                "--writers", "4", "--topology", "ring"])
     assert rc == 0
     out = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    # one increment per writer lane, max-merged across the population
-    assert out["value_size"] == 4
+    # one increment per writer lane, max-merged across the population;
+    # the counter total rides under "value" (a number), not "value_size"
+    assert out["value"] == 4
+    assert "value_size" not in out
 
 
 def test_pylog_fallback_compact_and_keys(tmp_path):
@@ -447,3 +449,31 @@ def test_runtime_checkpoint_round_trips_packed_mode(tmp_path):
     rt2.update_batch("s", [(1, ("add", "y"), "w")])
     rt2.run_to_convergence()
     assert rt2.coverage_value("s") == {"x", "y"}
+
+
+def test_checkpoint_migrates_pre_tombs_reset_map(tmp_path):
+    # a pre-round-5 snapshot of a reset_on_readd map stores a strict
+    # prefix of today's MapState leaves (no tombs planes); loading must
+    # fill the missing trailing planes with bottoms, not crash
+    from lasp_tpu.store import Store
+    from lasp_tpu.store.checkpoint import load_store, save_store
+
+    store = Store(n_actors=4)
+    m = store.declare(
+        id="kvs",
+        type="riak_dt_map",
+        fields=[(("Y", "riak_dt_gcounter"), "riak_dt_gcounter", {})],
+        reset_on_readd=True,
+    )
+    ky = ("Y", "riak_dt_gcounter")
+    store.update(m, ("update", [("update", ky, ("increment", 3))]), "r1")
+    var = store.variable(m)
+    var.state = var.state._replace(tombs=None)  # the round-4 leaf layout
+    path = str(tmp_path / "old.log")
+    save_store(store, path)
+    restored = load_store(path)
+    assert restored.value(m)[ky] == 3  # zero baselines: nothing subtracted
+    # and the restored map keeps working under round-5 semantics
+    restored.update(m, ("update", [("remove", ky)]), "r1")
+    restored.update(m, ("update", [("update", ky, ("increment", 4))]), "r1")
+    assert restored.value(m)[ky] == 4
