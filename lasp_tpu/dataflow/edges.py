@@ -254,7 +254,19 @@ class PairwiseEdge(Edge):
             # the right-live state freezes as of the last propagation
             # where the element was left-absent. The dataflow statem
             # (tests/dataflow/test_dataflow_statem.py) pins this exact
-            # semantics against a snapshot-based oracle.
+            # semantics with a round-simulating token oracle.
+            #
+            # DOCUMENTED REFERENCE DELTA (diamonds): the output token
+            # axis is the CONCAT of the two sides' axes, so a token that
+            # reaches this union through BOTH inputs (e.g. the left is
+            # derived from the right's source) occupies two independent
+            # columns. The reference keys tokens globally, so a
+            # left-path tombstone would also kill the identical
+            # right-path copy absorbed during a left-absent window; here
+            # that frozen copy stays live — strictly MORE-live, only for
+            # diamond lineage + a left-absent absorption window + a
+            # later removal. Pinned by
+            # tests/dataflow/test_combinators.py::test_union_diamond_frozen_copy.
             lmember = jnp.any(le, axis=-1, keepdims=True)
             exists = jnp.concatenate([le, re_ & ~lmember], axis=-1)
             removed = jnp.concatenate([lr, rr & ~lmember], axis=-1)

@@ -262,3 +262,35 @@ def test_ivar_bind_to():
     store.update(a, ("set", "hello"), "actor")
     graph.propagate()
     assert store.value(b) == "hello"
+
+
+def test_union_diamond_frozen_copy():
+    """Documented reference delta (edges.py PairwiseEdge): a token
+    reaching a union through BOTH inputs (diamond lineage) occupies two
+    concat-axis columns. When the element enters the derived LEFT a
+    round after the right absorbed it, a later removal kills only the
+    left-path copy — the frozen right-path copy stays live, where the
+    reference's global token ids would collapse the two and remove the
+    element. This test pins the engine's actual behavior so any future
+    change to it is a conscious one."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=4)
+    src = store.declare(id="s", type="lasp_orset", n_elems=8,
+                        tokens_per_actor=8)
+    graph = Graph(store)
+    d0 = graph.union(src, src, dst="d0")       # derived mirror of src
+    d1 = graph.union(d0, src, dst="d1")        # diamond: src via both
+    store.update(src, ("add", "x"), "w")
+    graph.propagate()
+    # round 1 of that propagate saw d0 left-absent for "x", so d1
+    # absorbed src's right-side copy
+    assert store.value(d1) == frozenset({"x"})
+    store.update(src, ("remove", "x"), "w")
+    graph.propagate()
+    assert store.value(src) == frozenset()
+    assert store.value(d0) == frozenset()      # left path saw the remove
+    # the engine's frozen right-path copy survives (the reference would
+    # return frozenset() here — global token ids collapse the diamond)
+    assert store.value(d1) == frozenset({"x"})

@@ -5,31 +5,48 @@ point, every derived variable's live value equals the oracle's
 prediction, no matter what causal machinery (tokens, pair universes,
 tombstone flow) produced it.
 
-The oracle models Lasp's combinators, not clean set algebra — building
-it surfaced exactly the corners that differ:
+Round-5 oracle design: instead of encoding the tricky consequences of
+Lasp's combinator semantics (union freeze points, intersection's
+either-side causality) as closed-form rules over per-propagate
+snapshots, the oracle SIMULATES the engine's dynamics at the token-dict
+level — a Python model of ``src/lasp_core.erl``'s combinators over
+``elem -> {token_id: deleted}`` orddicts, run in the same synchronous
+rounds to the same fixed point:
 
 - ``union`` is LEFT-BIASED (``orddict:merge`` keeping left,
-  ``src/lasp_core.erl:616-621``): right-side tokens flow into the
-  monotone output only while the element is absent from the left DICT
-  (live or tombstoned); once it appears there, later right-side
-  removals are invisible — the right-live state freezes as of the last
-  propagate where the element was left-absent. The oracle tracks
-  per-propagate source snapshots to evaluate that frozen state.
+  ``src/lasp_core.erl:616-621``): the model computes ``l[e] if e in l
+  else r[e]`` per round, and the output variable's join-monotone bind
+  does the freezing — exactly the engine's mechanism, so the one-round
+  shift that derived LEFT inputs introduce (membership is read from
+  pre-round state) emerges instead of being special-cased. The r4
+  restriction of union lefts to source variables is LIFTED.
 - ``intersection`` gates on membership in BOTH dicts but its causality
   is the UNION of both token dicts (``src/lasp_lattice.erl:311-312``):
-  the output element is live iff live on EITHER side — removing it from
-  just one input does not remove it from the intersection.
-- ``product`` pairs are live iff both coordinates are live
-  (``deleted = XDel orelse YDel``) — clean algebra.
-- ``map``/``fold``/``filter`` preserve causality per element image —
-  clean algebra over live values; dict membership flows through images.
+  live iff live on either side.
+- ``product`` pairs carry token pairs with ``deleted = XDel orelse
+  YDel`` (``src/lasp_core.erl`` causal product).
+- ``map``/``fold``/``filter`` flow each preimage's token dict to its
+  image (images merge preimage causality).
 
-Union LEFT inputs are restricted to source variables in the random DAG:
-for a derived left, the freeze point shifts by one propagation round
-(membership computed from pre-round state), which the per-propagate
-snapshot oracle cannot see. Rights are unrestricted, including chained
-unions (the freeze rule recurses through snapshots)."""
+Token identity models the ENGINE, not the reference: union/intersection
+outputs CONCAT their input token axes, so the oracle tags token ids per
+side — a diamond (the same source token reaching a union via both
+inputs) keeps two independent copies, exactly like the dense encoding.
+The one observable consequence (a left-path tombstone cannot kill a
+frozen right-path copy, where the reference's global token ids would) is
+a documented reference delta, pinned separately in
+test_combinators.py::test_union_diamond_frozen_copy. This oracle found
+it: the r4 snapshot oracle's source-left restriction was masking it.
 
+Because both the engine and the model are deterministic synchronous
+round systems with identical per-round dynamics, their trajectories —
+and therefore their fixed points — coincide exactly.
+
+map/fold still avoid product inputs in the random DAG: their token
+spaces multiply into OOM territory at soak budgets (an engine capacity
+bound, not a semantics gap)."""
+
+import itertools
 import os
 import random
 
@@ -51,94 +68,130 @@ FNS = {
 }
 
 
+def _join_entry(a: dict, b: dict) -> dict:
+    """Join two token dicts: union of ids, deleted flags OR-monotone."""
+    out = dict(a)
+    for tid, dead in b.items():
+        out[tid] = out.get(tid, False) or dead
+    return out
+
+
+def _join_dict(a: dict, b: dict) -> dict:
+    out = {e: dict(toks) for e, toks in a.items()}
+    for e, toks in b.items():
+        out[e] = _join_entry(out.get(e, {}), toks)
+    return out
+
+
 class Oracle:
-    """Evaluates live(node, t) and member(node, t) — the live value and
-    the dict key set of any DAG node at propagate-snapshot ``t`` — from
-    the recorded per-propagate source snapshots."""
+    """Token-dict model of the dataflow engine: sources hold
+    ``elem -> {token_id: deleted}`` orddicts mutated by client ops;
+    ``propagate`` runs the combinator DAG in synchronous rounds (every
+    edge reads the PREVIOUS round's node states; outputs join-bind) to
+    the fixed point, exactly like ``Graph.propagate``."""
 
-    def __init__(self):
-        #: per propagate: {src: (frozenset live, frozenset ever)}
-        self.snaps: list = []
+    def __init__(self, sources, edges):
+        #: edges: [(out_id, node_tuple)] in creation order; node tuples
+        #: reference input ids, e.g. ("union", "src0", "d2")
+        self.edges = edges
+        self.state = {s: {} for s in sources}
+        for out, _node in edges:
+            self.state.setdefault(out, {})
+        self._tokens = itertools.count()
 
-    def snapshot(self, live, ever):
-        self.snaps.append(
-            {s: (frozenset(live[s]), frozenset(ever[s])) for s in live}
+    # -- client ops on sources -----------------------------------------------
+    def add(self, src, e):
+        entry = self.state[src].setdefault(e, {})
+        entry[next(self._tokens)] = False
+
+    def remove(self, src, e):
+        for tid in self.state[src].get(e, {}):
+            self.state[src][e][tid] = True
+
+    # -- one synchronous round -----------------------------------------------
+    def _edge_out(self, node, prev) -> dict:
+        kind = node[0]
+        if kind in ("map", "fold"):
+            # image tokens are keyed by (preimage, token) — the engine's
+            # S*T token space (edges.py ProjectEdge): colliding images
+            # merge their preimages' CAUSALITY without conflating their
+            # token columns
+            out: dict = {}
+            for e, toks in prev[node[2]].items():
+                images = (
+                    FNS[node[1]](e) if kind == "fold" else [FNS[node[1]](e)]
+                )
+                tagged = {(e, t): d for t, d in toks.items()}
+                for img in images:
+                    out[img] = _join_entry(out.get(img, {}), tagged)
+            return out
+        if kind == "filter":
+            return {
+                e: dict(toks)
+                for e, toks in prev[node[2]].items()
+                if FNS[node[1]](e)
+            }
+        if kind == "union":
+            # left-biased orddict:merge — and, faithful to the ENGINE's
+            # dense concat token axis (not the reference's global token
+            # ids), each side's tokens are tagged by side: a token
+            # reaching the union through BOTH inputs (a diamond) keeps
+            # two independent columns, so a tombstone arriving via the
+            # left path never kills the frozen right-side copy. See
+            # edges.py PairwiseEdge for the documented reference delta.
+            l, r = prev[node[1]], prev[node[2]]
+            out = {
+                e: {("L", t): d for t, d in toks.items()}
+                for e, toks in l.items()
+            }
+            for e, toks in r.items():
+                if e not in l:
+                    out[e] = {("R", t): d for t, d in toks.items()}
+            return out
+        if kind == "intersection":
+            l, r = prev[node[1]], prev[node[2]]
+            return {
+                e: {
+                    **{("L", t): d for t, d in l[e].items()},
+                    **{("R", t): d for t, d in r[e].items()},
+                }
+                for e in l.keys() & r.keys()
+            }
+        if kind == "product":
+            l, r = prev[node[1]], prev[node[2]]
+            out = {}
+            for a, ta in l.items():
+                for b, tb in r.items():
+                    out[(a, b)] = {
+                        (x, y): dx or dy
+                        for (x, dx) in ta.items()
+                        for (y, dy) in tb.items()
+                    }
+            return out
+        if kind == "bind_to":
+            return {e: dict(toks) for e, toks in prev[node[1]].items()}
+        raise AssertionError(kind)
+
+    def propagate(self):
+        while True:
+            prev = self.state
+            new = dict(prev)
+            changed = False
+            for out, node in self.edges:
+                candidate = _join_dict(prev[out], self._edge_out(node, prev))
+                if candidate != prev[out]:
+                    changed = True
+                new[out] = candidate
+            self.state = new
+            if not changed:
+                return
+
+    def live(self, vid) -> frozenset:
+        return frozenset(
+            e
+            for e, toks in self.state[vid].items()
+            if any(not dead for dead in toks.values())
         )
-
-    def live(self, node, t) -> frozenset:
-        kind = node[0]
-        if kind == "src":
-            return self.snaps[t][node[1]][0]
-        if kind == "map":
-            return frozenset(FNS[node[1]](x) for x in self.live(node[2], t))
-        if kind == "fold":
-            out = set()
-            for x in self.live(node[2], t):
-                out.update(FNS[node[1]](x))
-            return frozenset(out)
-        if kind == "filter":
-            return frozenset(
-                x for x in self.live(node[2], t) if FNS[node[1]](x)
-            )
-        if kind == "union":
-            l, r = node[1], node[2]
-            out = set(self.live(l, t))
-            for e in self.member(r, t):
-                # freeze point: the last propagate at-or-before t where e
-                # was absent from the LEFT dict; right-live flows only
-                # through those propagates (left-biased merge)
-                pk = None
-                for tt in range(t, -1, -1):
-                    if e not in self.member(l, tt):
-                        pk = tt
-                        break
-                if pk is not None and e in self.live(r, pk):
-                    out.add(e)
-            return frozenset(out)
-        if kind == "intersection":
-            both = self.member(node[1], t) & self.member(node[2], t)
-            either_live = self.live(node[1], t) | self.live(node[2], t)
-            return frozenset(both & either_live)
-        if kind == "product":
-            return frozenset(
-                (a, b)
-                for a in self.live(node[1], t)
-                for b in self.live(node[2], t)
-            )
-        if kind == "bind_to":
-            return self.live(node[1], t)
-        raise AssertionError(kind)
-
-    def member(self, node, t) -> frozenset:
-        kind = node[0]
-        if kind == "src":
-            return self.snaps[t][node[1]][1]
-        if kind == "map":
-            return frozenset(
-                FNS[node[1]](x) for x in self.member(node[2], t)
-            )
-        if kind == "fold":
-            out = set()
-            for x in self.member(node[2], t):
-                out.update(FNS[node[1]](x))
-            return frozenset(out)
-        if kind == "filter":
-            return frozenset(
-                x for x in self.member(node[2], t) if FNS[node[1]](x)
-            )
-        if kind == "union":
-            return self.member(node[1], t) | self.member(node[2], t)
-        if kind == "intersection":
-            return self.member(node[1], t) & self.member(node[2], t)
-        if kind == "product":
-            return frozenset(
-                (a, b)
-                for a in self.member(node[1], t)
-                for b in self.member(node[2], t)
-            )
-        if kind == "bind_to":
-            return self.member(node[1], t)
-        raise AssertionError(kind)
 
 
 @pytest.mark.parametrize("seed", range(N_SEEDS))
@@ -147,20 +200,22 @@ def test_dataflow_statem(seed):
     store = Store(n_actors=4)
     graph = Graph(store)
 
-    sources, live, ever = [], {}, {}
+    sources = []
     for i in range(3):
         vid = store.declare(id=f"src{i}", type="lasp_orset", n_elems=16,
                             tokens_per_actor=max(16, N_OPS))
         sources.append(vid)
-        live[vid] = set()
-        ever[vid] = set()
 
-    def has_product(node):
+    def has_product(node_id, nodes):
+        node = nodes.get(node_id)
+        if node is None:
+            return False  # a source
         return node[0] == "product" or any(
-            has_product(x) for x in node[1:] if isinstance(x, tuple)
+            has_product(x, nodes) for x in node[1:]
         )
 
-    nodes = {vid: ("src", vid) for vid in sources}
+    nodes: dict = {}  # out_id -> node tuple over INPUT IDS
+    edges: list = []
     ids = list(sources)
     for j in range(rng.randint(3, 6)):
         kind = rng.choice(
@@ -168,7 +223,7 @@ def test_dataflow_statem(seed):
              "bind_to"]
         )
         a = rng.choice(ids)
-        if kind in ("map", "fold") and has_product(nodes[a]):
+        if kind in ("map", "fold") and has_product(a, nodes):
             # map/fold token spaces are S*T of their input; over a
             # product (whose token space is already Tl*Tr) the widths
             # multiply into OOM territory at soak op budgets — only
@@ -177,52 +232,54 @@ def test_dataflow_statem(seed):
         if kind == "map":
             fn = rng.choice(["x7", "neg"])
             out = graph.map(a, FNS[fn], dst=f"d{j}", dst_elems=64)
-            nodes[out] = ("map", fn, nodes[a])
+            nodes[out] = ("map", fn, a)
         elif kind == "fold":
             out = graph.fold(a, FNS["dup"], dst=f"d{j}", dst_elems=64)
-            nodes[out] = ("fold", "dup", nodes[a])
+            nodes[out] = ("fold", "dup", a)
         elif kind == "filter":
             fn = rng.choice(["even", "small"])
             out = graph.filter(a, FNS[fn], dst=f"d{j}")
-            nodes[out] = ("filter", fn, nodes[a])
+            nodes[out] = ("filter", fn, a)
         elif kind == "bind_to":
             out = graph.bind_to(f"d{j}", a)
-            nodes[out] = ("bind_to", nodes[a])
+            nodes[out] = ("bind_to", a)
         elif kind == "union":
-            left = rng.choice(sources)  # see module docstring
+            # round 5: the LEFT may be ANY node, derived included — the
+            # r4 source-only restriction is lifted (module docstring)
+            left = rng.choice(ids)
             out = graph.union(left, a, dst=f"d{j}")
-            nodes[out] = ("union", nodes[left], nodes[a])
+            nodes[out] = ("union", left, a)
         else:
             b = rng.choice(ids)
             if kind == "product":
                 # products multiply token widths: sources only
                 a, b = rng.choice(sources), rng.choice(sources)
             out = getattr(graph, kind)(a, b, dst=f"d{j}")
-            nodes[out] = (kind, nodes[a], nodes[b])
+            nodes[out] = (kind, a, b)
+        edges.append((out, nodes[out]))
         ids.append(out)
 
-    oracle = Oracle()
+    oracle = Oracle(sources, edges)
+    live = {s: set() for s in sources}
 
     def check():
         graph.propagate()
-        oracle.snapshot(live, ever)
-        t = len(oracle.snaps) - 1
-        for vid, node in nodes.items():
-            assert store.value(vid) == oracle.live(node, t), (
-                seed, vid, node,
-            )
+        oracle.propagate()
+        for vid in ids:
+            assert store.value(vid) == oracle.live(vid), (seed, vid)
 
     for _step in range(N_OPS):
         src = rng.choice(sources)
         if live[src] and rng.random() < 0.3:
             e = rng.choice(sorted(live[src]))
             store.update(src, ("remove", e), "w")
+            oracle.remove(src, e)
             live[src].discard(e)
         else:
             e = rng.choice(DOMAIN)
             store.update(src, ("add", e), "w")
+            oracle.add(src, e)
             live[src].add(e)
-            ever[src].add(e)
         if rng.random() < 0.5:
             check()
     check()
