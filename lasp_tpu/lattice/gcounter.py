@@ -36,6 +36,7 @@ class GCounterState(NamedTuple):
 
 class GCounter(CrdtType):
     name = "riak_dt_gcounter"
+    leafwise_join = "max"
 
     @staticmethod
     def new(spec: GCounterSpec) -> GCounterState:

@@ -33,6 +33,7 @@ class GSetState(NamedTuple):
 
 class GSet(CrdtType):
     name = "lasp_gset"
+    leafwise_join = "or"
 
     @staticmethod
     def new(spec: GSetSpec) -> GSetState:

@@ -64,6 +64,7 @@ class ORSetState(NamedTuple):
 
 class ORSet(CrdtType):
     name = "lasp_orset"
+    leafwise_join = "or"
 
     @staticmethod
     def new(spec: ORSetSpec) -> ORSetState:

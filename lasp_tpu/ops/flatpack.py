@@ -68,6 +68,7 @@ def _unpack_bits(spec: FlatORSetSpec, words: jax.Array) -> jax.Array:
 
 class FlatORSet:
     name = "lasp_orset_flat"
+    leafwise_join = "or"
 
     @staticmethod
     def new(spec: FlatORSetSpec) -> FlatORSetState:

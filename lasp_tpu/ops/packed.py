@@ -62,6 +62,7 @@ def _word_bit(token_idx):
 
 class PackedORSet:
     name = "lasp_orset_packed"
+    leafwise_join = "or"
 
     @staticmethod
     def new(spec: PackedORSetSpec) -> PackedORSetState:
