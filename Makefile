@@ -1,0 +1,23 @@
+# Top-level conveniences; the native engines build via native/Makefile
+# (tests/conftest.py invokes it automatically).
+
+.PHONY: test bench native bridge-e2e
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+native:
+	$(MAKE) -C native
+
+# Real-BEAM end-to-end of the lasp_backend delegation: starts the bridge
+# server, compiles bridge/erlang/lasp_tpu_backend.erl on a BEAM (local
+# escript, or a stock `erlang:26` container when only docker exists) and
+# drives start/put/get/merge_batch against the live server. See
+# tools/bridge_e2e.sh; a Python twin of the exact scenario runs in the
+# normal suite (tests/bridge/test_beam_e2e.py) so protocol drift shows
+# up even on BEAM-less machines like this image.
+bridge-e2e:
+	bash tools/bridge_e2e.sh

@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# One-command BEAM end-to-end: start the bridge server, run the Erlang
+# adapter's e2e escript against it (local escript if present, else a
+# stock erlang docker image), shut down. Green run == the .erl adapter
+# compiles AND speaks the live protocol.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PORT="${LASP_TPU_BRIDGE_PORT:-9193}"
+
+# pick the BEAM runtime FIRST: without one, fail instantly instead of
+# paying the jax-importing server spawn and binding the port for nothing
+RUNTIME=""
+if command -v escript >/dev/null 2>&1; then
+    RUNTIME="escript"
+elif command -v docker >/dev/null 2>&1; then
+    RUNTIME="docker"
+else
+    echo "bridge-e2e: neither escript nor docker on PATH" >&2
+    echo "(install erlang, or docker for the containerized run)" >&2
+    exit 3
+fi
+
+# the docker path reaches us via the host-gateway interface, not
+# loopback — bind wide for it, loopback-only otherwise
+BIND="127.0.0.1"
+[ "$RUNTIME" = "docker" ] && BIND="0.0.0.0"
+
+JAX_PLATFORMS=cpu python -m lasp_tpu.cli bridge --host "$BIND" --port "$PORT" &
+SRV=$!
+trap 'kill "$SRV" 2>/dev/null || true; wait "$SRV" 2>/dev/null || true' EXIT
+
+# wait for OUR listener: the connect probe alone would happily find a
+# foreign process already bound to the port while our server died with
+# address-in-use — verify the spawned pid is still alive each poll
+for _ in $(seq 100); do
+    if ! kill -0 "$SRV" 2>/dev/null; then
+        echo "bridge-e2e: server process died (port $PORT already in use?)" >&2
+        exit 4
+    fi
+    if python - "$PORT" <<'EOF'
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", int(sys.argv[1])), timeout=0.5).close()
+except OSError:
+    sys.exit(1)
+EOF
+    then
+        break
+    fi
+    sleep 0.2
+done
+
+if [ "$RUNTIME" = "escript" ]; then
+    escript lasp_tpu/bridge/erlang/e2e.escript "$PORT"
+else
+    # host.docker.internal + host-gateway reaches the host's listener on
+    # both Linux and Docker Desktop (--network host is a VM-scoped no-op
+    # on macOS/Windows); the adapter honors LASP_TPU_BRIDGE_HOST
+    docker run --rm \
+        --add-host=host.docker.internal:host-gateway \
+        -e LASP_TPU_BRIDGE_HOST=host.docker.internal \
+        -v "$PWD/lasp_tpu/bridge/erlang":/e2e:ro \
+        erlang:26 escript /e2e/e2e.escript "$PORT"
+fi
