@@ -127,6 +127,41 @@ def _fail_record(error: str) -> dict:
     }
 
 
+def _load_oneshot_capture() -> dict | None:
+    """Summarize tools/capture_out/oneshot_r05.jsonl (the single-connect
+    TPU capture's staged records) for embedding in a CPU-fallback
+    artifact: the LAST record per stage that ran on a real device, each
+    carrying its own unix timestamp ``t`` — labeled evidence from
+    earlier in the round, never a substitute for the live measurement."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tools", "capture_out", "oneshot_r05.jsonl",
+    )
+    if not os.path.exists(path):
+        return None
+    stages: dict = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                stage = rec.pop("stage", None)
+                if stage and "error" not in rec:
+                    stages[stage] = rec
+    except OSError:
+        return None
+    if not stages or "init" not in stages:
+        return None
+    return {
+        "note": "captured by tools/tpu_oneshot.py earlier in the round "
+                "(unix timestamps in 't'); the headline above is the "
+                "cpu fallback",
+        **stages,
+    }
+
+
 def _extract_json(out: str) -> dict | None:
     for line in reversed(out.strip().splitlines()):
         line = line.strip()
@@ -183,6 +218,14 @@ def main() -> int:
                     if tpu_ok is False
                     else "TPU attempts failed; measured on CPU fallback"
                 )
+                # a capture watcher (tools/tpu_capture.py) may have landed
+                # TPU measurements EARLIER in the round while the tunnel
+                # was briefly healthy: package them into this artifact,
+                # clearly labeled with their own timestamps, instead of
+                # losing them to the fallback
+                capture = _load_oneshot_capture()
+                if capture:
+                    record.setdefault("detail", {})["tpu_capture"] = capture
             _emit(record)
             return 0
         errors.append(

@@ -216,7 +216,10 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
             f"plan was built for {plan['n_shards']} shards but mesh axis "
             f"{axis!r} has {mesh.shape[axis]} devices — rebuild the plan"
         )
+    from .gossip import _leafwise_op
+
     vmerge = jax.vmap(lambda a, b: codec.merge(spec, a, b))
+    leaf_op = _leafwise_op(codec)
     k_cols = plan["idx"].shape[1]
 
     def local(block, send_idx, idx):
@@ -231,6 +234,17 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
             ),
             block, gathered,
         )
+        if leaf_op is not None:
+            # leafwise codecs: fuse all neighbor lookups + joins of one
+            # plane into a single expression (same move as gossip_round's
+            # fast path)
+            def leaf(b, f):
+                acc = b
+                for k in range(k_cols):
+                    acc = leaf_op(acc, f[idx[:, k]])
+                return acc
+
+            return jax.tree_util.tree_map(leaf, block, full)
         acc = block
         for k in range(k_cols):
             nbr = jax.tree_util.tree_map(lambda f: f[idx[:, k]], full)
