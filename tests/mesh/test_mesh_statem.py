@@ -21,6 +21,17 @@ sets — valid because every CRDT here is a join of its op history:
   implementation carries tombstoned tokens or vclock-dominated dots;
   a remove kills the adds visible at the removing row, a concurrent
   (unseen) add survives the merge.
+- riak_dt_map (round 5, BOTH re-add modes, schemaless dynamic fields):
+  field updates mint presence-touch ops; a field remove kills the
+  touches visible at the removing row (presence = any unkilled touch —
+  the ORSWOT dot rule). Contents: in default mode content ops are
+  join-monotone (a remove kills presence only); in reset_on_readd mode
+  the remove ALSO kills the content ops visible at the remover — which
+  is exactly riak_dt reset-remove (observed OR-Set tokens tombstone,
+  observed counter increments floor away; a concurrent unseen update
+  survives). One kill rule models tokens, dots, AND floors, because
+  each actor's increments spread as nested prefixes under the one-home
+  discipline.
 
 Membership mirrors resize: joins start empty; graceful leaves hand the
 departing rows' op sets to surviving row 0; crash leaves drop them.
@@ -40,6 +51,7 @@ from lasp_tpu.dataflow import Graph
 from lasp_tpu.mesh import ReplicatedRuntime
 from lasp_tpu.mesh.topology import random_regular, ring
 from lasp_tpu.store import Store
+from lasp_tpu.utils.interning import CapacityError
 
 N_SEEDS = int(os.environ.get("LASP_STATEM_SEEDS", "6"))
 N_OPS = int(os.environ.get("LASP_STATEM_OPS", "50"))
@@ -113,6 +125,63 @@ class MeshModel:
     def counter_of(seen: set) -> int:
         return sum(o[2] for o in seen if o[0] == "inc")
 
+    # -- riak_dt_map (composed fields under presence dots) -------------------
+    def map_update(self, row, var, key, content):
+        """One {update, Key, InnerOp}: a presence touch + a content op.
+        ``content``: ("madd", elem) or ("minc", by)."""
+        self.seen[row].add(("mtouch", self.next_id, var, key))
+        self.next_id += 1
+        self.seen[row].add((content[0], self.next_id, var, key, content[1]))
+        self.next_id += 1
+
+    def map_present(self, row, var, key) -> bool:
+        seen = self.seen[row]
+        killed = set()
+        for o in seen:
+            if o[0] == "mkill":
+                killed |= o[2]
+        return any(
+            o[0] == "mtouch" and o[2] == var and o[3] == key
+            and o[1] not in killed
+            for o in seen
+        )
+
+    def map_remove(self, row, var, key, reset: bool):
+        """{remove, Key}: kill the touches observed at this row; in reset
+        mode also kill the observed CONTENT ops (riak_dt reset-remove)."""
+        kinds = ("mtouch", "madd", "minc") if reset else ("mtouch",)
+        killed = frozenset(
+            o[1] for o in self.seen[row]
+            if o[0] in kinds and o[2] == var and o[3] == key
+        )
+        self.seen[row].add(("mkill", self.next_id, killed))
+        self.next_id += 1
+
+    def map_value(self, row, var) -> dict:
+        seen = self.seen[row]
+        killed = set()
+        for o in seen:
+            if o[0] == "mkill":
+                killed |= o[2]
+        out: dict = {}
+        for o in seen:
+            if o[0] == "mtouch" and o[2] == var and o[1] not in killed:
+                out.setdefault(o[3], None)
+        for key in list(out):
+            if key[1] == "riak_dt_gcounter":
+                out[key] = sum(
+                    o[4] for o in seen
+                    if o[0] == "minc" and o[2] == var and o[3] == key
+                    and o[1] not in killed
+                )
+            else:
+                out[key] = frozenset(
+                    o[4] for o in seen
+                    if o[0] == "madd" and o[2] == var and o[3] == key
+                    and o[1] not in killed
+                )
+        return out
+
     def orset_value(self, row, var="s") -> frozenset:
         return self.orset_of(self.seen[row], var)
 
@@ -142,6 +211,14 @@ def test_mesh_statem(seed):
     c = store.declare(id="c", type="riak_dt_gcounter", n_actors=N_ACTORS)
     w = store.declare(id="w", type="riak_dt_orswot", n_elems=len(ELEMS),
                       n_actors=N_ACTORS)
+    # SCHEMALESS maps (round 5): fields admit dynamically mid-run, one
+    # map per re-add mode — contents join-monotone vs riak_dt
+    # reset-remove — against the one op-kill model
+    m_def = store.declare(id="m_def", type="riak_dt_map",
+                          n_actors=N_ACTORS)
+    m_rst = store.declare(id="m_rst", type="riak_dt_map",
+                          n_actors=N_ACTORS, reset_on_readd=True)
+    MKEYS = [("S1", "lasp_orset"), ("C1", "riak_dt_gcounter")]
     rt = ReplicatedRuntime(store, Graph(store), n, nbrs,
                            debug_actors=True, donate_steps=False)
     model = MeshModel(n, nbrs)
@@ -158,6 +235,8 @@ def test_mesh_statem(seed):
             assert rt.replica_value(s, r) == model.orset_value(r), r
             assert rt.replica_value(w, r) == model.orset_value(r, "w"), r
             assert rt.replica_value(c, r) == model.counter_value(r), r
+            assert rt.replica_value(m_def, r) == model.map_value(r, "md"), r
+            assert rt.replica_value(m_rst, r) == model.map_value(r, "mr"), r
 
     for _step in range(N_OPS):
         roll = rng.random()
@@ -189,7 +268,7 @@ def test_mesh_statem(seed):
                 by = rng.randint(1, 3)
                 rt.update_at(r, c, ("increment", by), actor(r))
                 model.increment(r, by)
-        elif roll < 0.5:  # batched writes
+        elif roll < 0.42:  # batched writes
             ops, k = [], rng.randint(1, 4)
             for _ in range(k):
                 r = rng.randrange(model.n)
@@ -197,7 +276,47 @@ def test_mesh_statem(seed):
                 ops.append((r, ("add", e), actor(r)))
                 model.add(r, e)
             rt.update_batch(s, ops)
-        elif roll < 0.8:  # gossip round, possibly with dead edges
+        elif roll < 0.60:  # map field ops (dynamic admission included)
+            r = rng.randrange(model.n)
+            vid, tag = (m_def, "md") if rng.random() < 0.5 else (m_rst, "mr")
+            key = rng.choice(MKEYS)
+            # removes get near-parity odds AND pick their row among rows
+            # where the field IS present: the round-5 reset-remove
+            # semantics (token tombstones, counter floors) live on this
+            # branch, and a random row rarely satisfies the presence
+            # precondition on a young map
+            present_rows = (
+                [q for q in range(model.n) if model.map_present(q, tag, key)]
+                if rng.random() < 0.45
+                else []
+            )
+            if present_rows:
+                r = rng.choice(present_rows)
+                rt.update_at(r, vid, ("update", [("remove", key)]), actor(r))
+                model.map_remove(r, tag, key, reset=(tag == "mr"))
+            else:
+                inner = (
+                    ("increment", rng.randint(1, 3))
+                    if key[1] == "riak_dt_gcounter"
+                    else ("add", rng.choice(ELEMS))
+                )
+                try:
+                    rt.update_at(
+                        r, vid, ("update", [("update", key, inner)]), actor(r)
+                    )
+                except CapacityError:
+                    # reset-mode OR-Set fields pin tombstoned token slots
+                    # (documented cost): the default espec's pool can
+                    # exhaust under churn — loud, and the op is skipped
+                    # in both worlds
+                    pass
+                else:
+                    model.map_update(
+                        r, tag, key,
+                        ("minc", inner[1]) if inner[0] == "increment"
+                        else ("madd", inner[1]),
+                    )
+        elif roll < 0.82:  # gossip round, possibly with dead edges
             mask = None
             if rng.random() < 0.4:
                 mask = np.asarray(
@@ -234,7 +353,12 @@ def test_mesh_statem(seed):
     if all(seen == model.seen[0] for seen in model.seen):
         assert rt.divergence(s) == 0 and rt.divergence(c) == 0
         assert rt.divergence(w) == 0
+        assert rt.divergence(m_def) == 0 and rt.divergence(m_rst) == 0
     union = set().union(*model.seen)
     assert rt.coverage_value(s) == MeshModel.orset_of(union)
     assert rt.coverage_value(w) == MeshModel.orset_of(union, "w")
     assert rt.coverage_value(c) == MeshModel.counter_of(union)
+    umodel = MeshModel(1, [[0]])
+    umodel.seen = [union]
+    assert rt.coverage_value(m_def) == umodel.map_value(0, "md")
+    assert rt.coverage_value(m_rst) == umodel.map_value(0, "mr")
