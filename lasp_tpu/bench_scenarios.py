@@ -769,15 +769,33 @@ def partitioned_gossip(
     sh = NamedSharding(mesh, P("replicas"))
     sharded = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
 
-    def allgather_bytes(hlo: str) -> int:
-        total = 0
+    def collective_bytes(hlo: str) -> int:
+        """Bytes through cross-shard collectives: plain-form all-gathers
+        plus tuple-form all-to-alls (each tuple element is one
+        per-destination piece)."""
         sizes = {"pred": 1, "u8": 1, "u32": 4, "s32": 4, "u64": 8, "f32": 4}
-        for dt, dims in re.findall(r"= (\w+)\[([\d,]*)\][^=]*all-gather\(", hlo):
+
+        def shape_bytes(dt, dims):
             n = 1
             for d in dims.split(","):
                 if d:
                     n *= int(d)
-            total += n * sizes.get(dt, 4)
+            return n * sizes.get(dt, 4)
+
+        total = 0
+        for dt, dims in re.findall(
+            r"= (\w+)\[([\d,]*)\][^=]*all-gather\(", hlo
+        ):
+            total += shape_bytes(dt, dims)
+        # tuple-form all-to-all: each element is a per-destination piece
+        for tup in re.findall(r"= \(([^)]*)\)[^=]*all-to-all\(", hlo):
+            for dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", tup):
+                total += shape_bytes(dt, dims)
+        # array-form all-to-all (single-operand lowering on some backends)
+        for dt, dims in re.findall(
+            r"= (\w+)\[([\d,]*)\][^=]*all-to-all\(", hlo
+        ):
+            total += shape_bytes(dt, dims)
         return total
 
     # dense auto-sharded path on the SAME renumbered topology
@@ -794,43 +812,60 @@ def partitioned_gossip(
     jax.block_until_ready(out_d)
     dense_s = _time.perf_counter() - t0
 
-    # boundary-exchange path — warmed exactly like the dense path (one
+    # both exchange modes — each warmed exactly like the dense path (one
     # untimed call populates the dispatch cache; AOT .compile() does not)
-    from lasp_tpu.mesh.shard_gossip import partitioned_gossip_round_fn
+    from lasp_tpu.mesh.shard_gossip import (
+        partition_tables,
+        partitioned_gossip_round_fn,
+    )
 
-    tsh = NamedSharding(mesh, P("replicas", None))
-    send_idx = jax.device_put(jnp.asarray(plan["send_idx"]), tsh)
-    idx = jax.device_put(jnp.asarray(plan["idx"]), tsh)
-    part_round = jax.jit(partitioned_gossip_round_fn(GSet, spec, mesh, plan))
-    part_hlo = part_round.lower(sharded, send_idx, idx).compile().as_text()
-    out_p = part_round(sharded, send_idx, idx)  # untimed warmup round
-    jax.block_until_ready(out_p)
-    t0 = _time.perf_counter()
-    for _ in range(rounds):
-        out_p = part_round(out_p, send_idx, idx)
-    jax.block_until_ready(out_p)
-    part_s = _time.perf_counter() - t0
+    mode_out = {}
+    for mode in ("gather", "alltoall"):
+        send_idx, idx = partition_tables(plan, mesh, mode=mode)
+        part_round = jax.jit(
+            partitioned_gossip_round_fn(GSet, spec, mesh, plan, mode=mode)
+        )
+        part_hlo = part_round.lower(sharded, send_idx, idx).compile().as_text()
+        out_p = part_round(sharded, send_idx, idx)  # untimed warmup round
+        jax.block_until_ready(out_p)
+        t0 = _time.perf_counter()
+        for _ in range(rounds):
+            out_p = part_round(out_p, send_idx, idx)
+        jax.block_until_ready(out_p)
+        part_s = _time.perf_counter() - t0
+        ref = jax.tree_util.tree_map(
+            lambda a, b: bool(jnp.array_equal(a, b)), out_p, out_d
+        )
+        assert all(jax.tree_util.tree_leaves(ref)), f"{mode} diverged"
+        mode_out[mode] = {
+            "bytes": collective_bytes(part_hlo),
+            "seconds_per_round": round(part_s / rounds, 4),
+        }
 
-    ref = jax.tree_util.tree_map(lambda a, b: bool(jnp.array_equal(a, b)),
-                                 out_p, out_d)
-    assert all(jax.tree_util.tree_leaves(ref)), "paths diverged"
     st = plan["stats"]
-    d_bytes = allgather_bytes(dense_hlo)
-    p_bytes = allgather_bytes(part_hlo)
+    d_bytes = collective_bytes(dense_hlo)
+    g_bytes = mode_out["gather"]["bytes"]
+    a_bytes = mode_out["alltoall"]["bytes"]
     return {
         "scenario": f"partitioned_gossip_{n_replicas}",
         "n_replicas": n_replicas,
         "n_shards": n_dev,
         "cut": {k_: st[k_] for k_ in (
-            "cross_edges", "send_rows", "max_send",
-            "exchange_rows_per_round", "allgather_rows_per_round",
+            "cross_edges", "send_rows", "max_send", "m2",
+            "exchange_rows_per_round", "alltoall_rows_per_round",
+            "allgather_rows_per_round",
         )},
         "dense_allgather_bytes_per_round": d_bytes,
-        "exchange_allgather_bytes_per_round": p_bytes,
-        "wire_reduction": round(d_bytes / p_bytes, 2) if p_bytes else None,
+        "exchange_allgather_bytes_per_round": g_bytes,
+        "alltoall_bytes_per_round": a_bytes,
+        "wire_reduction": round(d_bytes / g_bytes, 2) if g_bytes else None,
+        "wire_reduction_alltoall": (
+            round(d_bytes / a_bytes, 2) if a_bytes else None
+        ),
         "dense_seconds_per_round": round(dense_s / rounds, 4),
-        "exchange_seconds_per_round": round(part_s / rounds, 4),
-        "check": "fixed rounds of both paths produce identical states",
+        "exchange_seconds_per_round": mode_out["gather"]["seconds_per_round"],
+        "alltoall_seconds_per_round": mode_out["alltoall"]["seconds_per_round"],
+        "check": "fixed rounds of all three paths produce identical states",
     }
 
 

@@ -1412,7 +1412,7 @@ class ReplicatedRuntime:
             part_rounds = {
                 v: partitioned_gossip_round_fn(
                     meta[v][0], meta[v][1], part["mesh"], part["plan"],
-                    axis=part["axis"],
+                    axis=part["axis"], mode=part.get("mode", "gather"),
                 )
                 for v in self.var_ids
             }
@@ -2395,6 +2395,7 @@ class ReplicatedRuntime:
         mesh: jax.sharding.Mesh,
         axis: "str | tuple[str, ...] | None" = None,
         partition: bool = False,
+        partition_mode: str = "alltoall",
     ) -> None:
         """Distribute every variable's replica axis over a mesh axis (a
         name or a tuple of names); states move device-side and the jitted
@@ -2415,7 +2416,11 @@ class ReplicatedRuntime:
         dynamic gather — cross-shard wire scales with the topology's cut,
         not the population (renumber with ``topology.locality_order``
         BEFORE building the runtime for a small cut; docs/PERF.md has the
-        measured 3.17x at 1M replicas). Not applicable to shift-structured
+        measured numbers at 1M replicas). ``partition_mode``:
+        ``"alltoall"`` (default — per-destination slices, each shard
+        receives only the rows it references) or ``"gather"`` (one union
+        buffer to every shard; fewer constraints on the fabric's
+        all-to-all performance). Not applicable to shift-structured
         topologies (already collective-permute) and incompatible with
         per-step ``edge_mask`` failure injection."""
         joint_divides = (
@@ -2453,6 +2458,11 @@ class ReplicatedRuntime:
         # mesh), and the plan must come from the host-side table (a
         # device table re-sharded in a multi-process mesh spans
         # non-addressable devices and cannot be pulled back)
+        if partition and partition_mode not in ("gather", "alltoall"):
+            raise ValueError(
+                f"unknown partition_mode {partition_mode!r} "
+                "(expected 'gather' or 'alltoall')"
+            )
         plan = self._plan_partition(mesh, axis) if partition else None
         self.states = {
             v: jax.tree_util.tree_map(
@@ -2462,17 +2472,18 @@ class ReplicatedRuntime:
         }
         self.neighbors = jax.device_put(self.neighbors, nbr_sharding)
         if plan is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from .shard_gossip import partition_tables
 
-            tsh = NamedSharding(mesh, P(axis, None))
+            send_idx, idx = partition_tables(
+                plan, mesh, axis=axis, mode=partition_mode
+            )
             self._partition = {
                 "mesh": mesh,
                 "axis": axis,
+                "mode": partition_mode,
                 "plan": plan,
-                "send_idx": jax.device_put(
-                    jnp.asarray(plan["send_idx"]), tsh
-                ),
-                "idx": jax.device_put(jnp.asarray(plan["idx"]), tsh),
+                "send_idx": send_idx,
+                "idx": idx,
             }
         else:
             # re-sharding without partition returns to the gather path

@@ -152,7 +152,16 @@ def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
 
     Returns ``{"send_idx": int32[S, M] (block-local row ids, pad 0),
     "idx": int32[R, K] (combined index: [0, B) local block, [B, B+S*M)
-    buffer position), "n_shards", "block", "m", "stats"}``."""
+    buffer position), "n_shards", "block", "m", "stats"}`` — plus the
+    PER-DESTINATION tables for the all-to-all variant
+    (:func:`partitioned_gossip_round_fn` with ``mode="alltoall"``):
+    ``send2_idx: int32[S, S, M2]`` (owner s's rows for destination t,
+    pad 0) and ``idx2: int32[R, K]`` against the ``[0, B) local |
+    [B, B+S*M2) received`` layout. The union buffer ships every
+    boundary row to every shard; the per-destination split ships each
+    shard only what IT needs — at the 1M scale-free BASELINE that is a
+    further ~4x wire cut (hub rows still go everywhere, but the Zipf
+    tail of rows needed by exactly one shard stops being broadcast)."""
     import numpy as np
 
     nbrs = np.asarray(neighbors).astype(np.int64)
@@ -173,6 +182,42 @@ def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
         send_idx[s, : len(rows)] = rows - s * B
         pos_of[rows] = np.arange(len(rows)) + s * m
     idx = np.where(cross, B + pos_of[nbrs], nbrs - src_shard * B)
+
+    # per-destination (all-to-all) tables: unique (row, needing-shard)
+    # pairs, grouped by (owner, destination) with stable in-group order,
+    # so destination t's received buffer lays out as [owner s][slot p]
+    need_rows = nbrs[cross]
+    need_dst = np.broadcast_to(src_shard, nbrs.shape)[cross]
+    pair_keys = np.unique((need_rows * n_shards + need_dst))
+    p_rows = pair_keys // n_shards
+    p_dst = pair_keys % n_shards
+    p_owner = p_rows // B
+    group = p_owner * n_shards + p_dst  # sort key: (owner, destination)
+    order = np.argsort(group * (R + 1) + p_rows, kind="stable")
+    p_rows, p_dst, p_owner, group = (
+        p_rows[order], p_dst[order], p_owner[order], group[order]
+    )
+    counts2 = np.bincount(group, minlength=n_shards * n_shards)
+    offd = counts2.copy()
+    offd[np.arange(n_shards) * (n_shards + 1)] = 0  # diagonal is free
+    m2 = max(int(offd.max()), 1)
+    starts = np.zeros(n_shards * n_shards + 1, dtype=np.int64)
+    np.cumsum(counts2, out=starts[1:])
+    send2_idx = np.zeros((n_shards, n_shards, m2), dtype=np.int64)
+    slot = np.arange(len(p_rows)) - starts[group]
+    keep = slot < m2  # diagonal groups may exceed m2; they are never read
+    send2_idx[p_owner[keep], p_dst[keep], slot[keep]] = (
+        p_rows[keep] - p_owner[keep] * B
+    )
+    # receiving shard t reads row g (owner s) at B + s*m2 + slot
+    sorted_keys = group * (R + 1) + p_rows
+    edge_keys = (
+        (owner * n_shards + src_shard) * (R + 1) + nbrs
+    )  # per cross edge: its (owner, MY shard, row) key
+    pos = np.searchsorted(sorted_keys, edge_keys)
+    flat2 = B + owner * m2 + (pos - starts[owner * n_shards + src_shard])
+    idx2 = np.where(cross, flat2, nbrs - src_shard * B)
+
     # stats derive from the arrays just built (one walk of the table,
     # and one definition of the cut — shard_cut_stats exists for callers
     # that have no plan)
@@ -188,25 +233,39 @@ def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
             int(per_owner.max()) if len(send_rows) else 0
         ),
     }
+    stats["m2"] = m2
+    stats["alltoall_rows_per_round"] = n_shards * m2
     return {
         "send_idx": send_idx.astype(np.int32),
         "idx": idx.astype(np.int32),
+        "send2_idx": send2_idx.astype(np.int32),
+        "idx2": idx2.astype(np.int32),
         "n_shards": n_shards,
         "block": B,
         "m": m,
+        "m2": m2,
         "stats": stats,
     }
 
 
 def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
-                                axis: str = "replicas"):
-    """Build ``(states, send_idx, idx) -> states`` running ONE gossip
+                                axis: str = "replicas",
+                                mode: str = "gather"):
+    """Build ``(states, send_tbl, idx_tbl) -> states`` running ONE gossip
     round of an irregular topology via the boundary exchange of
     ``plan`` — semantically identical to ``gossip_round(codec, spec,
-    states, neighbors)`` for block-sharded states, but the only
-    collective is an ``all_gather`` of ``plan["m"]`` rows per shard.
-    ``send_idx``/``idx`` are ``plan``'s tables as device arrays sharded
-    ``P(axis, None)`` (callers keep them resident across rounds)."""
+    states, neighbors)`` for block-sharded states. Two wire modes:
+
+    - ``"gather"``: one ``all_gather`` of the union buffer (``m`` rows
+      per shard; every shard receives every boundary row). Tables:
+      ``plan["send_idx"]`` / ``plan["idx"]``.
+    - ``"alltoall"``: one ``all_to_all`` of per-destination slices
+      (``m2`` rows per (owner, destination) pair; each shard receives
+      only what IT references — the Zipf tail stops being broadcast).
+      Tables: ``plan["send2_idx"]`` / ``plan["idx2"]``.
+
+    Tables ride as device arrays sharded ``P(axis, None[, None])``
+    (callers keep them resident across rounds)."""
     if plan["n_shards"] != mesh.shape[axis]:
         # a mismatched plan would shard send_idx into the WRONG per-device
         # rows and compute local indices against the wrong block size —
@@ -216,23 +275,39 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
             f"plan was built for {plan['n_shards']} shards but mesh axis "
             f"{axis!r} has {mesh.shape[axis]} devices — rebuild the plan"
         )
+    if mode not in ("gather", "alltoall"):
+        raise ValueError(f"unknown partitioned gossip mode {mode!r}")
     from .gossip import _leafwise_op
 
     vmerge = jax.vmap(lambda a, b: codec.merge(spec, a, b))
     leaf_op = _leafwise_op(codec)
     k_cols = plan["idx"].shape[1]
+    alltoall = mode == "alltoall"
 
-    def local(block, send_idx, idx):
-        send = send_idx[0]  # [1, M] shard slice -> [M]
-        contrib = jax.tree_util.tree_map(lambda x: x[send], block)
-        gathered = jax.tree_util.tree_map(
-            lambda x: jax.lax.all_gather(x, axis), contrib
-        )  # [S, M, ...] per leaf
+    def local(block, send_tbl, idx):
+        if alltoall:
+            send = send_tbl[0]  # [1, S, M2] shard slice -> [S, M2]
+            flat = send.reshape(-1)
+            contrib = jax.tree_util.tree_map(
+                lambda x: x[flat].reshape(send.shape + x.shape[1:]), block
+            )  # [S, M2, ...]: slice t = my rows destination t needs
+            recv = jax.tree_util.tree_map(
+                lambda c: jax.lax.all_to_all(
+                    c, axis, split_axis=0, concat_axis=0, tiled=False
+                ),
+                contrib,
+            )  # [S, M2, ...]: slice s = what owner s sent to ME
+        else:
+            send = send_tbl[0]  # [1, M] shard slice -> [M]
+            contrib = jax.tree_util.tree_map(lambda x: x[send], block)
+            recv = jax.tree_util.tree_map(
+                lambda x: jax.lax.all_gather(x, axis), contrib
+            )  # [S, M, ...] per leaf
         full = jax.tree_util.tree_map(
             lambda b, g: jnp.concatenate(
                 [b, g.reshape((-1,) + g.shape[2:])], axis=0
             ),
-            block, gathered,
+            block, recv,
         )
         if leaf_op is not None:
             # leafwise codecs: fuse all neighbor lookups + joins of one
@@ -251,21 +326,45 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
             acc = vmerge(acc, nbr)
         return acc
 
+    tbl_spec = P(axis, None, None) if alltoall else P(axis, None)
     return _shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(axis, None), P(axis, None)),
+        in_specs=(P(axis), tbl_spec, P(axis, None)),
         out_specs=P(axis), check_vma=False,
     )
 
 
+def partition_tables(plan: dict, mesh: Mesh, axis: str = "replicas",
+                     mode: str = "gather") -> tuple:
+    """``plan``'s tables for ``mode`` as device arrays with the shardings
+    :func:`partitioned_gossip_round_fn` expects."""
+    if mode == "alltoall":
+        send = jax.device_put(
+            jnp.asarray(plan["send2_idx"]),
+            jax.sharding.NamedSharding(mesh, P(axis, None, None)),
+        )
+        idx = plan["idx2"]
+    else:
+        send = jax.device_put(
+            jnp.asarray(plan["send_idx"]),
+            jax.sharding.NamedSharding(mesh, P(axis, None)),
+        )
+        idx = plan["idx"]
+    idx = jax.device_put(
+        jnp.asarray(idx), jax.sharding.NamedSharding(mesh, P(axis, None))
+    )
+    return send, idx
+
+
 def partitioned_gossip_rounds(codec, spec, states, mesh: Mesh, plan: dict,
-                              n_rounds: int, axis: str = "replicas"):
+                              n_rounds: int, axis: str = "replicas",
+                              mode: str = "gather"):
     """``n_rounds`` boundary-exchange rounds fused in one jit. Returns
     ``(new_states, changed)`` like :func:`ring_gossip_rounds`."""
-    round_fn = partitioned_gossip_round_fn(codec, spec, mesh, plan, axis=axis)
-    table_sharding = jax.sharding.NamedSharding(mesh, P(axis, None))
-    send_idx = jax.device_put(jnp.asarray(plan["send_idx"]), table_sharding)
-    idx = jax.device_put(jnp.asarray(plan["idx"]), table_sharding)
+    round_fn = partitioned_gossip_round_fn(
+        codec, spec, mesh, plan, axis=axis, mode=mode
+    )
+    send_idx, idx = partition_tables(plan, mesh, axis=axis, mode=mode)
 
     @jax.jit
     def run(s0):

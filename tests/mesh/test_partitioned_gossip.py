@@ -221,7 +221,7 @@ def test_engine_step_partitioned_hlo_is_boundary_sized():
     # point — stops all-gathering the population on irregular topologies
     rt, nn, _s = _partitioned_runtime()
     mesh = _mesh()
-    rt.shard(mesh, axis="replicas", partition=True)
+    rt.shard(mesh, axis="replicas", partition=True, partition_mode="gather")
     tables = rt._ensure_step()
     hlo = (
         jax.jit(rt._step_pure)
@@ -289,3 +289,92 @@ def test_failed_partition_reshard_leaves_runtime_intact():
     assert rt._partition["plan"] is plan_before  # untouched
     rt.run_to_convergence(max_rounds=32)  # still serves
     assert rt.divergence(s) == 0
+
+
+# -- per-destination (all-to-all) exchange ------------------------------------
+
+@pytest.mark.parametrize("seed", [3, 9])
+def test_alltoall_rounds_equal_dense(seed):
+    R, S = 256, 8
+    mesh = _mesh()
+    _, nn = locality_order(scale_free(R, 3, seed=seed))
+    plan = partitioned_gossip_plan(nn, S)
+    assert plan["m2"] <= plan["m"]  # per-destination never exceeds union
+    spec = PackedORSetSpec(n_elems=8, n_actors=4, tokens_per_actor=2)
+    rng = np.random.RandomState(seed)
+    states = replicate(PackedORSet.new(spec), R)._replace(
+        exists=jnp.asarray(
+            rng.randint(0, 256, size=(R, spec.n_elems, spec.n_words)),
+            dtype=jnp.uint32,
+        )
+    )
+    sharded = _put(states, mesh)
+    got, _ = partitioned_gossip_rounds(
+        PackedORSet, spec, sharded, mesh, plan, 3, mode="alltoall"
+    )
+    ref = states
+    for _ in range(3):
+        ref = gossip_round(PackedORSet, spec, ref, jnp.asarray(nn))
+    assert jnp.array_equal(got.exists, ref.exists)
+    assert jnp.array_equal(got.removed, ref.removed)
+
+
+def test_alltoall_hlo_ships_per_destination_slices():
+    from lasp_tpu.mesh.shard_gossip import partition_tables
+
+    R, S = 256, 8
+    mesh = _mesh()
+    _, nn = locality_order(scale_free(R, 3, seed=3))
+    plan = partitioned_gossip_plan(nn, S)
+    spec = GSetSpec(n_elems=16)
+    states = _put(replicate(GSet.new(spec), R), mesh)
+    send_idx, idx = partition_tables(plan, mesh, mode="alltoall")
+    fn = jax.jit(partitioned_gossip_round_fn(GSet, spec, mesh, plan,
+                                             mode="alltoall"))
+    hlo = fn.lower(states, send_idx, idx).compile().as_text()
+    tups = re.findall(r"= \(([^)]*)\)[^=]*all-to-all\(", hlo)
+    assert tups, "alltoall mode must lower to an all-to-all"
+    for tup in tups:
+        for _dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", tup):
+            lead = [int(d) for d in dims.split(",") if d]
+            # every piece is ONE destination's slice: m2 rows, never the
+            # union buffer and never the population
+            rows = lead[1] if len(lead) > 1 else lead[0]
+            assert rows <= plan["m2"], dims
+    assert "all-gather" not in hlo
+
+
+def test_engine_step_alltoall_mode():
+    rt, nn, s = _partitioned_runtime()
+    ref, _nn, _s = _partitioned_runtime()
+    rt.shard(_mesh(), axis="replicas", partition=True,
+             partition_mode="alltoall")
+    assert rt._partition["mode"] == "alltoall"
+    # the DEFAULT mode's wire bound holds on the FULL compiled step,
+    # not just the side round fn (docs/PERF.md claims exactly this)
+    tables = rt._ensure_step()
+    hlo = (
+        jax.jit(rt._step_pure)
+        .lower(rt.states, rt.neighbors, None, tables)
+        .compile()
+        .as_text()
+    )
+    assert "all-gather" not in hlo
+    m2 = rt._partition["plan"]["m2"]
+    for tup in re.findall(r"= \(([^)]*)\)[^=]*all-to-all\(", hlo):
+        for _dt, dims in re.findall(r"(\w+)\[([\d,]*)\]", tup):
+            lead = [int(d) for d in dims.split(",") if d]
+            rows = lead[1] if len(lead) > 1 else lead[0]
+            assert rows <= m2, dims
+    rt.run_to_convergence(max_rounds=64)
+    ref.run_to_convergence(max_rounds=64)
+    assert rt.divergence(s) == 0
+    assert rt.coverage_value(s) == ref.coverage_value(s)
+    assert rt.coverage_value("out") == ref.coverage_value("out")
+
+
+def test_unknown_partition_mode_is_loud():
+    rt, _nn, _s = _partitioned_runtime(n=64)
+    with pytest.raises(ValueError, match="partition_mode"):
+        rt.shard(_mesh(), axis="replicas", partition=True,
+                 partition_mode="broadcast")
