@@ -7,10 +7,12 @@ from .gossip import converged, divergence, gossip_round, join_all, quorum_read
 from .runtime import ActorCollisionError, ReplicatedRuntime
 from .topology import (
     edge_failure_mask,
+    locality_order,
     partition_mask,
     random_regular,
     ring,
     scale_free,
+    shard_cut_stats,
 )
 
 __all__ = [
@@ -21,9 +23,11 @@ __all__ = [
     "edge_failure_mask",
     "gossip_round",
     "join_all",
+    "locality_order",
     "partition_mask",
     "quorum_read",
     "random_regular",
     "ring",
     "scale_free",
+    "shard_cut_stats",
 ]

@@ -366,15 +366,18 @@ def partitioned_gossip_rounds(codec, spec, states, mesh: Mesh, plan: dict,
     )
     send_idx, idx = partition_tables(plan, mesh, axis=axis, mode=mode)
 
+    # tables ride as ARGUMENTS, not closures: a multi-process mesh's
+    # globally-sharded arrays cannot be closed over (non-addressable),
+    # and operands also avoid baking them into the executable
     @jax.jit
-    def run(s0):
+    def run(s0, send_tbl, idx_tbl):
         out = jax.lax.fori_loop(
-            0, n_rounds, lambda _, s: round_fn(s, send_idx, idx), s0
+            0, n_rounds, lambda _, s: round_fn(s, send_tbl, idx_tbl), s0
         )
         eq = jax.vmap(lambda a, b: codec.equal(spec, a, b))(s0, out)
         return out, ~jnp.all(eq)
 
-    return run(states)
+    return run(states, send_idx, idx)
 
 
 def ring_gossip_shardmap_dryrun(mesh: Mesh, n_replicas: int) -> None:

@@ -85,5 +85,35 @@ pop = jax.tree_util.tree_map(
 out, _changed = ring_gossip_rounds(PackedORSet, spec, pop, flat, 1, k=2)
 jax.block_until_ready(jax.tree_util.tree_leaves(out))
 
+# the round-5 boundary exchange (per-destination all_to_all) crosses the
+# process boundary too: an irregular locality-ordered topology converges
+# to a uniform population with the cut-sized collective as the only wire
+from lasp_tpu.lattice import GSet, GSetSpec  # noqa: E402
+from lasp_tpu.mesh.shard_gossip import (  # noqa: E402
+    partitioned_gossip_plan,
+    partitioned_gossip_rounds,
+)
+from lasp_tpu.mesh.topology import locality_order, scale_free  # noqa: E402
+
+_, nn = locality_order(scale_free(R, 3, seed=4))
+plan = partitioned_gossip_plan(nn, 8)
+gspec = GSetSpec(n_elems=8)
+gpop = replicate(GSet.new(gspec), R)
+# the jitted seed write also establishes the block sharding (out_shardings)
+gpop = gpop._replace(mask=jax.jit(
+    lambda m: m.at[0, 0].set(True).at[41, 3].set(True),
+    out_shardings=jax.sharding.NamedSharding(
+        flat, jax.sharding.PartitionSpec("replicas")
+    ),
+)(gpop.mask))
+gout, _ = partitioned_gossip_rounds(
+    GSet, gspec, gpop, flat, plan, 24, mode="alltoall"
+)
+uniform, bits = jax.jit(
+    lambda m: (jnp.all(m == m[0:1]), jnp.sum(m[0]))
+)(gout.mask)
+assert bool(uniform), "partitioned exchange failed to converge cross-process"
+assert int(bits) == 2, int(bits)
+
 print(f"WORKER-OK process={jax.process_index()}", flush=True)
 sys.exit(0)
