@@ -600,3 +600,58 @@ def test_dynamic_batch_admission_is_atomic():
     rt.update_at(0, m, ("update", ("A", "lasp_gset"), ("add", "z")), "w0")
     rt.run_to_convergence(max_rounds=16)
     assert rt.coverage_value(m) == {("A", "lasp_gset"): frozenset({"z"})}
+
+
+def test_dynamic_statem_reset_mode():
+    # the reset-mode twin of test_dynamic_statem: sequential single-store
+    # semantics — a field remove erases its contents (riak_dt observable),
+    # so the oracle resets the entry; dynamic admission interleaves
+    import random
+
+    import pytest
+
+    from lasp_tpu.utils.interning import CapacityError
+
+    for seed in range(4):
+        rng = random.Random(seed + 100)
+        store = Store(n_actors=8)
+        m = store.declare(type="riak_dt_map", reset_on_readd=True)
+        pool = [(f"K{i}", "lasp_orset") for i in range(3)] + [
+            (f"C{i}", "riak_dt_gcounter") for i in range(2)
+        ]
+        model: dict = {}  # key -> (value, present)
+        for stepi in range(100):
+            key = rng.choice(pool)
+            actor = f"w{rng.randrange(8)}"
+            roll = rng.random()
+            if roll < 0.6:
+                if key[1] == "lasp_orset":
+                    e = f"e{rng.randrange(5)}"
+                    try:
+                        store.update(
+                            m, ("update", [("update", key, ("add", e))]), actor
+                        )
+                    except CapacityError:
+                        continue  # tombstoned slots pinned (documented)
+                    cur = model.get(key, (frozenset(), False))[0]
+                    model[key] = (cur | {e}, True)
+                else:
+                    by = rng.randint(1, 3)
+                    store.update(
+                        m, ("update", [("update", key, ("increment", by))]),
+                        actor,
+                    )
+                    cur = model.get(key, (0, False))[0]
+                    model[key] = (cur + by, True)
+            else:
+                present = model.get(key, (None, False))[1]
+                if present:
+                    store.update(m, ("update", [("remove", key)]), actor)
+                    # SEQUENTIAL reset-remove: contents erased outright
+                    bottom = frozenset() if key[1] == "lasp_orset" else 0
+                    model[key] = (bottom, False)
+                else:
+                    with pytest.raises(PreconditionError):
+                        store.update(m, ("update", [("remove", key)]), actor)
+            expect = {k: v for k, (v, p) in model.items() if p}
+            assert store.value(m) == expect, (seed, stepi)
