@@ -38,9 +38,11 @@ Remove/re-add semantics — two modes:
     tombstones pin their token slots, so remove/re-add cycling a field
     exhausts the fixed per-actor pool after ``tokens_per_actor`` cycles
     with a loud ``CapacityError`` — the same bounded-shape trade as
-    top-level OR-Set removes (size ``tokens_per_actor`` for the
-    workload's churn; compaction reclaims top-level sets, embedded
-    fields currently only grow).
+    top-level OR-Set removes. Reclamation:
+    ``Store.compact_map_field`` (single store) /
+    ``ReplicatedRuntime.compact_map_field`` (whole population, gated on
+    divergence 0) free fully-tombstoned element rows at quiescence, so
+    sized pools sustain unbounded churn.
   * OR-SWOT fields: remove drops the observed birth dots (clock kept) —
     the standard orswot remove-all; concurrent adds' fresh dots escape
     the remover's clock and survive. Exactly riak_dt.

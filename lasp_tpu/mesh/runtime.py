@@ -2221,6 +2221,45 @@ class ReplicatedRuntime:
         self.graph.refresh()
         return reclaimed
 
+    def compact_map_field(self, var_id: str, key) -> int:
+        """Population-wide :meth:`Store.compact_map_field`: reclaim one
+        OR-Set field's fully-tombstoned element slots across every
+        replica row. Same gates as :meth:`compact_orset` (divergence 0 so
+        a dropped tombstone cannot be resurrected by a divergent peer;
+        no trigger touching the map — closures bake element orders).
+        Maps never ride the packed wire format, so the population planes
+        reindex directly. Returns slots reclaimed."""
+        if self.divergence(var_id) != 0:
+            raise RuntimeError(
+                f"compact_map_field({var_id!r}): population not converged; "
+                "run_to_convergence first"
+            )
+        for _fn, touch, _b in self._triggers:
+            if touch is None or var_id in touch:
+                raise RuntimeError(
+                    f"compact_map_field({var_id!r}): a registered trigger "
+                    "touches this variable (closures bake element orders)"
+                )
+        var = self.store.variable(var_id)
+        states = self._population(var_id)  # dense: maps are never packed
+        row0 = jax.tree_util.tree_map(lambda x: x[0], states)
+        # the converged row is the authority; validations + plan are the
+        # store's one shared path
+        f, order, fresh = self.store.compact_map_plan(var_id, key, state=row0)
+        shim = var.map_aux[f]
+        reclaimed = len(shim.elems) - len(fresh)
+        if not reclaimed:
+            return 0
+        var.state = var.codec.set_field(
+            var.spec, var.state,
+            f, self.store.reindex_orset_state(var.state.fields[f], order),
+        )
+        fields = list(states.fields)
+        fields[f] = self.store.reindex_orset_state(fields[f], order)
+        self.states[var_id] = states._replace(fields=tuple(fields))
+        shim.elems = fresh
+        return reclaimed
+
     @contextlib.contextmanager
     def compaction_window(self, max_rounds: int = 10_000, edge_mask=None,
                           block: int = 32):

@@ -798,8 +798,6 @@ class Store:
         runtime layer checks that). This is the reclamation the reference's
         ``waste_pct`` stat cues but never performs
         (``src/lasp_orset.erl:178-191``)."""
-        import numpy as np
-
         var = self._vars[id]
         if var.type_name not in ("lasp_orset", "lasp_orset_gbtree"):
             raise TypeError(f"compact: {var.type_name} has no tombstones")
@@ -807,17 +805,76 @@ class Store:
             raise RuntimeError(
                 f"cannot compact {id}: watches hold old-order thresholds"
             )
-        if state is None:
-            state = var.state
+        return self._orset_live_plan(
+            var.elems, var.state if state is None else state
+        )
+
+    @staticmethod
+    def _orset_live_plan(elems, state):
+        """(order, fresh_interner) for one OR-Set state: surviving element
+        indices in their new positions — the ONE liveness rule shared by
+        top-level variables (:meth:`compact_plan`) and embedded map
+        fields (:meth:`compact_map_field`)."""
+        import numpy as np
+
         exists = np.asarray(state.exists)
         removed = np.asarray(state.removed)
         live = (exists & ~removed).any(axis=-1)
         order = np.flatnonzero(live)
-        fresh = Interner(var.spec.n_elems, kind=var.elems.kind)
-        terms = var.elems.terms()
+        fresh = Interner(elems.capacity, kind=elems.kind)
+        terms = elems.terms()
         for i in order:
             fresh.intern(terms[int(i)])
         return order, fresh
+
+    def compact_map_plan(self, map_id: str, key, state=None) -> tuple:
+        """Validations + liveness plan for compacting one OR-Set FIELD of
+        a riak_dt_map: ``(field_idx, order, fresh_interner)``. The ONE
+        validation/plan path for the single-store and population tiers —
+        a soundness gate added here covers both. ``state`` overrides the
+        authoritative map state (the runtime passes a converged row)."""
+        var = self._vars[map_id]
+        if var.type_name != "riak_dt_map":
+            raise TypeError(f"compact_map_field: {var.type_name} is not a map")
+        if var.waiting or var.lazy:
+            raise RuntimeError(
+                f"cannot compact {map_id}: watches hold old-order thresholds"
+            )
+        f = var.spec.field_index(key)
+        shim = var.map_aux[f]
+        if shim.codec.name not in ("lasp_orset", "lasp_orset_gbtree"):
+            raise TypeError(
+                f"compact_map_field: field {key!r} is {shim.codec.name}, "
+                "which has no token tombstones"
+            )
+        authority = var.state if state is None else state
+        order, fresh = self._orset_live_plan(shim.elems, authority.fields[f])
+        return f, order, fresh
+
+    def compact_map_field(self, map_id: str, key) -> int:
+        """Reclaim element slots (and with them the tombstoned token
+        slots) of one OR-Set FIELD of a riak_dt_map — the reclamation
+        that makes reset-mode remove/re-add churn sustainable: each
+        reset tombstones the observed tokens, pinning their pool slots
+        until the element row is fully dead and compacted away
+        (lattice/map.py docstring, COST note). Soundness is the
+        compact_orset argument: dropping a fully-tombstoned element
+        forgets its tombstones, which is safe exactly when no OTHER
+        state can reintroduce those tokens — single store always,
+        replicated populations only at divergence 0
+        (:meth:`ReplicatedRuntime.compact_map_field` checks). Returns
+        slots reclaimed."""
+        var = self._vars[map_id]
+        f, order, fresh = self.compact_map_plan(map_id, key)
+        shim = var.map_aux[f]
+        reclaimed = len(shim.elems) - len(fresh)
+        if reclaimed:
+            var.state = var.codec.set_field(
+                var.spec, var.state,
+                f, self.reindex_orset_state(var.state.fields[f], order),
+            )
+            shim.elems = fresh
+        return reclaimed
 
     @staticmethod
     def reindex_orset_state(state, order):

@@ -655,3 +655,60 @@ def test_dynamic_statem_reset_mode():
                         store.update(m, ("update", [("remove", key)]), actor)
             expect = {k: v for k, (v, p) in model.items() if p}
             assert store.value(m) == expect, (seed, stepi)
+
+
+def test_compact_map_field_sustains_reset_churn():
+    # the reclamation that makes reset-mode remove/re-add churn
+    # sustainable: each cycle tombstones the observed tokens; compaction
+    # at quiescence frees the fully-dead element rows (and their pinned
+    # token slots), so churn can continue past tokens_per_actor cycles
+    store = Store(n_actors=4)
+    m = store.declare(
+        id="kvs",
+        type="riak_dt_map",
+        fields=[(("X", "lasp_orset"), "lasp_orset",
+                 {"n_elems": 4, "tokens_per_actor": 3})],
+        reset_on_readd=True,
+    )
+    key = ("X", "lasp_orset")
+    for cycle in range(10):  # far beyond the 3-slot pool
+        store.update(m, ("update", [("update", key, ("add", "x"))]), "r1")
+        assert store.value(m) == {key: frozenset({"x"})}
+        store.update(m, ("update", [("remove", key)]), "r1")
+        assert store.value(m) == {}
+        assert store.compact_map_field(m, key) >= 1
+    # refusals: non-orset fields have no tombstones
+    import pytest
+
+    store.update(m, ("update", [("update", ("C", "riak_dt_gcounter"),
+                                 ("increment",))]), "r1")
+    with pytest.raises(TypeError, match="no token tombstones"):
+        store.compact_map_field(m, ("C", "riak_dt_gcounter"))
+
+
+def test_runtime_compact_map_field_population():
+    import pytest
+
+    store = Store(n_actors=8)
+    m = store.declare(type="riak_dt_map", reset_on_readd=True)
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    key = ("X", "lasp_orset")
+    for cycle in range(3):
+        rt.update_at(0, m, ("update", [("update", key, ("add", f"v{cycle}"))]),
+                     "w0")
+        rt.run_to_convergence(max_rounds=16)
+        rt.update_at(2, m, ("update", [("remove", key)]), "w2")
+        rt.run_to_convergence(max_rounds=16)
+        assert rt.coverage_value(m) == {}
+    # diverged populations refuse (a dropped tombstone could resurrect)
+    rt.update_at(1, m, ("update", [("update", key, ("add", "live"))]), "w1")
+    with pytest.raises(RuntimeError, match="not converged"):
+        rt.compact_map_field(m, key)
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.compact_map_field(m, key) >= 1
+    # the map keeps serving after the population-wide reindex
+    assert rt.coverage_value(m) == {key: frozenset({"live"})}
+    rt.update_at(3, m, ("update", [("update", key, ("add", "after"))]), "w3")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.coverage_value(m) == {key: frozenset({"live", "after"})}
+    assert rt.divergence(m) == 0
