@@ -139,6 +139,7 @@ class Session:
     # -- replication ---------------------------------------------------------
     def replicate(self, n_replicas: int, neighbors=None, *, topology="ring",
                   fanout: int = 3, seed: int = 0, packed: bool = False,
+                  locality: bool = True,
                   **kwargs):
         """Lift this session onto a replicated population — the one-call
         path from the single-store verbs to the mesh layer (the
@@ -150,10 +151,25 @@ class Session:
         runtime). ``neighbors`` overrides ``topology`` (one of ring /
         random / scale_free) + ``fanout`` + ``seed``; extra kwargs reach
         :class:`~lasp_tpu.mesh.runtime.ReplicatedRuntime` (``packed``,
-        ``debug_actors``, ``donate_steps``)."""
+        ``debug_actors``, ``donate_steps``). Irregular built-in
+        topologies are locality-ordered by default (a graph isomorphism)
+        so a later ``rt.shard(mesh, partition=True)`` ships the cut, not
+        the population. NOTE: the renumbering means replica INDICES no
+        longer match the raw builder's (e.g. ``scale_free`` hubs are no
+        longer the low indices); the permutation is exposed as
+        ``rt.locality_perm`` (``perm[new_index] = builder_index``), and
+        the O(R) host-side walk costs a few seconds at 10M replicas.
+        ``locality=False`` opts out, and an explicit ``neighbors`` table
+        is never reordered."""
         from ..mesh import ReplicatedRuntime
-        from ..mesh.topology import random_regular, ring, scale_free
+        from ..mesh.topology import (
+            locality_order,
+            random_regular,
+            ring,
+            scale_free,
+        )
 
+        perm = None
         if neighbors is None:
             builder = {
                 "ring": lambda: ring(n_replicas, fanout),
@@ -168,10 +184,17 @@ class Session:
                     "(ring | random | scale_free)"
                 )
             neighbors = builder()
-        return ReplicatedRuntime(
+            if locality and topology != "ring":
+                perm, neighbors = locality_order(neighbors)
+        rt = ReplicatedRuntime(
             self.store, self.graph, n_replicas, neighbors,
             packed=packed, **kwargs,
         )
+        # builder-index of each replica row (None when no reordering
+        # happened) — experiments keyed to raw builder indices translate
+        # through this
+        rt.locality_perm = perm
+        return rt
 
     # -- programs (L5, src/lasp_program.erl) ---------------------------------
     def register(self, name: str, program_cls, *args, **kwargs) -> str:

@@ -137,3 +137,30 @@ def test_session_replicate_rejects_unknown_topology():
     s.declare("lasp_gset", n_elems=4)
     with pytest.raises(ValueError, match="unknown topology"):
         s.replicate(8, topology="hypercube")
+
+
+def test_replicate_locality_ordering():
+    # irregular built-in topologies come back locality-ordered with the
+    # permutation exposed; rings and explicit tables are untouched
+    import numpy as np
+
+    from lasp_tpu import Session
+    from lasp_tpu.mesh.topology import locality_order, scale_free
+
+    s = Session(n_actors=4)
+    v = s.declare("lasp_gset", n_elems=4)
+    s.update(v, ("add", "x"), actor="w")
+    rt = s.replicate(64, topology="scale_free", seed=3)
+    perm_ref, nn_ref = locality_order(scale_free(64, 3, seed=3))
+    assert rt.locality_perm is not None
+    assert np.array_equal(np.asarray(rt.neighbors), nn_ref)
+    assert np.array_equal(rt.locality_perm, perm_ref)
+    rt.run_to_convergence(max_rounds=64)
+    assert rt.coverage_value(v) == frozenset({"x"})
+
+    s2 = Session(n_actors=4)
+    s2.declare("lasp_gset", n_elems=4)
+    rt2 = s2.replicate(16, topology="ring")
+    assert rt2.locality_perm is None
+    rt3 = s2.replicate(16, topology="scale_free", locality=False)
+    assert rt3.locality_perm is None
