@@ -2468,15 +2468,17 @@ class ReplicatedRuntime:
             % (mesh.shape["slices"] * mesh.shape["replicas"])
             == 0
         )
+        part_axis = axis  # what the partition plan shards over
         if axis is None and joint_divides:
             # canonical build_mesh layout: comm.py owns its definition
+            part_axis = ("slices", "replicas")
             from .comm import neighbor_sharding, population_sharding
 
             sharding = population_sharding(mesh)
             nbr_sharding = neighbor_sharding(mesh)
         else:
             if axis is None:
-                axis = "replicas"
+                axis = part_axis = "replicas"
                 if self.n_replicas % mesh.shape[axis] != 0:
                     raise ValueError(
                         f"cannot shard {self.n_replicas} replicas over this "
@@ -2502,7 +2504,7 @@ class ReplicatedRuntime:
                 f"unknown partition_mode {partition_mode!r} "
                 "(expected 'gather' or 'alltoall')"
             )
-        plan = self._plan_partition(mesh, axis) if partition else None
+        plan = self._plan_partition(mesh, part_axis) if partition else None
         self.states = {
             v: jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, sharding), self.states[v]
@@ -2514,11 +2516,11 @@ class ReplicatedRuntime:
             from .shard_gossip import partition_tables
 
             send_idx, idx = partition_tables(
-                plan, mesh, axis=axis, mode=partition_mode
+                plan, mesh, axis=part_axis, mode=partition_mode
             )
             self._partition = {
                 "mesh": mesh,
-                "axis": axis,
+                "axis": part_axis,
                 "mode": partition_mode,
                 "plan": plan,
                 "send_idx": send_idx,
@@ -2541,10 +2543,15 @@ class ReplicatedRuntime:
                 "shift-structured table already lowers to "
                 "collective-permute (strictly better than any exchange)"
             )
-        if not isinstance(axis, str):
-            raise NotImplementedError(
-                "partition=True needs a single named mesh axis (pass "
-                "axis='replicas'); the joint (slices, replicas) layout "
-                "is not wired to the boundary exchange yet"
+        from .shard_gossip import axis_extent
+
+        names = (axis,) if isinstance(axis, str) else tuple(axis)
+        unknown = [a for a in names if a not in mesh.axis_names]
+        if unknown:
+            raise ValueError(
+                f"partition axis {unknown} not in mesh axes "
+                f"{mesh.axis_names}"
             )
-        return partitioned_gossip_plan(self._host_neighbors, mesh.shape[axis])
+        return partitioned_gossip_plan(
+            self._host_neighbors, axis_extent(mesh, axis)
+        )

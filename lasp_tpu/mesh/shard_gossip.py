@@ -249,7 +249,7 @@ def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
 
 
 def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
-                                axis: str = "replicas",
+                                axis="replicas",
                                 mode: str = "gather"):
     """Build ``(states, send_tbl, idx_tbl) -> states`` running ONE gossip
     round of an irregular topology via the boundary exchange of
@@ -266,14 +266,15 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
 
     Tables ride as device arrays sharded ``P(axis, None[, None])``
     (callers keep them resident across rounds)."""
-    if plan["n_shards"] != mesh.shape[axis]:
+    if plan["n_shards"] != axis_extent(mesh, axis):
         # a mismatched plan would shard send_idx into the WRONG per-device
         # rows and compute local indices against the wrong block size —
         # silently wrong merges, so refuse loudly (ring's _shift_pull
         # raises on its analogous misconfiguration)
         raise ValueError(
             f"plan was built for {plan['n_shards']} shards but mesh axis "
-            f"{axis!r} has {mesh.shape[axis]} devices — rebuild the plan"
+            f"{axis!r} has {axis_extent(mesh, axis)} devices — rebuild "
+            "the plan"
         )
     if mode not in ("gather", "alltoall"):
         raise ValueError(f"unknown partitioned gossip mode {mode!r}")
@@ -334,7 +335,16 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
     )
 
 
-def partition_tables(plan: dict, mesh: Mesh, axis: str = "replicas",
+def axis_extent(mesh: Mesh, axis) -> int:
+    """Total shard count of a mesh axis name or tuple of names."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    n = 1
+    for a in names:
+        n *= mesh.shape[a]
+    return n
+
+
+def partition_tables(plan: dict, mesh: Mesh, axis="replicas",
                      mode: str = "gather") -> tuple:
     """``plan``'s tables for ``mode`` as device arrays with the shardings
     :func:`partitioned_gossip_round_fn` expects."""
@@ -357,7 +367,7 @@ def partition_tables(plan: dict, mesh: Mesh, axis: str = "replicas",
 
 
 def partitioned_gossip_rounds(codec, spec, states, mesh: Mesh, plan: dict,
-                              n_rounds: int, axis: str = "replicas",
+                              n_rounds: int, axis="replicas",
                               mode: str = "gather"):
     """``n_rounds`` boundary-exchange rounds fused in one jit. Returns
     ``(new_states, changed)`` like :func:`ring_gossip_rounds`."""

@@ -283,8 +283,10 @@ def test_failed_partition_reshard_leaves_runtime_intact():
     rt.shard(mesh, axis="replicas", partition=True)
     rt.run_to_convergence(max_rounds=32)
     plan_before = rt._partition["plan"]
-    with pytest.raises(NotImplementedError):
-        rt.shard(mesh, axis=("replicas",), partition=True)  # tuple axis
+    with pytest.raises(ValueError, match="not (found )?in mesh"):
+        # jax's NamedSharding validation or our plan validation — either
+        # way the runtime must be left exactly as it was
+        rt.shard(mesh, axis="no_such_axis", partition=True)
     assert rt._partition is not None
     assert rt._partition["plan"] is plan_before  # untouched
     rt.run_to_convergence(max_rounds=32)  # still serves
@@ -378,3 +380,23 @@ def test_unknown_partition_mode_is_loud():
     with pytest.raises(ValueError, match="partition_mode"):
         rt.shard(_mesh(), axis="replicas", partition=True,
                  partition_mode="broadcast")
+
+
+def test_engine_step_partitioned_joint_slices_layout():
+    # the canonical build_mesh (slices, replicas) layout — the pod
+    # deployment shape — takes the boundary exchange too: axis=None
+    # resolves to the joint axes, and convergence matches unsharded
+    from lasp_tpu.mesh.comm import build_mesh
+
+    rt, nn, s = _partitioned_runtime(n=256)
+    ref, _nn, _s = _partitioned_runtime(n=256)
+    mesh = build_mesh(slice_of=lambda d: d.id % 2)  # fake 2 DCN slices
+    assert mesh.shape["slices"] == 2
+    rt.shard(mesh, partition=True)
+    assert rt._partition["axis"] == ("slices", "replicas")
+    assert rt._partition["plan"]["n_shards"] == 8
+    rt.run_to_convergence(max_rounds=64)
+    ref.run_to_convergence(max_rounds=64)
+    assert rt.divergence(s) == 0
+    assert rt.coverage_value(s) == ref.coverage_value(s)
+    assert rt.coverage_value("out") == ref.coverage_value("out")
