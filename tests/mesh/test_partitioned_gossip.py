@@ -400,3 +400,39 @@ def test_engine_step_partitioned_joint_slices_layout():
     assert rt.divergence(s) == 0
     assert rt.coverage_value(s) == ref.coverage_value(s)
     assert rt.coverage_value("out") == ref.coverage_value("out")
+
+
+def test_read_until_and_checkpoint_under_partition(tmp_path):
+    # the device-parked blocking read and the checkpoint round-trip both
+    # ride the compiled step — they must keep working when the step runs
+    # the boundary exchange
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.store import load_runtime, save_runtime
+
+    rt, nn, s = _partitioned_runtime(n=64)
+    rt.shard(_mesh(), axis="replicas", partition=True)
+    # a write lands at row 0; a reader at a far row blocks until gossip
+    # delivers it through the exchange
+    rt.update_at(0, s, ("add", "blocking"), "w9")
+    row = rt.read_until(
+        40, s, Threshold(rt.states[s].__class__(
+            exists=rt.states[s].exists[40] * 0,
+            removed=rt.states[s].removed[40] * 0,
+        ), strict=True),
+        max_rounds=64,
+    )
+    assert row is not None
+    rt.run_to_convergence(max_rounds=64)
+    want = rt.coverage_value(s)
+    assert "blocking" in want
+    # checkpoint the partition-sharded runtime and restore it fresh
+    path = str(tmp_path / "part_rt.log")
+    save_runtime(rt, path)
+    restored = load_runtime(path)
+    restored.run_to_convergence(max_rounds=64)
+    assert restored.coverage_value(s) == want
+    # the restored runtime re-shards and keeps converging
+    restored.shard(_mesh(), axis="replicas", partition=True)
+    restored.update_at(3, s, ("add", "post-restore"), "w10")
+    restored.run_to_convergence(max_rounds=64)
+    assert restored.coverage_value(s) == want | {"post-restore"}
