@@ -312,12 +312,21 @@ def _check_capacity(interner, terms, what: str) -> None:
         )
 
 
-def _validate_portable(var, portable: Any) -> None:
+def _validate_portable(var, portable: Any, _pending=None) -> None:
     """Full validation of a portable state WITHOUT touching any interner
     — structure (token ranges, dots vs the state's own clock, schema
     keys) AND interner capacity for every new elem/actor it names,
     recursing into map fields — so a rejected state consumes no capacity
-    anywhere, including in embedded field universes."""
+    anywhere, including in embedded field universes.
+
+    Dynamic map-field admission (schema growth) is NEVER applied
+    mid-pass: every growth/resync lands as a closure on ``_pending`` and
+    commits only after the WHOLE top-level state validated — a rejection
+    at any depth leaves specs, shims, and states exactly as they were
+    (the round-5 nested-map atomicity rule)."""
+    top_level = _pending is None
+    if top_level:
+        _pending = []
     tn, spec = var.type_name, var.spec
     if tn == "lasp_gset":
         _check_capacity(var.elems, [_to_key(e) for e in portable or []], "elem")
@@ -387,9 +396,21 @@ def _validate_portable(var, portable: Any) -> None:
                         f"state's own clock ({seen}) — not a valid map state"
                     )
             shim = fresh_shims.get(k)
+            known_idx = None
             if shim is None:
-                shim = var.map_aux[spec.field_index(k)]
-            _validate_portable(shim, inner)
+                known_idx = spec.field_index(k)
+                shim = var.map_aux[known_idx]
+            _validate_portable(shim, inner, _pending)
+            # NESTED maps: validating the inner portable may SCHEDULE
+            # admissions inside the submap — the parent's field triple
+            # must then track the shim's evolved spec at commit time, or
+            # the import would build against the stale sub-schema
+            if known_idx is not None and shim.type_name == "riak_dt_map":
+                def _resync(var=var, f=known_idx, shim=shim):
+                    if var.spec.fields[f][2] is not shim.spec:
+                        var.spec = var.spec.replace_field_spec(f, shim.spec)
+
+                _pending.append(_resync)
         for key, epoch in epoch_part:
             if int(epoch) < 0:
                 raise ValueError(f"negative field epoch for {key!r}")
@@ -414,9 +435,28 @@ def _validate_portable(var, portable: Any) -> None:
                 tomb_actors.append(_to_key(actor))
         _check_capacity(var.actors, list(pclock) + tomb_actors, "actor")
         if fresh:
-            # everything validated: admit for real (bottom fields, no
-            # observable change until the import lands)
-            Store.grow_map_fields(var, fresh)
+            # this level validated: SCHEDULE the admission (bottom
+            # fields, no observable change until the import lands).
+            # Fresh NESTED map triples take their temp shim's spec at
+            # commit time — the temp shims' own pending growth runs
+            # first (appended during the inner frames), so nested
+            # subfields are already folded in
+            def _commit_fresh(
+                var=var,
+                keys=[k for (k, _c, _e) in fresh],
+                shims=dict(fresh_shims),
+            ):
+                Store.grow_map_fields(
+                    var,
+                    [(k, shims[k].codec, shims[k].spec) for k in keys],
+                )
+
+            _pending.append(_commit_fresh)
+    if top_level:
+        # the WHOLE state validated: commit every scheduled admission in
+        # recursion order (children before their parents' resyncs)
+        for fn in _pending:
+            fn()
 
 
 def _import_state(var, portable: Any, *, _validated: bool = False):
@@ -426,8 +466,11 @@ def _import_state(var, portable: Any, *, _validated: bool = False):
     if not _validated:
         # may ADMIT dynamic map fields (growing var.spec) — read the spec
         # only afterwards so the imported state is laid out for the grown
-        # schema
+        # schema, and migrate the variable's own live state (the bind /
+        # merge_batch paths merge into it)
         _validate_portable(var, portable)
+        if tn == "riak_dt_map" and var.state is not None:
+            var.state = var.codec.grow(var.spec, var.state)
     spec = var.spec
     state = var.codec.new(spec)
     if tn == "lasp_gset":

@@ -19,6 +19,11 @@ admits unknown keys by growing the field axis — a new spec with the field
 appended plus :meth:`CrdtMap.grow` to append bottom slots to live states
 (the same grow-then-re-layout move interners use for element universes).
 Declaring fields up front remains a pre-sizing fast path, not a fence.
+Maps NEST: a ``{Name, riak_dt_map}`` field embeds a submap (to any
+depth) with the same dynamic admission, the parent's re-add mode, and —
+in reset mode — riak_dt's RECURSIVE reset-remove (removing a submap
+field erases exactly what was observed at every level of the subtree;
+see :func:`_reset_field`).
 
 Remove/re-add semantics — two modes:
 
@@ -114,6 +119,15 @@ class MapSpec:
         states migrate by appending bottom slots (:meth:`CrdtMap.grow`)."""
         return dataclasses.replace(self, fields=self.fields + tuple(new_fields))
 
+    def replace_field_spec(self, field_idx: int, espec) -> "MapSpec":
+        """A spec with one field's embedded spec replaced — how a NESTED
+        map field's growth propagates to its parent (the parent's triple
+        must track the submap's evolving schema)."""
+        fields = list(self.fields)
+        k, codec, _old = fields[field_idx]
+        fields[field_idx] = (k, codec, espec)
+        return dataclasses.replace(self, fields=tuple(fields))
+
 
 def _resets(spec: MapSpec) -> bool:
     # works on pre-round-4 unpickled MapSpecs too: the field is absent
@@ -157,6 +171,25 @@ def _tomb_bottom(codec, espec):
     return None
 
 
+def _reset_field(codec, espec, fs, tomb):
+    """The ONE per-type reset-remove rule (module docstring), shared by a
+    single field's :meth:`CrdtMap.remove` and the whole-map
+    :meth:`CrdtMap.reset_observed`: returns ``(new_field_state,
+    new_tomb)``."""
+    if codec.name == "riak_dt_map":
+        # recursive reset-remove: erase what was observed at EVERY level
+        # of the subtree (riak_dt's remove recurses into embedded maps)
+        return CrdtMap.reset_observed(espec, fs), tomb
+    if codec.name in ("lasp_orset", "lasp_orset_gbtree"):
+        return fs._replace(removed=fs.removed | fs.exists), tomb
+    if codec.name == "riak_dt_orswot":
+        return fs._replace(dots=jnp.zeros_like(fs.dots)), tomb
+    if codec.name == "riak_dt_gcounter":
+        return fs, jnp.maximum(tomb, fs.counts)
+    # epoch-gated types (gset/ivar): bottom-reset
+    return codec.new(espec), tomb
+
+
 class CrdtMap(CrdtType):
     name = "riak_dt_map"
 
@@ -190,17 +223,31 @@ class CrdtMap(CrdtType):
         axes — the mesh layer grows whole replica populations in place."""
         f_old = state.dots.shape[-2]
         f_new = spec.n_fields
-        if f_new == f_old:
-            return state
         batch = state.dots.shape[:-2]
-        dots = jnp.concatenate(
-            [
-                state.dots,
-                jnp.zeros(batch + (f_new - f_old, spec.n_actors), state.dots.dtype),
-            ],
-            axis=-2,
-        )
         fields = list(state.fields)
+        changed = f_new != f_old
+        for f in range(f_old):
+            # existing NESTED map fields may themselves have grown (their
+            # espec gained subfields): recurse so one top-level grow
+            # migrates the whole tree
+            _k, codec, espec = spec.fields[f]
+            if codec.name == "riak_dt_map":
+                grown_sub = CrdtMap.grow(espec, fields[f])
+                changed = changed or grown_sub is not fields[f]
+                fields[f] = grown_sub
+        if not changed:
+            return state
+        dots = state.dots
+        if f_new != f_old:
+            dots = jnp.concatenate(
+                [
+                    dots,
+                    jnp.zeros(
+                        batch + (f_new - f_old, spec.n_actors), dots.dtype
+                    ),
+                ],
+                axis=-2,
+            )
         for _k, codec, espec in spec.fields[f_old:]:
             bottom = codec.new(espec)
             if batch:
@@ -209,7 +256,7 @@ class CrdtMap(CrdtType):
                 )
             fields.append(bottom)
         epochs = state.epochs
-        if epochs is not None:
+        if epochs is not None and f_new != f_old:
             epochs = jnp.concatenate(
                 [epochs, jnp.zeros(batch + (f_new - f_old,), epochs.dtype)],
                 axis=-1,
@@ -257,19 +304,41 @@ class CrdtMap(CrdtType):
         fields = list(out.fields)
         tombs = list(out.tombs)
         fs = fields[f]
-        if codec.name in ("lasp_orset", "lasp_orset_gbtree"):
-            fields[f] = fs._replace(removed=fs.removed | fs.exists)
-        elif codec.name == "riak_dt_orswot":
-            fields[f] = fs._replace(dots=jnp.zeros_like(fs.dots))
-        elif codec.name == "riak_dt_gcounter":
-            tombs[f] = jnp.maximum(tombs[f], fs.counts)
-        else:  # epoch-gated types (gset/ivar): bottom-reset
-            fields[f] = codec.new(espec)
+        fields[f], tombs[f] = _reset_field(codec, espec, fs, tombs[f])
         return out._replace(
             fields=tuple(fields),
             tombs=tuple(tombs),
             epochs=out.epochs.at[f].add(1),
         )
+
+    @staticmethod
+    def reset_observed(spec: MapSpec, state: MapState) -> MapState:
+        """The reset-remove of an ENTIRE map state: drop every observed
+        presence dot, bump every field's epoch, and reset each field's
+        contents per its type (the same per-type rules as
+        :meth:`remove`, applied to all fields at once, recursively for
+        nested maps). Used when a PARENT map's field holding this map is
+        removed in reset mode — exactly what was observed here dies;
+        concurrent unseen updates survive the later merge."""
+        fields = list(state.fields)
+        tombs = (
+            list(state.tombs)
+            if state.tombs is not None
+            else [None] * len(fields)
+        )
+        for f, (_k, codec, espec) in enumerate(spec.fields):
+            fields[f], tombs[f] = _reset_field(
+                codec, espec, fields[f], tombs[f]
+            )
+        out = state._replace(
+            dots=jnp.zeros_like(state.dots),
+            fields=tuple(fields),
+        )
+        if state.epochs is not None:
+            out = out._replace(
+                epochs=state.epochs + 1, tombs=tuple(tombs)
+            )
+        return out
 
     @staticmethod
     def effective_field(spec: MapSpec, state: MapState, field_idx: int):

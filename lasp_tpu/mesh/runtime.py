@@ -541,11 +541,11 @@ class ReplicatedRuntime:
             # Two-phase on purpose: the scan validates EVERY op's keys
             # before anything mutates, so a malformed key later in the
             # batch raises with spec and population still in lock-step.
-            fresh = self.store.scan_map_admissions(
+            plan = self.store.scan_map_admissions(
                 var, (op for _r, op, _a in ops)
             )
-            if fresh:
-                self.store.grow_map_fields(var, fresh)
+            if plan:
+                self.store.grow_map_plan(var, plan)
                 self._grow_map_population(var)
         states = self._population(var_id)
         if not ops:

@@ -73,20 +73,27 @@ def _var_manifest(var: Variable) -> dict:
     if var.ivar_payloads is not None:
         m["ivar_payloads"] = list(var.ivar_payloads.terms())
     if var.map_aux is not None:
-        m["map_aux"] = [
-            {
-                "elems": list(s.elems.terms()) if s.elems is not None else None,
-                "ivar_payloads": (
-                    list(s.ivar_payloads.terms())
-                    if s.ivar_payloads is not None
-                    else None
-                ),
-            }
-            for s in var.map_aux
-        ]
+        m["map_aux"] = [_shim_manifest(s) for s in var.map_aux]
     if var.actors is not None:
         m["actors"] = list(var.actors.terms())
     return m
+
+
+def _shim_manifest(shim) -> dict:
+    """One map-field shim's interner terms — RECURSIVE: nested map fields
+    carry their own shim trees, whose element/payload universes must
+    round-trip too (round 5)."""
+    out = {
+        "elems": list(shim.elems.terms()) if shim.elems is not None else None,
+        "ivar_payloads": (
+            list(shim.ivar_payloads.terms())
+            if shim.ivar_payloads is not None
+            else None
+        ),
+    }
+    if shim.map_aux is not None:
+        out["map_aux"] = [_shim_manifest(s) for s in shim.map_aux]
+    return out
 
 
 def _restore_interners(var: Variable, m: dict) -> None:
@@ -100,13 +107,19 @@ def _restore_interners(var: Variable, m: dict) -> None:
         for t in m["actors"]:
             var.actors.intern(t)
     if m.get("map_aux") is not None:
-        for shim, sm in zip(var.map_aux, m["map_aux"]):
-            if sm["elems"] is not None:
-                for t in sm["elems"]:
-                    shim.elems.intern(t)
-            if sm["ivar_payloads"] is not None:
-                for t in sm["ivar_payloads"]:
-                    shim.ivar_payloads.intern(t)
+        _restore_shims(var.map_aux, m["map_aux"])
+
+
+def _restore_shims(shims, manifests) -> None:
+    for shim, sm in zip(shims, manifests):
+        if sm["elems"] is not None:
+            for t in sm["elems"]:
+                shim.elems.intern(t)
+        if sm["ivar_payloads"] is not None:
+            for t in sm["ivar_payloads"]:
+                shim.ivar_payloads.intern(t)
+        if sm.get("map_aux") is not None and shim.map_aux is not None:
+            _restore_shims(shim.map_aux, sm["map_aux"])
 
 
 def _varmeta_key(var_id) -> str:

@@ -75,17 +75,43 @@ ALLOWED_CAPS = {
 }
 
 
-def resolve_map_field(key, type_name: str, caps, n_actors: int) -> tuple:
+def resolve_map_field(
+    key, type_name: str, caps, n_actors: int, reset_on_readd: bool = False
+) -> tuple:
     """``(key, codec, espec)`` for ONE map field — the single validation
     path shared by declared schemas (:func:`build_map_spec`) and dynamic
     admission (:meth:`Store.admit_map_fields`), so both reject the same
-    misuses with the same exception types."""
+    misuses with the same exception types. ``reset_on_readd`` is the
+    PARENT map's re-add mode: nested map fields inherit it (one coherent
+    reset behavior per tree — riak_dt's remove recurses uniformly)."""
     caps = dict(caps or {})
     if type_name == "riak_dt_map":
-        raise TypeError(
-            f"map field {key!r}: nested riak_dt_map fields are not "
-            "supported (flatten the schema)"
+        # nested map: embedded schema recurses; declared sub-fields are
+        # pre-sizing like the top level, unknown keys admit dynamically
+        if "reset_on_readd" in caps and bool(
+            caps["reset_on_readd"]
+        ) != bool(reset_on_readd):
+            raise TypeError(
+                f"map field {key!r}: nested reset_on_readd must match "
+                f"the parent map's ({reset_on_readd}) — one reset "
+                "behavior per tree"
+            )
+        if caps.get("n_actors", n_actors) != n_actors:
+            raise TypeError(
+                f"map field {key!r}: n_actors must match the map's "
+                f"({n_actors}); per-field writer universes are not "
+                "separable from the map clock"
+            )
+        unknown = set(caps) - {"fields", "n_actors", "reset_on_readd"}
+        if unknown:
+            raise TypeError(
+                f"map field {key!r} (riak_dt_map): unknown capacity "
+                f"kwargs {sorted(unknown)}"
+            )
+        espec = build_map_spec(
+            caps.get("fields", ()), n_actors, reset_on_readd=reset_on_readd
         )
+        return (key, get_type("riak_dt_map"), espec)
     if type_name not in ALLOWED_CAPS:
         raise TypeError(f"map field {key!r}: unknown type {type_name!r}")
     unknown = set(caps) - ALLOWED_CAPS[type_name]
@@ -153,7 +179,9 @@ def build_map_spec(fields, n_actors: int, reset_on_readd: bool = False) -> MapSp
     (``riak_test/lasp_kvs_replica_test.erl:57-135`` updates keys never
     declared anywhere) — see :meth:`Store.admit_map_fields`."""
     resolved = [
-        resolve_map_field(key, type_name, caps, n_actors)
+        resolve_map_field(
+            key, type_name, caps, n_actors, reset_on_readd=reset_on_readd
+        )
         for key, type_name, caps in fields
     ]
     return MapSpec(
@@ -308,6 +336,13 @@ class Store:
         shim.actors = parent.actors
         if fcodec.name == "lasp_ivar":
             shim.ivar_payloads = Interner(2**31 - 1, kind="ivar payload")
+        if fcodec.name == "riak_dt_map":
+            # nested map: the shim carries its own sub-shims (recursive),
+            # sharing the one actor universe all the way down
+            shim.map_aux = [
+                Store._field_shim(shim.id, k2, c2, s2, shim)
+                for k2, c2, s2 in fspec.fields
+            ]
         return shim
 
     # -- dynamic map fields ---------------------------------------------------
@@ -326,23 +361,31 @@ class Store:
                 "update requires (name, type_name) keys (riak_dt_map's "
                 "{Name, Type}) — or pre-declare the field"
             )
-        return resolve_map_field(key, type_name, None, spec.n_actors)
+        return resolve_map_field(
+            key, type_name, None, spec.n_actors,
+            reset_on_readd=spec.reset_on_readd,
+        )
 
     @classmethod
-    def scan_map_admissions(cls, var: Variable, ops) -> list:
-        """Validate-only pass: the ``(key, codec, espec)`` triples for
-        every unknown field key that the update subs of ``ops`` (an
-        iterable of map client ops) touch for the first time. Raises on
-        any non-admissible key WITHOUT mutating anything — callers grow
-        atomically afterwards (:meth:`grow_map_fields`), so a bad op later
-        in a batch can never leave the spec half-grown. Removes never
-        admit — removing an absent field is a precondition error, not a
-        creation."""
+    def scan_map_admissions(cls, var: Variable, ops) -> dict:
+        """Validate-only pass over the update subs of ``ops`` (an iterable
+        of map client ops), RECURSIVE through nested map fields: returns
+        an admission PLAN ``{"fresh": [(key, codec, espec), ...], "sub":
+        {field_idx: subplan}}`` (either key absent when empty; ``{}`` =
+        no growth anywhere). Raises on any non-admissible key WITHOUT
+        mutating anything — callers grow atomically afterwards
+        (:meth:`grow_map_plan`), so a bad op later in a batch can never
+        leave the spec half-grown. Removes never admit — removing an
+        absent field is a precondition error, not a creation."""
+        return cls._scan_level(var.spec, var.map_aux, ops)
+
+    @classmethod
+    def _scan_level(cls, spec: MapSpec, map_aux, ops) -> dict:
         from ..lattice.map import map_subs
 
-        spec = var.spec
-        known = {k for k, _c, _s in spec.fields}
-        fresh, seen = [], set()
+        known = {k: i for i, (k, _c, _s) in enumerate(spec.fields)}
+        fresh: dict = {}  # key -> [codec, espec] (espec evolves for maps)
+        sub_ops: dict = {}  # field_idx -> [inner ops]
         for op in ops:
             for sub in map_subs(op):
                 if not (
@@ -351,47 +394,132 @@ class Store:
                     and sub[0] == "update"
                 ):
                     continue  # removes / malformed: the normal path rules
-                key = sub[1]
-                if key in known or key in seen:
-                    continue
-                fresh.append(cls.resolve_dynamic_field(spec, key))
-                seen.add(key)
-        return fresh
-
-    def admit_map_fields(self, var: Variable, op: tuple) -> int:
-        """Admit unknown map field keys touched by ``op``'s updates (the
-        reference's dynamic schema: ``riak_dt_map`` creates a field the
-        first time ``{update, Key, Op}`` names it). Returns how many fields
-        were admitted; 0 means the layout is unchanged. Admission is
-        observably a no-op until the update itself lands (a fresh field
-        has no presence dots), so batch layers may pre-admit a whole batch
-        up front without changing sequential semantics."""
-        fresh = self.scan_map_admissions(var, (op,))
-        if not fresh:
-            return 0
-        self.grow_map_fields(var, fresh)
-        return len(fresh)
+                key, inner = sub[1], sub[2]
+                if key in known:
+                    f = known[key]
+                    if spec.fields[f][1].name == "riak_dt_map":
+                        sub_ops.setdefault(f, []).append(inner)
+                elif key in fresh:
+                    codec, espec = fresh[key]
+                    if codec.name == "riak_dt_map":
+                        fresh[key][1] = cls._extend_fresh_subspec(
+                            espec, inner
+                        )
+                else:
+                    triple = cls.resolve_dynamic_field(spec, key)
+                    entry = [triple[1], triple[2]]
+                    if entry[0].name == "riak_dt_map":
+                        entry[1] = cls._extend_fresh_subspec(
+                            entry[1], inner
+                        )
+                    fresh[key] = entry
+        plan: dict = {}
+        subs: dict = {}
+        for f, inners in sub_ops.items():
+            # inner ops ARE map client ops for the submap (the recursive
+            # op grammar); scan them against the submap's spec/shims
+            shim = map_aux[f] if map_aux is not None else None
+            subplan = cls._scan_level(
+                spec.fields[f][2],
+                shim.map_aux if shim is not None else None,
+                inners,
+            )
+            if subplan:
+                subs[f] = subplan
+        if fresh:
+            plan["fresh"] = [(k, c, e) for k, (c, e) in fresh.items()]
+        if subs:
+            plan["sub"] = subs
+        return plan
 
     @classmethod
-    def grow_map_fields(cls, var: Variable, fresh: list) -> None:
-        """Append admitted fields: new spec, state migration (bottom slots),
-        per-field shims, and parked watch thresholds re-laid-out so
-        ``threshold_met`` keeps comparing same-shaped states. Static so
+    def _extend_fresh_subspec(cls, espec: MapSpec, inner_op) -> MapSpec:
+        """Fold a fresh NESTED map field's inner op into its staged spec:
+        the submap has no shims yet, so admission happens purely at the
+        spec level."""
+        subplan = cls._scan_level(espec, None, [inner_op])
+        return cls._apply_plan_to_spec(espec, subplan)
+
+    @classmethod
+    def _apply_plan_to_spec(cls, spec: MapSpec, plan: dict) -> MapSpec:
+        for f, subplan in plan.get("sub", {}).items():
+            spec = spec.replace_field_spec(
+                f, cls._apply_plan_to_spec(spec.fields[f][2], subplan)
+            )
+        if plan.get("fresh"):
+            spec = spec.with_fields(plan["fresh"])
+        return spec
+
+    def admit_map_fields(self, var: Variable, op: tuple) -> int:
+        """Admit unknown map field keys touched by ``op``'s updates at ANY
+        nesting depth (the reference's dynamic schema: ``riak_dt_map``
+        creates a field the first time ``{update, Key, Op}`` names it).
+        Returns how many fields were admitted; 0 means the layout is
+        unchanged. Admission is observably a no-op until the update
+        itself lands (a fresh field has no presence dots), so batch
+        layers may pre-admit a whole batch up front without changing
+        sequential semantics."""
+        plan = self.scan_map_admissions(var, (op,))
+        if not plan:
+            return 0
+        return self.grow_map_plan(var, plan)
+
+    @classmethod
+    def grow_map_plan(cls, var: Variable, plan: dict) -> int:
+        """Apply an admission plan from :meth:`scan_map_admissions`: new
+        (recursively grown) spec, shim tree sync, state migration (bottom
+        slots at every level), and parked watch thresholds re-laid-out so
+        ``threshold_met`` keeps comparing same-shaped states. Returns the
+        number of fields admitted across all levels. Static so
         state-import layers (the ETF bridge) can admit against a bare
         Variable."""
         from ..lattice.map import CrdtMap, MapState
 
-        var.spec = var.spec.with_fields(fresh)
+        var.spec = cls._apply_plan_to_spec(var.spec, plan)
+        count = cls._sync_shims(var)
         if var.state is not None:
             var.state = CrdtMap.grow(var.spec, var.state)
-        for key, fcodec, fspec in fresh:
-            var.map_aux.append(cls._field_shim(var.id, key, fcodec, fspec, var))
         for watch in list(var.waiting) + list(var.lazy):
             thr = watch.threshold
             if thr is not None and isinstance(thr.state, MapState):
                 watch.threshold = Threshold(
                     CrdtMap.grow(var.spec, thr.state), thr.strict
                 )
+        return count
+
+    @classmethod
+    def grow_map_fields(cls, var: Variable, fresh: list) -> None:
+        """Level-local admission of ``fresh`` triples (the ETF bridge's
+        per-level import path); equivalent to a plan with only a
+        ``fresh`` component."""
+        cls.grow_map_plan(var, {"fresh": list(fresh)})
+
+    @classmethod
+    def _sync_shims(cls, var: Variable) -> int:
+        """Align the shim tree with ``var.spec`` after growth: append
+        shims for new fields, push updated nested especs down, recurse.
+        Returns how many shims were created (== fields admitted)."""
+        created = 0
+        for i, (key, fcodec, fspec) in enumerate(var.spec.fields):
+            if i >= len(var.map_aux):
+                var.map_aux.append(
+                    cls._field_shim(var.id, key, fcodec, fspec, var)
+                )
+                created += 1 + cls._count_fields(fcodec, fspec)
+            elif fcodec.name == "riak_dt_map":
+                shim = var.map_aux[i]
+                if shim.spec is not fspec:
+                    shim.spec = fspec
+                    created += cls._sync_shims(shim)
+        return created
+
+    @classmethod
+    def _count_fields(cls, fcodec, fspec) -> int:
+        if fcodec.name != "riak_dt_map":
+            return 0
+        return sum(
+            1 + cls._count_fields(c, s) for _k, c, s in fspec.fields
+        )
 
     def redeclare_derived(self, id: str, type: str, spec: Any, elems: Any) -> str:
         """Replace a (still-bottom) variable's codec layout with a derived

@@ -430,14 +430,21 @@ def test_dynamic_admission_key_validation():
         store.update(
             m, ("update", [("update", ("A", "no_such_type"), ("add", "x"))]), "r1"
         )
-    # nested maps are rejected exactly like the declared-schema path
-    with pytest.raises(TypeError):
-        store.update(
-            m,
-            ("update", [("update", ("N", "riak_dt_map"), ("update", []))]),
-            "r1",
+    # nested maps ADMIT (round 5): an empty batched inner op creates the
+    # submap field with an empty dynamic schema
+    store.update(
+        m,
+        ("update", [("update", ("N", "riak_dt_map"), ("update", []))]),
+        "r1",
+    )
+    assert store.value(m) == {("N", "riak_dt_map"): {}}
+    # a mismatched nested reset mode is still loud at declare
+    with pytest.raises(TypeError, match="reset_on_readd must match"):
+        store.declare(
+            type="riak_dt_map",
+            fields=[(("N", "riak_dt_map"), "riak_dt_map",
+                     {"reset_on_readd": True})],
         )
-    assert store.variable(m).spec.fields == ()
 
 
 def test_dynamic_watch_thresholds_grow():
@@ -712,3 +719,106 @@ def test_runtime_compact_map_field_population():
     rt.run_to_convergence(max_rounds=16)
     assert rt.coverage_value(m) == {key: frozenset({"live", "after"})}
     assert rt.divergence(m) == 0
+
+
+# -- nested riak_dt_map fields (round 5) --------------------------------------
+# riak_dt_map embeds maps; {Name, riak_dt_map} keys nest to any depth,
+# dynamically admitted like every other field.
+
+KN = ("N", "riak_dt_map")
+KC = ("c", "riak_dt_gcounter")
+KS = ("s", "lasp_orset")
+
+
+def test_nested_map_schemaless_flow():
+    store = Store(n_actors=8)
+    m = store.declare(type="riak_dt_map")
+    store.update(
+        m,
+        ("update", [("update", KN,
+                     ("update", [("update", KC, ("increment", 3))]))]),
+        "r1",
+    )
+    store.update(m, ("update", [("update", KN, ("update", KS, ("add", "x")))]),
+                 "r1")
+    assert store.value(m) == {KN: {KC: 3, KS: frozenset({"x"})}}
+    # depth 3
+    K2 = ("D", "riak_dt_map")
+    store.update(
+        m,
+        ("update", [("update", KN,
+                     ("update", K2, ("update", KC, ("increment",))))]),
+        "r2",
+    )
+    assert store.value(m)[KN][K2] == {KC: 1}
+    # inner remove: presence only in default mode; absent inner remove is
+    # a precondition error
+    store.update(m, ("update", [("update", KN, ("remove", KS))]), "r1")
+    assert KS not in store.value(m)[KN]
+    import pytest
+
+    with pytest.raises(PreconditionError):
+        store.update(
+            m, ("update", [("update", KN, ("remove", ("zz", "lasp_gset")))]),
+            "r1",
+        )
+
+
+def test_nested_map_reset_remove_recurses():
+    from lasp_tpu.lattice import CrdtMap
+
+    store = Store(n_actors=8)
+    m = store.declare(type="riak_dt_map", reset_on_readd=True)
+    store.update(m, ("update", [("update", KN, ("update", KC, ("increment", 5)))]),
+                 "r1")
+    # removing the SUBMAP resets everything the remover observed inside it
+    store.update(m, ("update", [("remove", KN)]), "r1")
+    assert store.value(m) == {}
+    store.update(m, ("update", [("update", KN, ("update", KC, ("increment", 2)))]),
+                 "r1")
+    assert store.value(m) == {KN: {KC: 2}}  # the 5 stay reset (floor)
+    # inner-field reset works the same one level down
+    store.update(m, ("update", [("update", KN, ("remove", KC))]), "r1")
+    assert store.value(m)[KN] == {}
+    store.update(m, ("update", [("update", KN, ("update", KC, ("increment", 4)))]),
+                 "r1")
+    assert store.value(m)[KN] == {KC: 4}
+    # CONCURRENCY: a submap remove vs a concurrent inner update — the
+    # update's own contribution survives (recursive reset-remove)
+    var = store.variable(m)
+    base = var.state
+    a = store._apply_op(var, base, ("update", [("remove", KN)]), "r1")
+    b = store._apply_op(
+        var, base,
+        ("update", [("update", KN, ("update", KC, ("increment", 7)))]), "r2",
+    )
+    merged = CrdtMap.merge(var.spec, a, b)
+    assert store._decode_value(var, merged) == {KN: {KC: 7}}
+
+
+def test_nested_map_mesh_convergence_and_checkpoint(tmp_path):
+    from lasp_tpu.store.checkpoint import load_store, save_store
+
+    store = Store(n_actors=8)
+    m = store.declare(type="riak_dt_map")
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    rt.update_at(0, m, ("update", [("update", KN, ("update", KC, ("increment", 2)))]),
+                 "w0")
+    rt.run_to_convergence(max_rounds=16)
+    # nested DYNAMIC admission mid-run at a different replica
+    rt.update_at(2, m, ("update", [("update", KN, ("update", KS, ("add", "deep")))]),
+                 "w2")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.divergence(m) == 0
+    want = {KN: {KC: 2, KS: frozenset({"deep"})}}
+    assert rt.coverage_value(m) == want
+    # checkpoint round-trips nested interners (round-5 recursion fix)
+    store.bind_raw(m, jax.tree_util.tree_map(lambda x: x[0], rt.states[m]))
+    path = str(tmp_path / "nested.log")
+    save_store(store, path)
+    restored = load_store(path)
+    assert restored.value(m) == want
+    restored.update(
+        m, ("update", [("update", KN, ("update", KS, ("add", "post")))]), "w9"
+    )
+    assert restored.value(m)[KN][KS] == frozenset({"deep", "post"})

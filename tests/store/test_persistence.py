@@ -245,10 +245,28 @@ def test_map_field_caps_validated():
             type="riak_dt_map",
             fields=[(("k", "lasp_orset"), "lasp_orset", {"n_elem": 2})],
         )
-    with pytest.raises(TypeError, match="nested"):
+    # nested map fields are supported (round 5): a declared submap schema
+    # recurses, and its re-add mode must match the parent's
+    m = store.declare(
+        type="riak_dt_map",
+        fields=[(("k", "riak_dt_map"), "riak_dt_map",
+                 {"fields": [(("c", "riak_dt_gcounter"),
+                              "riak_dt_gcounter", {})]})],
+    )
+    store.update(
+        m,
+        ("update", [("update", ("k", "riak_dt_map"),
+                     ("update", ("c", "riak_dt_gcounter"), ("increment",)))]),
+        "w",
+    )
+    assert store.value(m) == {
+        ("k", "riak_dt_map"): {("c", "riak_dt_gcounter"): 1}
+    }
+    with pytest.raises(TypeError, match="reset_on_readd must match"):
         store.declare(
-            type="riak_dt_map",
-            fields=[(("k", "riak_dt_map"), "riak_dt_map", {})],
+            type="riak_dt_map", reset_on_readd=True,
+            fields=[(("k", "riak_dt_map"), "riak_dt_map",
+                     {"reset_on_readd": False})],
         )
 
 
