@@ -125,62 +125,100 @@ class MeshModel:
     def counter_of(seen: set) -> int:
         return sum(o[2] for o in seen if o[0] == "inc")
 
-    # -- riak_dt_map (composed fields under presence dots) -------------------
-    def map_update(self, row, var, key, content):
-        """One {update, Key, InnerOp}: a presence touch + a content op.
-        ``content``: ("madd", elem) or ("minc", by)."""
-        self.seen[row].add(("mtouch", self.next_id, var, key))
-        self.next_id += 1
-        self.seen[row].add((content[0], self.next_id, var, key, content[1]))
+    # -- riak_dt_map (composed fields under presence dots; keys are key
+    # PATHS — tuples — so nested submaps model with the same ops) ------------
+    def map_update(self, row, var, path, content):
+        """One (possibly nested) field update: a presence touch for EVERY
+        prefix of ``path`` plus a content op at ``path``. ``content``:
+        ("madd", elem) or ("minc", by)."""
+        for i in range(1, len(path) + 1):
+            self.seen[row].add(("mtouch", self.next_id, var, path[:i]))
+            self.next_id += 1
+        self.seen[row].add((content[0], self.next_id, var, path, content[1]))
         self.next_id += 1
 
-    def map_present(self, row, var, key) -> bool:
-        seen = self.seen[row]
-        killed = set()
+    @staticmethod
+    def _killed(seen) -> set:
+        out: set = set()
         for o in seen:
             if o[0] == "mkill":
-                killed |= o[2]
+                out |= o[2]
+        return out
+
+    def map_present(self, row, var, path) -> bool:
+        seen = self.seen[row]
+        killed = self._killed(seen)
         return any(
-            o[0] == "mtouch" and o[2] == var and o[3] == key
+            o[0] == "mtouch" and o[2] == var and o[3] == path
             and o[1] not in killed
             for o in seen
         )
 
-    def map_remove(self, row, var, key, reset: bool):
-        """{remove, Key}: kill the touches observed at this row; in reset
-        mode also kill the observed CONTENT ops (riak_dt reset-remove)."""
-        kinds = ("mtouch", "madd", "minc") if reset else ("mtouch",)
-        killed = frozenset(
-            o[1] for o in self.seen[row]
-            if o[0] in kinds and o[2] == var and o[3] == key
-        )
+    def map_remove(self, row, var, path, reset: bool):
+        """Remove the field at ``path``: kill the touches observed AT the
+        path; in reset mode also kill everything observed BELOW it
+        (touches + content ops — riak_dt's recursive reset-remove). In
+        default mode only the path's own presence dies: nested
+        sub-presences survive hidden and resurface on re-add, exactly
+        like the dense encoding's outer-dots-only removal."""
+        seen = self.seen[row]
+        n = len(path)
+        # an INNER remove rides {update, OuterKey, {remove, InnerKey}}:
+        # the engine MINTS a fresh presence dot on every ancestor on the
+        # way down (the update path touches), so the model must too —
+        # an inner remove resurrects a previously-removed ancestor
+        for i in range(1, n):
+            self.seen[row].add(("mtouch", self.next_id, var, path[:i]))
+            self.next_id += 1
+        if reset:
+            killed = frozenset(
+                o[1] for o in seen
+                if o[0] in ("mtouch", "madd", "minc") and o[2] == var
+                and o[3][:n] == path
+            )
+        else:
+            killed = frozenset(
+                o[1] for o in seen
+                if o[0] == "mtouch" and o[2] == var and o[3] == path
+            )
         self.seen[row].add(("mkill", self.next_id, killed))
         self.next_id += 1
 
     def map_value(self, row, var) -> dict:
         seen = self.seen[row]
-        killed = set()
-        for o in seen:
-            if o[0] == "mkill":
-                killed |= o[2]
-        out: dict = {}
-        for o in seen:
-            if o[0] == "mtouch" and o[2] == var and o[1] not in killed:
-                out.setdefault(o[3], None)
-        for key in list(out):
-            if key[1] == "riak_dt_gcounter":
-                out[key] = sum(
-                    o[4] for o in seen
-                    if o[0] == "minc" and o[2] == var and o[3] == key
-                    and o[1] not in killed
-                )
-            else:
-                out[key] = frozenset(
-                    o[4] for o in seen
-                    if o[0] == "madd" and o[2] == var and o[3] == key
-                    and o[1] not in killed
-                )
-        return out
+        killed = self._killed(seen)
+        visible = {
+            o[3]
+            for o in seen
+            if o[0] == "mtouch" and o[2] == var and o[1] not in killed
+        }
+        # ancestor visibility is enforced structurally: build() recurses
+        # only through prefixes that are themselves visible
+
+        def build(prefix) -> dict:
+            out: dict = {}
+            depth = len(prefix) + 1
+            for path in visible:
+                if len(path) != depth or path[: len(prefix)] != prefix:
+                    continue
+                key = path[-1]
+                if key[1] == "riak_dt_map":
+                    out[key] = build(path)
+                elif key[1] == "riak_dt_gcounter":
+                    out[key] = sum(
+                        o[4] for o in seen
+                        if o[0] == "minc" and o[2] == var and o[3] == path
+                        and o[1] not in killed
+                    )
+                else:
+                    out[key] = frozenset(
+                        o[4] for o in seen
+                        if o[0] == "madd" and o[2] == var and o[3] == path
+                        and o[1] not in killed
+                    )
+            return out
+
+        return build(())
 
     def orset_value(self, row, var="s") -> frozenset:
         return self.orset_of(self.seen[row], var)
@@ -219,6 +257,11 @@ def test_mesh_statem(seed):
     m_rst = store.declare(id="m_rst", type="riak_dt_map",
                           n_actors=N_ACTORS, reset_on_readd=True)
     MKEYS = [("S1", "lasp_orset"), ("C1", "riak_dt_gcounter")]
+    MSUB = ("M1", "riak_dt_map")  # nested submap key
+    MPATHS = (
+        [(k,) for k in MKEYS]
+        + [(MSUB, ("s", "lasp_orset")), (MSUB, ("c", "riak_dt_gcounter"))]
+    )
     rt = ReplicatedRuntime(store, Graph(store), n, nbrs,
                            debug_actors=True, donate_steps=False)
     model = MeshModel(n, nbrs)
@@ -276,34 +319,53 @@ def test_mesh_statem(seed):
                 ops.append((r, ("add", e), actor(r)))
                 model.add(r, e)
             rt.update_batch(s, ops)
-        elif roll < 0.60:  # map field ops (dynamic admission included)
+        elif roll < 0.60:  # map field ops (dynamic admission, NESTED paths)
             r = rng.randrange(model.n)
             vid, tag = (m_def, "md") if rng.random() < 0.5 else (m_rst, "mr")
-            key = rng.choice(MKEYS)
+            path = rng.choice(MPATHS)
+
+            def wire_update(path, inner):
+                op = ("update", path[-1], inner)
+                for key in reversed(path[:-1]):
+                    op = ("update", key, op)
+                return ("update", [op])
+
+            def wire_remove(path):
+                op = ("remove", path[-1])
+                for key in reversed(path[:-1]):
+                    op = ("update", key, op)
+                return ("update", [op])
+
             # removes get near-parity odds AND pick their row among rows
             # where the field IS present: the round-5 reset-remove
-            # semantics (token tombstones, counter floors) live on this
-            # branch, and a random row rarely satisfies the presence
-            # precondition on a young map
+            # semantics (token tombstones, counter floors, recursive
+            # submap resets) live on this branch
             present_rows = (
-                [q for q in range(model.n) if model.map_present(q, tag, key)]
+                [q for q in range(model.n) if model.map_present(q, tag, path)]
                 if rng.random() < 0.45
                 else []
             )
             if present_rows:
                 r = rng.choice(present_rows)
-                rt.update_at(r, vid, ("update", [("remove", key)]), actor(r))
-                model.map_remove(r, tag, key, reset=(tag == "mr"))
+                rt.update_at(r, vid, wire_remove(path), actor(r))
+                model.map_remove(r, tag, path, reset=(tag == "mr"))
+            elif rng.random() < 0.15 and (subrows := [
+                q for q in range(model.n)
+                if model.map_present(q, tag, (MSUB,))
+            ]):
+                # occasionally remove the WHOLE submap (recursive reset)
+                r = rng.choice(subrows)
+                rt.update_at(r, vid, wire_remove((MSUB,)), actor(r))
+                model.map_remove(r, tag, (MSUB,), reset=(tag == "mr"))
             else:
+                key = path[-1]
                 inner = (
                     ("increment", rng.randint(1, 3))
                     if key[1] == "riak_dt_gcounter"
                     else ("add", rng.choice(ELEMS))
                 )
                 try:
-                    rt.update_at(
-                        r, vid, ("update", [("update", key, inner)]), actor(r)
-                    )
+                    rt.update_at(r, vid, wire_update(path, inner), actor(r))
                 except CapacityError:
                     # reset-mode OR-Set fields pin tombstoned token slots
                     # (documented cost): the default espec's pool can
@@ -312,7 +374,7 @@ def test_mesh_statem(seed):
                     pass
                 else:
                     model.map_update(
-                        r, tag, key,
+                        r, tag, path,
                         ("minc", inner[1]) if inner[0] == "increment"
                         else ("madd", inner[1]),
                     )
