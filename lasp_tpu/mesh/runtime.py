@@ -2244,19 +2244,23 @@ class ReplicatedRuntime:
         states = self._population(var_id)  # dense: maps are never packed
         row0 = jax.tree_util.tree_map(lambda x: x[0], states)
         # the converged row is the authority; validations + plan are the
-        # store's one shared path
-        f, order, fresh = self.store.compact_map_plan(var_id, key, state=row0)
-        shim = var.map_aux[f]
+        # store's one shared path (key may be a PATH into nested submaps)
+        idxs, shim, order, fresh = self.store.compact_map_plan(
+            var_id, key, state=row0
+        )
         reclaimed = len(shim.elems) - len(fresh)
         if not reclaimed:
             return 0
-        var.state = var.codec.set_field(
-            var.spec, var.state,
-            f, self.store.reindex_orset_state(var.state.fields[f], order),
+
+        leaf_of = self.store._nested_field
+        var.state = self.store._replace_nested_field(
+            var.codec, var.spec, var.state, idxs,
+            self.store.reindex_orset_state(leaf_of(var.state, idxs), order),
         )
-        fields = list(states.fields)
-        fields[f] = self.store.reindex_orset_state(fields[f], order)
-        self.states[var_id] = states._replace(fields=tuple(fields))
+        self.states[var_id] = self.store._replace_nested_field(
+            var.codec, var.spec, states, idxs,
+            self.store.reindex_orset_state(leaf_of(states, idxs), order),
+        )
         shim.elems = fresh
         return reclaimed
 

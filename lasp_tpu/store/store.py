@@ -955,12 +955,40 @@ class Store:
             fresh.intern(terms[int(i)])
         return order, fresh
 
+    @staticmethod
+    def _normalize_map_path(key) -> tuple:
+        """A field reference is either ONE ``{Name, Type}`` key or a PATH
+        (tuple of keys) into nested submaps; single keys normalize to a
+        length-1 path. Classification uses the self-describing key shape
+        itself: anything :func:`map_key_type_name` recognizes is a single
+        key (tuple-NAMED keys like ``((u, 7), "lasp_orset")`` included);
+        a tuple whose every element is such a key is a path."""
+        if map_key_type_name(key) is not None:
+            return (key,)
+        if (
+            isinstance(key, tuple)
+            and key
+            and all(map_key_type_name(k) is not None for k in key)
+        ):
+            return tuple(key)
+        return (key,)
+
+    @staticmethod
+    def _nested_field(state, idxs):
+        """The embedded state at a path of field indices — the ONE walk
+        shared by the compaction plan and both reindex appliers."""
+        for f in idxs:
+            state = state.fields[f]
+        return state
+
     def compact_map_plan(self, map_id: str, key, state=None) -> tuple:
-        """Validations + liveness plan for compacting one OR-Set FIELD of
-        a riak_dt_map: ``(field_idx, order, fresh_interner)``. The ONE
+        """Validations + liveness plan for compacting one OR-Set field of
+        a riak_dt_map at ``key`` — a single key or a PATH into nested
+        submaps: ``(field_idxs, shim, order, fresh_interner)``. The ONE
         validation/plan path for the single-store and population tiers —
         a soundness gate added here covers both. ``state`` overrides the
         authoritative map state (the runtime passes a converged row)."""
+        path = self._normalize_map_path(key)
         var = self._vars[map_id]
         if var.type_name != "riak_dt_map":
             raise TypeError(f"compact_map_field: {var.type_name} is not a map")
@@ -968,16 +996,42 @@ class Store:
             raise RuntimeError(
                 f"cannot compact {map_id}: watches hold old-order thresholds"
             )
-        f = var.spec.field_index(key)
-        shim = var.map_aux[f]
+        holder_spec, holder_aux = var.spec, var.map_aux
+        idxs, shim = [], None
+        for depth, k in enumerate(path):
+            f = holder_spec.field_index(k)
+            shim = holder_aux[f]
+            idxs.append(f)
+            if depth < len(path) - 1:
+                if shim.type_name != "riak_dt_map":
+                    raise TypeError(
+                        f"compact_map_field: path element {k!r} is "
+                        f"{shim.type_name}, not a submap"
+                    )
+                holder_spec, holder_aux = shim.spec, shim.map_aux
         if shim.codec.name not in ("lasp_orset", "lasp_orset_gbtree"):
             raise TypeError(
-                f"compact_map_field: field {key!r} is {shim.codec.name}, "
-                "which has no token tombstones"
+                f"compact_map_field: field {path[-1]!r} is "
+                f"{shim.codec.name}, which has no token tombstones"
             )
         authority = var.state if state is None else state
-        order, fresh = self._orset_live_plan(shim.elems, authority.fields[f])
-        return f, order, fresh
+        order, fresh = self._orset_live_plan(
+            shim.elems, self._nested_field(authority, idxs)
+        )
+        return idxs, shim, order, fresh
+
+    @staticmethod
+    def _replace_nested_field(codec, spec, state, idxs, new_leaf):
+        """``set_field`` through a path of field indices (leading batch
+        axes ride along untouched)."""
+        f = idxs[0]
+        if len(idxs) == 1:
+            return codec.set_field(spec, state, f, new_leaf)
+        sub_spec = spec.fields[f][2]
+        new_sub = Store._replace_nested_field(
+            codec, sub_spec, state.fields[f], idxs[1:], new_leaf
+        )
+        return codec.set_field(spec, state, f, new_sub)
 
     def compact_map_field(self, map_id: str, key) -> int:
         """Reclaim element slots (and with them the tombstoned token
@@ -993,13 +1047,14 @@ class Store:
         (:meth:`ReplicatedRuntime.compact_map_field` checks). Returns
         slots reclaimed."""
         var = self._vars[map_id]
-        f, order, fresh = self.compact_map_plan(map_id, key)
-        shim = var.map_aux[f]
+        idxs, shim, order, fresh = self.compact_map_plan(map_id, key)
         reclaimed = len(shim.elems) - len(fresh)
         if reclaimed:
-            var.state = var.codec.set_field(
-                var.spec, var.state,
-                f, self.reindex_orset_state(var.state.fields[f], order),
+            var.state = self._replace_nested_field(
+                var.codec, var.spec, var.state, idxs,
+                self.reindex_orset_state(
+                    self._nested_field(var.state, idxs), order
+                ),
             )
             shim.elems = fresh
         return reclaimed

@@ -822,3 +822,38 @@ def test_nested_map_mesh_convergence_and_checkpoint(tmp_path):
         m, ("update", [("update", KN, ("update", KS, ("add", "post")))]), "w9"
     )
     assert restored.value(m)[KN][KS] == frozenset({"deep", "post"})
+
+
+def test_compact_nested_map_field_path():
+    # nested reset churn pins pools too: compact by PATH into the submap
+    from lasp_tpu.utils.interning import CapacityError
+
+    store = Store(n_actors=4)
+    m = store.declare(type="riak_dt_map", reset_on_readd=True)
+    path = (KN, ("s", "lasp_orset"))
+    for _cycle in range(6):  # default pool is 4 tokens/actor
+        store.update(
+            m, ("update", [("update", KN, ("update", path[1], ("add", "x")))]),
+            "r1",
+        )
+        assert store.value(m)[KN][path[1]] == frozenset({"x"})
+        store.update(m, ("update", [("update", KN, ("remove", path[1]))]), "r1")
+        assert store.compact_map_field(m, path) >= 1
+    # population tier, same path
+    store2 = Store(n_actors=8)
+    m2 = store2.declare(type="riak_dt_map", reset_on_readd=True)
+    rt = ReplicatedRuntime(store2, Graph(store2), 4, ring(4, 2))
+    for cycle in range(3):
+        rt.update_at(
+            0, m2,
+            ("update", [("update", KN, ("update", path[1], ("add", f"v{cycle}")))]),
+            "w0",
+        )
+        rt.run_to_convergence(max_rounds=16)
+        rt.update_at(2, m2, ("update", [("update", KN, ("remove", path[1]))]), "w2")
+        rt.run_to_convergence(max_rounds=16)
+    assert rt.compact_map_field(m2, path) >= 1
+    rt.update_at(1, m2, ("update", [("update", KN, ("update", path[1], ("add", "after")))]), "w1")
+    rt.run_to_convergence(max_rounds=16)
+    assert rt.coverage_value(m2)[KN][path[1]] == frozenset({"after"})
+    assert rt.divergence(m2) == 0
