@@ -1,10 +1,17 @@
 # Top-level conveniences; the native engines build via native/Makefile
 # (tests/conftest.py invokes it automatically).
 
-.PHONY: test bench native bridge-e2e
+.PHONY: test bench native bridge-e2e verify
 
 test:
 	python -m pytest tests/ -q
+
+# lint + fast suite: the metrics-catalog check keeps the telemetry key
+# set (docs/OBSERVABILITY.md) in lock-step with the code, then the
+# non-slow tests run (the tier-1 shape)
+verify:
+	python tools/check_metrics_catalog.py
+	python -m pytest tests/ -q -m 'not slow'
 
 bench:
 	python bench.py

@@ -408,6 +408,19 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["bridge_codec"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- telemetry overhead guard: the always-on registry/span layer must
+    # stay under 5% of the gossip step path (the "cheap enough to always
+    # be on" contract; tests/telemetry/test_overhead.py asserts the same
+    # measurement slow-marked) --------------------------------------------
+    try:
+        from lasp_tpu.telemetry.overhead import measure_overhead
+
+        detail["telemetry_overhead"] = measure_overhead()
+    except Exception as exc:
+        detail["telemetry_overhead"] = {
+            "error": f"{type(exc).__name__}: {exc}"
+        }
+
     _emit(
         {
             "metric": "orset_replica_merges_per_sec_per_chip",

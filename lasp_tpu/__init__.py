@@ -13,7 +13,8 @@ Layer map (mirrors SURVEY.md §1, redesigned per §7):
 - ``lasp_tpu.ops``     — Pallas/packed kernels for the hot merge path
 - ``lasp_tpu.bridge``  — Erlang↔Python backend bridge (north-star, §7.6)
 - ``lasp_tpu.config``  — unified typed configuration (LASP_* env overrides)
-- ``lasp_tpu.utils``   — metrics, interning
+- ``lasp_tpu.telemetry`` — metric registry, spans, Prometheus/JSONL export
+- ``lasp_tpu.utils``   — interning, step-trace facade
 """
 
 __version__ = "0.1.0"
@@ -24,7 +25,7 @@ __version__ = "0.1.0"
 # without paying jax's import cost or risking any backend touch.
 _SUBMODULES = frozenset({
     "api", "bridge", "config", "dataflow", "lattice", "mesh", "ops",
-    "programs", "store", "utils",
+    "programs", "store", "telemetry", "utils",
 })
 _ATTRS = {
     "Session": ("api", "Session"),
@@ -60,5 +61,6 @@ __all__ = [
     "ops",
     "programs",
     "store",
+    "telemetry",
     "__version__",
 ]

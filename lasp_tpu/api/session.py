@@ -35,6 +35,15 @@ from typing import Any, Optional
 from ..dataflow import Graph
 from ..lattice import Threshold
 from ..store import Store, Watch
+from ..telemetry import counter, render_prometheus
+
+
+def _count_verb(verb: str) -> None:
+    counter(
+        "session_ops_total",
+        help="public Lasp verbs dispatched through Session, by verb",
+        verb=verb,
+    ).inc()
 
 
 class Session:
@@ -53,11 +62,13 @@ class Session:
 
     def update(self, id: str, op: tuple, actor) -> None:
         """``lasp:update/3`` (``src/lasp.erl:180-184``)."""
+        _count_verb("update")
         self.store.update(id, op, actor)
         self._maybe_propagate()
 
     def bind(self, id: str, state) -> None:
         """``lasp:bind/2`` (``src/lasp.erl:194-198``)."""
+        _count_verb("bind")
         self.store.bind(id, state)
         self._maybe_propagate()
 
@@ -73,6 +84,7 @@ class Session:
         the default is "whatever is there" (bottom, non-strict) — note the
         reference's ``read/1`` uses ``{strict, undefined}`` for ivars (wait
         for a bind); pass ``Threshold(None, strict=True)`` for that."""
+        _count_verb("read")
         self._maybe_propagate()
         return self.store.read(id, threshold)
 
@@ -87,8 +99,15 @@ class Session:
 
     def value(self, id: str):
         """Decoded observable value (``Type:value/1`` on a quorum read)."""
+        _count_verb("value")
         self._maybe_propagate()
         return self.store.value(id)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the process-global telemetry
+        registry — the in-process twin of the bridge's ``metrics`` verb
+        and ``lasp_tpu metrics`` (docs/OBSERVABILITY.md)."""
+        return render_prometheus()
 
     # -- combinators ---------------------------------------------------------
     def map(self, src: str, fn, dst: Optional[str] = None) -> str:

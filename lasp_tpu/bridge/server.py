@@ -29,6 +29,7 @@ request                                             response
 ``{merge_batch, [{Id, State}, ...]}``               ``{ok, Count}``  (the batched anti-entropy RPC)
 ``{read, Id}``                                      ``{ok, Value}``
 ``{keys}``                                          ``{ok, [Id...]}``
+``{metrics}``                                       ``{ok, PromTextBin}`` (telemetry scrape: Prometheus text exposition of the process registry; allowed before ``start``)
 ==================================================  =========================
 
 Portable CRDT state encodings (id/elem/actor terms are arbitrary ETF
@@ -69,10 +70,19 @@ from typing import Any, Optional
 import numpy as np
 
 from ..store import Store
+from ..telemetry import counter, histogram, render_prometheus, span
+from ..utils.metrics import Timer
 from . import etf
 from .etf import Atom
 
 _HDR = struct.Struct(">I")
+
+#: label clamp for per-verb metrics: arbitrary client garbage must not
+#: mint unbounded label cardinality in the registry
+_METRIC_VERBS = frozenset({
+    "start", "declare", "put", "get", "update", "bind", "merge_batch",
+    "read", "keys", "metrics",
+})
 
 #: declare caps accepted over the wire, per type (mirrors store.ALLOWED_CAPS)
 _CAP_KEYS = ("n_elems", "n_actors", "tokens_per_actor")
@@ -739,8 +749,14 @@ class _Conn:
         if ids != self._manifest["var_ids"]:
             self._manifest["var_ids"] = ids
             self._hs.put("manifest", pickle.dumps(self._manifest))
+        # counters-record schema (STABLE across PRs): {"schema": 1,
+        # "metrics": <CounterGroup.snapshot(): binds / inflations /
+        # ignored_binds / reads>, "mutations": int} — the typed registry
+        # snapshot replaces the old untyped dict(store.metrics) payload;
+        # readers (checkpoint.load_store) .get() the keys, so pre-schema
+        # records load identically
         self._hs.put("counters", pickle.dumps(
-            {"metrics": dict(self.store.metrics),
+            {"schema": 1, "metrics": self.store.metrics.snapshot(),
              "mutations": self.store.mutations}
         ))
         self._writes += 1
@@ -787,6 +803,12 @@ class _Conn:
             self._release()
             self.store = Store(n_actors=self.n_actors)
             return (etf.OK, req[1] if len(req) > 1 else Atom("store"))
+        if verb == "metrics":
+            # scrape surface for the BEAM side (and any frame-speaking
+            # client): the process-global registry as Prometheus text.
+            # Deliberately allowed BEFORE {start, Name} — scraping must
+            # never require claiming a store
+            return (etf.OK, render_prometheus().encode())
         if self.store is None:
             return (etf.ERROR, Atom("not_started"), b"send {start, Name} first")
         try:
@@ -933,8 +955,45 @@ class BridgeServer:
                         break
                     try:
                         req = etf.decode(frame)
-                        resp = state.handle(req)
+                        raw_verb = (
+                            str(req[0])
+                            if isinstance(req, tuple) and req
+                            else "malformed"
+                        )
+                        verb = (
+                            raw_verb if raw_verb in _METRIC_VERBS else "other"
+                        )
+                        with span(f"bridge.{verb}"):
+                            with Timer() as t:
+                                resp = state.handle(req)
+                        counter(
+                            "bridge_requests_total",
+                            help="bridge protocol requests served, by verb",
+                            verb=verb,
+                        ).inc()
+                        histogram(
+                            "bridge_request_seconds",
+                            help="bridge request handling wall time, by verb",
+                            verb=verb,
+                        ).observe(t.elapsed)
+                        if (
+                            isinstance(resp, tuple)
+                            and resp
+                            and resp[0] == etf.ERROR
+                        ):
+                            counter(
+                                "bridge_errors_total",
+                                help="bridge requests answered with an "
+                                     "error term, by verb",
+                                verb=verb,
+                            ).inc()
                     except etf.ETFDecodeError as e:
+                        counter(
+                            "bridge_errors_total",
+                            help="bridge requests answered with an error "
+                                 "term, by verb",
+                            verb="etf_decode",
+                        ).inc()
                         resp = (etf.ERROR, Atom("etf_decode"), str(e).encode())
                     try:
                         _send_frame(sock, etf.encode(resp))
@@ -1023,6 +1082,11 @@ class BridgeClient:
 
     def read(self, var_id):
         return self.call((Atom("read"), var_id))
+
+    def metrics(self):
+        """``{metrics}`` -> ``{ok, <Prometheus text binary>}`` — the
+        scrape verb (works before ``start``)."""
+        return self.call((Atom("metrics"),))
 
     def close(self) -> None:
         self._sock.close()

@@ -2,8 +2,10 @@
 ``lasp_console``, SURVEY.md §1/§2.7). Cluster-admin verbs map to their
 simulation equivalents: ``status`` (ringready/member-status) reports
 devices and convergence state; ``simulate`` runs a gossip population to
-its fixed point; ``bench`` runs the BASELINE scenarios; ``inspect``
-lists a checkpoint's contents.
+its fixed point; ``bench`` runs the BASELINE scenarios; ``metrics``
+prints a telemetry snapshot (Prometheus text + optional JSONL; the
+riak-admin ``status``/``stat`` role — see docs/OBSERVABILITY.md);
+``inspect`` lists a checkpoint's contents.
 
 Usage: ``python -m lasp_tpu.cli <verb> [options]``
 """
@@ -133,6 +135,96 @@ def cmd_bridge(args) -> int:
     return 0
 
 
+def _metrics_workload(n_replicas: int) -> None:
+    """The built-in observability smoke workload: a small replicated
+    gossip run that exercises every instrumented layer — per-type merges
+    (orset / orswot / gcounter client writes), a dataflow edge (map), a
+    gossip population run to quiescence, and a loopback bridge exchange —
+    so a bare ``lasp_tpu metrics`` emits a representative snapshot
+    without needing a live system to scrape."""
+    from lasp_tpu.bridge import BridgeClient, BridgeServer
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+    from lasp_tpu.telemetry import span
+
+    with span("cli.metrics_workload", replicas=n_replicas):
+        store = Store(n_actors=8)
+        ads = store.declare(type="lasp_orset", n_elems=16)
+        hits = store.declare(type="riak_dt_gcounter")
+        tags = store.declare(type="riak_dt_orswot", n_elems=16)
+        graph = Graph(store)
+        graph.map(ads, lambda x: ("seen", x))
+        rt = ReplicatedRuntime(
+            store, graph, n_replicas, ring(n_replicas, min(2, n_replicas - 1))
+        )
+        for r in range(n_replicas):
+            rt.update_at(r % n_replicas, ads, ("add", f"ad{r}"), f"w{r}")
+            rt.update_at(r % n_replicas, hits, ("increment",), f"w{r}")
+            rt.update_at(r % n_replicas, tags, ("add", f"t{r}"), f"w{r}")
+        rt.run_to_convergence(max_rounds=64)
+        # loopback bridge exchange: verbs land in the same process
+        # registry the snapshot reads
+        from lasp_tpu.bridge.etf import Atom
+
+        with BridgeServer(port=0) as server:
+            with BridgeClient("127.0.0.1", server.port) as c:
+                c.start("metrics_demo")
+                c.declare(b"v", "lasp_gset", n_elems=8)
+                c.update(b"v", (Atom("add"), b"x"), b"w")
+                c.read(b"v")
+                c.metrics()
+
+
+def cmd_metrics(args) -> int:
+    """Telemetry snapshot console (the riak-admin status role for the
+    metrics subsystem): Prometheus text to stdout, optional JSONL event
+    dump, optional watch loop, optional live-bridge scrape."""
+    import time
+
+    from lasp_tpu import telemetry
+
+    def emit() -> None:
+        if args.bridge:
+            from lasp_tpu.bridge import BridgeClient
+
+            host, _, port = args.bridge.rpartition(":")
+            with BridgeClient(host or "127.0.0.1", int(port)) as c:
+                resp = c.metrics()
+            if not (isinstance(resp, tuple) and len(resp) == 2):
+                raise RuntimeError(f"bridge metrics verb failed: {resp!r}")
+            sys.stdout.write(
+                resp[1].decode() if isinstance(resp[1], bytes) else str(resp[1])
+            )
+        else:
+            sys.stdout.write(telemetry.render_prometheus())
+        if args.jsonl:
+            telemetry.dump_jsonl(sys.stdout)
+        sys.stdout.flush()
+
+    if not args.bridge:
+        if args.replicas < 2:
+            print(
+                f"error: --replicas must be >= 2 (a {args.replicas}-replica "
+                "population has no gossip edges to observe)",
+                file=sys.stderr,
+            )
+            return 2
+        _metrics_workload(args.replicas)
+    if args.watch:
+        try:
+            while True:
+                emit()
+                print(f"--- watch: next snapshot in {args.watch}s ---")
+                time.sleep(args.watch)
+                if not args.bridge:
+                    _metrics_workload(args.replicas)
+        except KeyboardInterrupt:
+            return 0
+    emit()
+    return 0
+
+
 def cmd_inspect(args) -> int:
     from lasp_tpu.store import HostStore
     from lasp_tpu.store.checkpoint import loads_manifest
@@ -220,6 +312,23 @@ def main(argv=None) -> int:
     scen.add_argument("--replicas", type=int, default=0,
                       help="override the population for sized scenarios")
 
+    met = sub.add_parser(
+        "metrics",
+        help="telemetry snapshot: Prometheus text (+ JSONL events); "
+             "runs a 2-replica gossip workload unless --bridge scrapes "
+             "a live server",
+    )
+    met.add_argument("--replicas", type=int, default=2,
+                     help="population of the built-in workload")
+    met.add_argument("--jsonl", action="store_true",
+                     help="also dump span + metric events as JSONL")
+    met.add_argument("--watch", type=float, default=0,
+                     metavar="SECONDS",
+                     help="re-emit every SECONDS until interrupted")
+    met.add_argument("--bridge", default=None, metavar="HOST:PORT",
+                     help="scrape a live bridge's {metrics} verb instead "
+                          "of running the built-in workload")
+
     ins = sub.add_parser("inspect", help="list a checkpoint's contents")
     ins.add_argument("path")
 
@@ -237,6 +346,7 @@ def main(argv=None) -> int:
         "simulate": cmd_simulate,
         "bench": cmd_bench,
         "scenario": cmd_scenario,
+        "metrics": cmd_metrics,
         "inspect": cmd_inspect,
         "bridge": cmd_bridge,
     }[args.verb](args)

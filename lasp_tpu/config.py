@@ -72,6 +72,9 @@ class LaspConfig:
             "LASP_BENCH_CHILD_BUDGET",
             "LASP_DRYRUN",
             "LASP_STATEM",  # test-suite soak depth (tests/lattice)
+            "LASP_TELEMETRY",  # telemetry sinks (JSONL path etc.),
+            # read directly by lasp_tpu.telemetry.spans
+
             "LASP_WATCH",  # tools/tpu_capture.py watcher knobs
             "LASP_ONESHOT",  # tools/tpu_oneshot.py capture knobs
         )

@@ -66,6 +66,9 @@ class Edge:
     #: variable ids read / written
     srcs: tuple = ()
     dst: str = ""
+    #: combinator kind — the telemetry label of
+    #: dataflow_edge_recomputes_total / dataflow_edge_refreshes_total
+    kind: str = "edge"
     #: device-array cache of the host tables; invalidated when refresh()
     #: actually changes something, so the steady state (no new terms) pays
     #: no host->device upload per propagate
@@ -78,6 +81,13 @@ class Edge:
         changed = self._refresh(store)
         if changed:
             self._tables_cache = None
+            from ..telemetry import counter
+
+            counter(
+                "dataflow_edge_refreshes_total",
+                help="edge table rebuilds after interner growth, by kind",
+                kind=self.kind,
+            ).inc()
         return changed
 
     def _refresh(self, store) -> bool:
@@ -284,6 +294,7 @@ class ProductEdge(Edge):
     token (tl, tr) at tl*TR + tr — pure index arithmetic, no host tables."""
 
     def __init__(self, left: str, right: str, dst: str, store):
+        self.kind = "product"
         self.srcs = (left, right)
         self.dst = dst
         l_var, r_var = store.variable(left), store.variable(right)
@@ -315,6 +326,7 @@ class BindToEdge(Edge):
     """Identity link (``src/lasp_core.erl:434-446``): dst follows src."""
 
     def __init__(self, src: str, dst: str, store):
+        self.kind = "bind_to"
         self.srcs = (src,)
         self.dst = dst
         src_var, dst_var = store.variable(src), store.variable(dst)

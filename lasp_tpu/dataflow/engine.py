@@ -322,6 +322,9 @@ class Graph:
             return 0
         if self._clean_mark == (self.store.mutations, len(self.edges)):
             return 0  # nothing written since the last fixed point
+        from ..telemetry import counter, histogram, span
+        from ..utils.metrics import Timer
+
         self.refresh()
         if self._jitted is None:
             self._build()
@@ -329,16 +332,45 @@ class Graph:
         states = {v: self.store.state(v) for v in self._var_ids}
         limit = max_rounds if max_rounds is not None else len(self.edges) + 1
         rounds = 0
-        for _ in range(limit):
-            states, residual = self._jitted(states, tables)
-            if int(residual) == 0:
-                break
-            rounds += 1
-        else:
-            raise RuntimeError(
-                f"dataflow did not converge within {limit} rounds "
-                "(cyclic graph? raise max_rounds)"
-            )
+        executed = 0  # jitted sweeps issued (incl. the final quiescent one)
+        try:
+            with span("dataflow.propagate", edges=len(self.edges)):
+                with Timer() as t:
+                    for _ in range(limit):
+                        states, residual = self._jitted(states, tables)
+                        executed += 1
+                        if int(residual) == 0:
+                            break
+                        rounds += 1
+                    else:
+                        raise RuntimeError(
+                            f"dataflow did not converge within {limit} "
+                            "rounds (cyclic graph? raise max_rounds)"
+                        )
+        finally:
+            # emissions land for the non-convergence raise too — a
+            # runaway propagate is exactly what an operator scrapes for
+            counter(
+                "dataflow_rounds_total",
+                help="jitted dataflow sweeps executed",
+            ).inc(executed)
+            histogram(
+                "dataflow_propagate_seconds",
+                help="wall time of a propagate-to-fixpoint run",
+            ).observe(t.elapsed)
+            # every sweep re-evaluates every edge's contribution (Jacobi
+            # iteration) — the per-edge recompute count, by combinator
+            # kind
+            by_kind: dict = {}
+            for e in self.edges:
+                by_kind[e.kind] = by_kind.get(e.kind, 0) + executed
+            for kind, n in by_kind.items():
+                counter(
+                    "dataflow_edge_recomputes_total",
+                    help="edge contribution evaluations, by combinator "
+                         "kind",
+                    kind=kind,
+                ).inc(n)
         pre_ingest = self.store.mutations
         writes = self.store.ingest(states)
         if self.store.mutations == pre_ingest + writes:

@@ -159,3 +159,25 @@ def divergence(codec, spec, states) -> jax.Array:
     top = join_all(codec, spec, states)
     eq = jax.vmap(lambda s: codec.equal(spec, s, top))(states)
     return jnp.sum(~eq)
+
+
+def round_traffic_bytes(states, fanout: int) -> int:
+    """Host-side estimate of the bytes ONE pull-gossip round moves: every
+    replica gathers ``fanout`` neighbor rows of every variable, so the
+    whole population's state crosses HBM/ICI ``fanout`` times per round
+    (the Tascade-style reduction-traffic accounting; DrJAX's per-round
+    communication-cost visibility). Reads only leaf shape/dtype metadata
+    — never pulls device (possibly multi-host-sharded) buffers — so it
+    is safe to call on any live population. Feeds the
+    ``gossip_bytes_exchanged_total`` counter (docs/OBSERVABILITY.md)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(states):
+        dt = getattr(leaf, "dtype", None)
+        size = getattr(leaf, "size", None)
+        if dt is None or size is None:
+            import numpy as np
+
+            arr = np.asarray(leaf)
+            dt, size = arr.dtype, arr.size
+        total += int(size) * int(dt.itemsize)
+    return total * int(fanout)

@@ -33,6 +33,7 @@ import numpy as np
 
 from ..lattice.base import Threshold, replicate
 from ..ops.flatpack import FlatORSet, FlatORSetSpec
+from ..telemetry import counter, histogram, span
 from ..utils.metrics import StepTrace, Timer
 from .gossip import (
     divergence,
@@ -40,6 +41,7 @@ from .gossip import (
     gossip_round_shift,
     join_all,
     quorum_read,
+    round_traffic_bytes,
 )
 from .topology import shift_offsets
 
@@ -175,6 +177,11 @@ class ReplicatedRuntime:
         self._fused_steps_cache: dict[int, object] = {}
         self._n_edges = -1
         self.trace = StepTrace()
+        #: per-round wire estimate (bytes), refreshed by _ensure_step
+        self._round_traffic = 0
+        #: cached hot-path instruments: (registry generation, var_ids,
+        #: edge-kind tuple, dict) — see _instruments()
+        self._tel_cache: "tuple | None" = None
         self._sync_graph()
 
     def _sync_graph(self) -> None:
@@ -397,6 +404,7 @@ class ReplicatedRuntime:
         for key in keys:
             prev = self._actor_sites.get(key)
             if prev is not None and prev != int(replica):
+                self._count_guard_rejection()
                 if prev < 0:
                     raise ActorCollisionError(
                         f"actor {actor!r} departed with a crashed row "
@@ -418,6 +426,13 @@ class ReplicatedRuntime:
     def _guard_actor_commit(self, keys, replica: int) -> None:
         for key in keys:
             self._actor_sites.setdefault(key, int(replica))
+
+    @staticmethod
+    def _count_guard_rejection() -> None:
+        counter(
+            "actor_guard_rejections_total",
+            help="writes refused by the debug_actors collision guard",
+        ).inc()
 
     @staticmethod
     def _op_mints_lane(var, op: tuple) -> bool:
@@ -486,7 +501,14 @@ class ReplicatedRuntime:
         )
         row = self._to_dense_row(var_id, wire_row)
         candidate = self.store._apply_op(var, row, op, actor)
-        merged = var.codec.merge(var.spec, row, candidate)
+        with span(f"merge.{var.type_name}"):
+            with Timer() as mt:
+                merged = var.codec.merge(var.spec, row, candidate)
+        histogram(
+            "merge_seconds",
+            help="host-path CRDT merge wall time by type",
+            type=var.type_name,
+        ).observe(mt.elapsed)
         if bool(var.codec.is_inflation(var.spec, row, merged)):
             new_row = self._from_dense_row(var_id, merged)
             if guarded:
@@ -576,6 +598,7 @@ class ReplicatedRuntime:
                     if prev is None:
                         staged[key] = int(r)
                     elif prev != int(r):
+                        self._count_guard_rejection()
                         raise ActorCollisionError(
                             f"update_batch({var_id!r}): actor {actor!r} "
                             + ("departed with a crashed row and may "
@@ -600,13 +623,28 @@ class ReplicatedRuntime:
                 if self._op_mints_lane(var, op)
             ]
         dispatch_exc = None
+        bt = Timer()
+        bt.__enter__()
         try:
             if ops:
-                self._dispatch_batch(var, tn, states, ops)
+                with span("mesh.update_batch", type=tn, ops=len(ops)):
+                    self._dispatch_batch(var, tn, states, ops)
         except BaseException as exc:
             dispatch_exc = exc
             raise
         finally:
+            bt.__exit__()
+            # timings land for failed dispatches too (a slow failing batch
+            # is exactly what an operator is hunting)
+            histogram(
+                "update_batch_seconds",
+                help="batched client-op dispatch wall time by type",
+                type=tn,
+            ).observe(bt.elapsed)
+            counter(
+                "update_batch_ops_total",
+                help="client ops submitted through update_batch",
+            ).inc(len(ops))
             # a mid-batch CapacityError/PreconditionError persists the ops
             # before the failure (sequential semantics) — their interned
             # terms must still fold into the edge tables, or a caller that
@@ -1454,6 +1492,7 @@ class ReplicatedRuntime:
                         "— don't pass another table"
                     )
             prev = states
+            var_order = self.var_ids  # residual-vector order (telemetry)
             if edges or triggers:
 
                 def local_round(s_all):
@@ -1489,7 +1528,7 @@ class ReplicatedRuntime:
                 swept = jax.vmap(local_round)(dict(states))
                 states = swept
             out = {}
-            residual = jnp.zeros((), dtype=jnp.int32)
+            residual_per_var = []
             for v in self.var_ids:
                 codec, spec = meta[v]
                 if part_rounds is not None:
@@ -1521,8 +1560,19 @@ class ReplicatedRuntime:
                         _spec, a, b
                     )
                 )(prev[v], new)
-                residual += jnp.sum(changed.astype(jnp.int32))
+                residual_per_var.append(jnp.sum(changed.astype(jnp.int32)))
                 out[v] = new
+            # PER-VAR residual vector (order = self.var_ids): the host
+            # step() syncs it anyway (one transfer either way) and the
+            # telemetry layer turns it into gossip_residual{var=...}
+            # gauges — "which variable is still diverging" for free.
+            # Consumers wanting the old scalar sum it (fused/while paths
+            # below do exactly that inside their own traces).
+            residual = (
+                jnp.stack(residual_per_var)
+                if residual_per_var
+                else jnp.zeros((len(var_order),), dtype=jnp.int32)
+            )
             return out, residual
 
         # un-jitted; __graft_entry__ re-jits with shardings. CAVEAT for
@@ -1573,18 +1623,21 @@ class ReplicatedRuntime:
 
     def _run_step_fn(self, fn, edge_mask, tables, *extra):
         """Dispatch a (possibly donating) compiled step and SYNC on its
-        scalar result inside the guarded region — jax dispatch is
-        asynchronous, so a device-side failure (OOM mid-block) surfaces at
-        the blocking ``int()``, not at the call. Returns
-        ``(new_states, scalar: int)``. On failure, the runtime is marked
-        poisoned only if donation actually consumed the input buffers
-        (trace/compile-time errors leave state intact and recoverable)."""
+        result inside the guarded region — jax dispatch is asynchronous,
+        so a device-side failure (OOM mid-block) surfaces at the blocking
+        host transfer, not at the call. Returns ``(new_states, result:
+        np.ndarray)`` — a scalar for the fused/while entry points, the
+        per-var residual vector for the plain step. On failure, the
+        runtime is marked poisoned only if donation actually consumed the
+        input buffers (trace/compile-time errors leave state intact and
+        recoverable)."""
         states_in = self.states  # property read: raises if already poisoned
         try:
             new_states, scalar = fn(
                 states_in, self.neighbors, edge_mask, tables, *extra
             )
-            return new_states, int(scalar)  # device sync: errors land here
+            # device sync: errors land here
+            return new_states, np.asarray(scalar)
         except Exception as exc:
             if self._donate_argnums() and any(
                 getattr(leaf, "is_deleted", lambda: False)()
@@ -1610,20 +1663,126 @@ class ReplicatedRuntime:
             # ride as TRACED operands, not executable constants
             tables = tables + ((self._partition["send_idx"],
                                 self._partition["idx"]),)
+        # per-round wire estimate for gossip_bytes_exchanged_total:
+        # metadata-only walk (shape/dtype), recomputed here because state
+        # shapes only change where _ensure_step already runs
+        fan = (
+            int(self._host_neighbors.shape[1])
+            if self._host_neighbors.ndim == 2
+            else 0
+        )
+        self._round_traffic = round_traffic_bytes(self._states, fan)
         return tables
+
+    def _instruments(self) -> "dict | None":
+        """Hot-path instrument cache (None when telemetry is disabled):
+        the per-round emissions run on every step dispatch, so the
+        name+label registry lookups are resolved ONCE and keyed on the
+        registry generation (a test-time ``telemetry.reset()`` detaches
+        instruments; the generation bump makes this cache re-fetch
+        instead of incrementing into the void), the var set, and the
+        edge-kind census."""
+        from ..telemetry import registry as _reg
+
+        if not _reg.enabled():
+            return None
+        gen = _reg.generation()
+        kinds = tuple(e.kind for e in self.graph.edges)
+        cache = self._tel_cache
+        if (
+            cache is not None
+            and cache[0] == gen
+            and cache[1] == self.var_ids
+            and cache[2] == kinds
+        ):
+            return cache[3]
+        reg = _reg.get_registry()
+        by_kind: dict = {}
+        for k in kinds:
+            by_kind[k] = by_kind.get(k, 0) + 1
+        inst = {
+            "rounds": reg.counter(
+                "gossip_rounds_total", help="gossip rounds executed"
+            ),
+            "bytes": reg.counter(
+                "gossip_bytes_exchanged_total",
+                help="estimated bytes moved by gossip gathers (see "
+                     "gossip.round_traffic_bytes)",
+            ),
+            "round_seconds": reg.histogram(
+                "gossip_round_seconds",
+                help="wall time per unfused gossip round",
+            ),
+            "residual": [
+                reg.gauge(
+                    "gossip_residual",
+                    help="replicas whose state the last round changed, "
+                         "per var",
+                    var=v,
+                )
+                for v in self.var_ids
+            ],
+            # the engine sweep inside each step re-evaluates every
+            # edge's contribution once per round (same Jacobi accounting
+            # as Graph.propagate's host loop): (counter, edges-of-kind)
+            "edge_recomputes": [
+                (
+                    reg.counter(
+                        "dataflow_edge_recomputes_total",
+                        help="edge contribution evaluations, by "
+                             "combinator kind",
+                        kind=k,
+                    ),
+                    cnt,
+                )
+                for k, cnt in by_kind.items()
+            ],
+        }
+        self._tel_cache = (gen, self.var_ids, kinds, inst)
+        return inst
+
+    def _record_rounds(self, n: int) -> None:
+        """Registry bookkeeping for ``n`` executed gossip rounds — the
+        one emission point for every stepping entry (plain, fused,
+        on-device while)."""
+        tel = self._instruments()
+        if tel is None:
+            return
+        tel["rounds"].inc(n)
+        tel["bytes"].inc(self._round_traffic * n)
+        for c, edges_of_kind in tel["edge_recomputes"]:
+            c.inc(n * edges_of_kind)
 
     def step(self, edge_mask=None) -> int:
         """One bulk-synchronous round: local dataflow sweep + gossip.
         Returns the number of (replica, variable) states the step CHANGED
         (0 on the final, quiescent round)."""
         tables = self._ensure_step()
-        with Timer() as t:
-            # _run_step_fn syncs on the residual, closing the timing window
-            self.states, residual = self._run_step_fn(
-                self._step, edge_mask, tables
-            )
-        self.trace.record_round(residual, t.elapsed)
+        with span("gossip.round", annotate=True):
+            with Timer() as t:
+                # _run_step_fn syncs on the residual vector, closing the
+                # timing window
+                self.states, res_vec = self._run_step_fn(
+                    self._step, edge_mask, tables
+                )
+        residual = int(res_vec.sum())
+        self._emit_step_telemetry(res_vec, residual, t.elapsed)
         return residual
+
+    def _emit_step_telemetry(self, res_vec, residual: int,
+                             elapsed: float) -> None:
+        """The WHOLE per-step host-side telemetry emission, factored out
+        so the overhead guard (telemetry.overhead) can time exactly this
+        code path in isolation — the trace row always records (summary
+        correctness does not depend on the registry switch); registry
+        emissions no-op when disabled."""
+        self.trace.record_round(residual, elapsed)
+        self._record_rounds(1)
+        tel = self._instruments()
+        if tel is not None:
+            tel["round_seconds"].observe(elapsed)
+            for g, r in zip(tel["residual"], res_vec.tolist()):
+                g.set(int(r))
 
     def fused_steps(self, block: int, edge_mask=None) -> int:
         """Run ``block`` FULL steps (dataflow sweep + triggers + gossip +
@@ -1647,7 +1806,8 @@ class ReplicatedRuntime:
             def fused(states, neighbors, mask, tables):
                 def body(i, carry):
                     s, first_zero = carry
-                    out, residual = step(s, neighbors, mask, tables)
+                    out, res_vec = step(s, neighbors, mask, tables)
+                    residual = jnp.sum(res_vec)
                     first_zero = jnp.where(
                         (first_zero < 0) & (residual == 0), i, first_zero
                     )
@@ -1659,12 +1819,16 @@ class ReplicatedRuntime:
 
             fn = jax.jit(fused, donate_argnums=self._donate_argnums())
             self._fused_steps_cache[block] = fn
-        with Timer() as t:
-            # _run_step_fn syncs on first_zero, closing the timing window
-            self.states, first_zero = self._run_step_fn(
-                fn, edge_mask, tables
-            )
+        with span("gossip.round", annotate=True, block=block):
+            with Timer() as t:
+                # _run_step_fn syncs on first_zero, closing the timing
+                # window
+                self.states, first_zero = self._run_step_fn(
+                    fn, edge_mask, tables
+                )
+        first_zero = int(first_zero)
         self.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
+        self._record_rounds(block)  # fori always executes the whole block
         return first_zero
 
     def run_to_convergence(
@@ -1681,13 +1845,22 @@ class ReplicatedRuntime:
                 b = min(block, max_rounds - rounds)  # never overshoot
                 first_zero = self.fused_steps(b, edge_mask)
                 if first_zero >= 0:
-                    return rounds + first_zero + 1
+                    return self._record_quiescence(rounds + first_zero + 1)
                 rounds += b
             raise RuntimeError(f"no convergence within {max_rounds} rounds")
         for i in range(max_rounds):
             if self.step(edge_mask) == 0:
-                return i + 1
+                return self._record_quiescence(i + 1)
         raise RuntimeError(f"no convergence within {max_rounds} rounds")
+
+    @staticmethod
+    def _record_quiescence(rounds: int) -> int:
+        histogram(
+            "gossip_rounds_to_quiescence",
+            help="rounds a convergence run took to reach the fixed point",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 1024),
+        ).observe(rounds)
+        return rounds
 
     def converge_on_device(
         self, max_rounds: int = 10_000, edge_mask=None, strict: bool = True
@@ -1724,8 +1897,8 @@ class ReplicatedRuntime:
 
                 def body(carry):
                     s, rounds, _residual = carry
-                    out, residual = step(s, neighbors, mask, tables)
-                    return out, rounds + 1, residual
+                    out, res_vec = step(s, neighbors, mask, tables)
+                    return out, rounds + 1, jnp.sum(res_vec)
 
                 # seed residual=1 so the first round always runs; the
                 # count includes the final quiescent round, exactly like
@@ -1737,13 +1910,18 @@ class ReplicatedRuntime:
 
             fn = jax.jit(converge, donate_argnums=self._donate_argnums())
             self._fused_steps_cache["while"] = fn
-        with Timer() as t:
-            self.states, signed_rounds = self._run_step_fn(
-                fn, edge_mask, tables, jnp.int32(max_rounds)
-            )
+        with span("gossip.converge", annotate=True):
+            with Timer() as t:
+                self.states, signed_rounds = self._run_step_fn(
+                    fn, edge_mask, tables, jnp.int32(max_rounds)
+                )
+        signed_rounds = int(signed_rounds)
         # 0 = reached the fixed point; -1 = budget ran out unconverged
         # (the same convention fused_steps' trace rows use)
         self.trace.record_round(0 if signed_rounds > 0 else -1, t.elapsed)
+        self._record_rounds(abs(signed_rounds))
+        if signed_rounds > 0:
+            self._record_quiescence(signed_rounds)
         if signed_rounds < 0 and strict:
             raise RuntimeError(
                 f"no convergence within {-signed_rounds} rounds"
@@ -1830,6 +2008,7 @@ class ReplicatedRuntime:
                     if prev is None:
                         staged[key] = int(row)
                     elif prev != int(row):
+                        self._count_guard_rejection()
                         raise ActorCollisionError(
                             f"seed_increments({var_id!r}): lane {lane} "
                             f"written from replicas {prev} and {int(row)}"
@@ -2107,8 +2286,8 @@ class ReplicatedRuntime:
 
                 def body(carry):
                     s, rounds, _residual = carry
-                    out, residual = step(s, neighbors, mask, tables)
-                    return out, rounds + 1, residual
+                    out, res_vec = step(s, neighbors, mask, tables)
+                    return out, rounds + 1, jnp.sum(res_vec)
 
                 out, rounds, residual = jax.lax.while_loop(
                     cond, body, (states, jnp.int32(0), jnp.int32(1))
@@ -2130,9 +2309,11 @@ class ReplicatedRuntime:
                 jnp.int32(max_rounds),
                 tuple(thr.state for _v, thr in resolved),
             )
+        packed = int(packed)
         which = packed % n_reads
         rounds, code = (packed // n_reads) // 4, (packed // n_reads) % 4
         self.trace.record_round(0 if code == 0 else -1, t.elapsed)
+        self._record_rounds(rounds)
         verb = "read_until" if n_reads == 1 else "read_any_until"
         if code == 0:
             var_id, thr = resolved[which]

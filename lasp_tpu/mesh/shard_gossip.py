@@ -235,6 +235,19 @@ def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
     }
     stats["m2"] = m2
     stats["alltoall_rows_per_round"] = n_shards * m2
+    # the cut IS the wire cost of the boundary exchange — surface it as
+    # gauges so an operator sees a bad (non-locality-ordered) renumbering
+    # in a scrape instead of in the ICI profile
+    from ..telemetry import gauge
+
+    gauge(
+        "gossip_partition_cut_rows",
+        help="distinct rows some other shard references (the cut)",
+    ).set(stats["send_rows"])
+    gauge(
+        "gossip_partition_cross_edges",
+        help="neighbor-table edges crossing a shard boundary",
+    ).set(stats["cross_edges"])
     return {
         "send_idx": send_idx.astype(np.int32),
         "idx": idx.astype(np.int32),

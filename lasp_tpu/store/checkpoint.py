@@ -191,8 +191,14 @@ def save_store(store: Store, path: str) -> None:
             entry = _var_manifest(var)
             _put_state(hs, var_id, var.state, entry)
             hs.put(_varmeta_key(var_id), pickle.dumps(entry))
+        # counters-record schema (STABLE across PRs — the bridge's durable
+        # stores and every saved checkpoint parse it): {"schema": 1,
+        # "metrics": <CounterGroup.snapshot(): binds / inflations /
+        # ignored_binds / reads>, "mutations": int}. Readers use .get so
+        # pre-schema records (no "schema" key) load identically.
         hs.put("counters", pickle.dumps(
-            {"metrics": dict(store.metrics), "mutations": store.mutations}
+            {"schema": 1, "metrics": store.metrics.snapshot(),
+             "mutations": store.mutations}
         ))
         hs.put("manifest", pickle.dumps(header))
 

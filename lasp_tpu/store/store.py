@@ -44,7 +44,9 @@ from ..lattice import (
     Threshold,
     get_type,
 )
+from ..telemetry.registry import CounterGroup, counter, histogram
 from ..utils.interning import Interner
+from ..utils.metrics import Timer
 
 DEFAULT_SPECS = {
     "lasp_ivar": lambda **kw: IVarSpec(),
@@ -257,7 +259,12 @@ class Store:
             n_actors if n_actors is not None else get_config().n_actors
         )
         self._id_counter = itertools.count()
-        self.metrics = {"binds": 0, "inflations": 0, "ignored_binds": 0, "reads": 0}
+        #: typed fixed-key counters (telemetry.CounterGroup): same mapping
+        #: surface as the old ad-hoc dict (persistence round-trips
+        #: unchanged), but unknown keys and non-monotone garbage are loud
+        self.metrics = CounterGroup(
+            ("binds", "inflations", "ignored_binds", "reads")
+        )
         #: bumped on every effective write; lets the dataflow engine skip
         #: propagation when nothing changed since its last fixed point
         self.mutations = 0
@@ -689,15 +696,29 @@ class Store:
         """Merge + inflation gate + write (``src/lasp_core.erl:291-312``)."""
         var = self._vars[id]
         self.metrics["binds"] += 1
+        counter("store_binds_total", help="bind verbs dispatched").inc()
         if bool(var.codec.equal(var.spec, var.state, state)):
             return var.state
-        merged = var.codec.merge(var.spec, var.state, state)
+        with Timer() as t:
+            merged = var.codec.merge(var.spec, var.state, state)
+        histogram(
+            "merge_seconds",
+            help="host-path CRDT merge wall time by type",
+            type=var.type_name,
+        ).observe(t.elapsed)
         if bool(var.codec.is_inflation(var.spec, var.state, merged)):
             self.metrics["inflations"] += 1
+            counter(
+                "store_inflations_total", help="binds that inflated"
+            ).inc()
             self._write(var, merged)
         else:
             # non-inflation silently ignored (src/lasp_core.erl:305-311)
             self.metrics["ignored_binds"] += 1
+            counter(
+                "store_ignored_binds_total",
+                help="binds ignored by the inflation gate",
+            ).inc()
         return var.state
 
     def bind_raw(self, id: str, state) -> Any:
@@ -766,6 +787,7 @@ class Store:
         (:348-349, fire rule per reply_to_all :795-813)."""
         var = self._vars[id]
         self.metrics["reads"] += 1
+        counter("store_reads_total", help="threshold reads issued").inc()
         thr = self._resolve_threshold(var, threshold)
         self._offer_to_lazy(var, thr)
         watch = Watch("read", id, thr)

@@ -1,0 +1,72 @@
+"""Unified telemetry: metric registry, span tracing, Prometheus/JSONL
+export (SURVEY.md §5 auxiliary subsystems, rebuilt as a first-class
+layer).
+
+One import surface for every emitter and consumer:
+
+- ``counter/gauge/histogram`` — typed instruments on the process-global
+  registry (see :mod:`.registry`); ``set_enabled(False)`` flips them to
+  no-ops (the bench overhead guard's off arm).
+- ``span`` — nested timing regions into a bounded ring + optional JSONL
+  log (see :mod:`.spans`); ``annotate=True`` adds a ``jax.profiler``
+  annotation when jax is already imported.
+- ``render_prometheus`` / ``dump_jsonl`` — the scrape/offline surfaces
+  (see :mod:`.export`); served by the bridge's ``metrics`` verb and the
+  ``lasp_tpu metrics`` CLI.
+- ``profile`` — the ``jax.profiler`` block tracer (re-exported from
+  ``utils.metrics``, where the legacy import path keeps working).
+
+This package never imports jax at module scope: telemetry must be
+importable by the lightweight processes (CLI --help, the bench parent)
+that the lazy package __init__ protects.
+
+The metric catalog and span taxonomy live in docs/OBSERVABILITY.md;
+``tools/check_metrics_catalog.py`` keeps code and catalog in lock-step.
+"""
+
+from __future__ import annotations
+
+from .export import dump_jsonl, metric_events, render_prometheus
+from .registry import (
+    DEFAULT_BUCKETS,
+    Counter,
+    CounterGroup,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    counter,
+    enabled,
+    gauge,
+    get_registry,
+    histogram,
+    reset,
+    set_enabled,
+)
+from .spans import clear as clear_spans
+from .spans import configure, current_path, events, span
+from ..utils.metrics import profile
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Counter",
+    "CounterGroup",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "clear_spans",
+    "configure",
+    "counter",
+    "current_path",
+    "dump_jsonl",
+    "enabled",
+    "events",
+    "gauge",
+    "get_registry",
+    "histogram",
+    "metric_events",
+    "profile",
+    "render_prometheus",
+    "reset",
+    "set_enabled",
+    "span",
+]

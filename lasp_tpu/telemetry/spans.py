@@ -1,0 +1,154 @@
+"""Hierarchical span tracing: bounded ring + append-only JSONL event log.
+
+A span is a named, nestable timing region (``span("gossip.round")`` >
+``span("merge.orswot")``); the nesting path is tracked per thread, so
+the bridge's per-connection threads interleave without corrupting each
+other's lineage. Finished spans land in
+
+- a **bounded in-memory ring** (default 2048 events, oldest dropped) —
+  the flight recorder the CLI dumps with ``lasp_tpu metrics --jsonl``;
+- an optional **append-only JSONL file** (one JSON object per line) —
+  configure with :func:`configure` or the ``LASP_TELEMETRY_JSONL`` env
+  var; write failures disable the sink loudly once rather than breaking
+  the traced operation.
+
+``annotate=True`` additionally wraps the region in a
+``jax.profiler.TraceAnnotation`` so spans show up inside XLA profiles —
+only when jax is ALREADY imported (telemetry must never be the thing
+that pulls jax into a lightweight process; see lasp_tpu/__init__.py's
+lazy-import contract).
+
+Span taxonomy (documented in docs/OBSERVABILITY.md): ``gossip.round``,
+``gossip.converge``, ``merge.<crdt_type>``, ``mesh.update_batch``,
+``dataflow.propagate``, ``bridge.<verb>``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+
+from . import registry as _registry
+
+DEFAULT_RING_SIZE = 2048
+
+_local = threading.local()
+_lock = threading.Lock()
+_ring: collections.deque = collections.deque(maxlen=DEFAULT_RING_SIZE)
+_jsonl_path: "str | None" = None
+_jsonl_file = None
+_jsonl_checked = False
+
+
+def configure(jsonl_path: "str | None" = None,
+              ring_size: "int | None" = None) -> None:
+    """(Re)configure the sinks. ``jsonl_path=None`` keeps any current
+    file; pass ``""`` to close and disable the JSONL sink."""
+    global _ring, _jsonl_path, _jsonl_file, _jsonl_checked
+    with _lock:
+        if ring_size is not None:
+            _ring = collections.deque(_ring, maxlen=int(ring_size))
+        if jsonl_path is not None:
+            if _jsonl_file is not None:
+                try:
+                    _jsonl_file.close()
+                except OSError:
+                    pass
+            _jsonl_file = None
+            _jsonl_path = jsonl_path or None
+            _jsonl_checked = True  # explicit configure beats the env var
+
+
+def events() -> list:
+    """Snapshot of the ring (oldest first)."""
+    with _lock:
+        return list(_ring)
+
+
+def clear() -> None:
+    with _lock:
+        _ring.clear()
+
+
+def _emit(rec: dict) -> None:
+    global _jsonl_file, _jsonl_path, _jsonl_checked
+    with _lock:
+        _ring.append(rec)
+        if not _jsonl_checked:
+            # first event decides the env-var default exactly once
+            _jsonl_path = os.environ.get("LASP_TELEMETRY_JSONL") or None
+            _jsonl_checked = True
+        if _jsonl_path is None:
+            return
+        try:
+            if _jsonl_file is None:
+                _jsonl_file = open(_jsonl_path, "a", buffering=1)
+            _jsonl_file.write(json.dumps(rec) + "\n")
+        except OSError as exc:
+            # a broken sink must not break the traced operation — disable
+            # it loudly ONCE instead of failing every span from now on
+            print(
+                f"lasp_tpu.telemetry: JSONL sink {_jsonl_path!r} failed "
+                f"({exc}); span logging to file disabled",
+                file=sys.stderr,
+            )
+            _jsonl_path = None
+            _jsonl_file = None
+
+
+@contextlib.contextmanager
+def span(name: str, annotate: bool = False, **attrs):
+    """Time a region as one span event. Nesting is tracked per thread
+    (``path`` joins enclosing span names with ``>``); duration is
+    recorded whether or not the body raises (a failed round's timing is
+    exactly the one you want on a dashboard), with ``error`` set to the
+    exception type when it does."""
+    if not _registry.enabled():
+        yield
+        return
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    path = ">".join(stack + [name])
+    stack.append(name)
+    ann = None
+    if annotate and "jax" in sys.modules:
+        import jax
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+    ts = time.time()
+    t0 = time.perf_counter()
+    err: "str | None" = None
+    try:
+        yield
+    except BaseException as exc:
+        err = type(exc).__name__
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        if ann is not None:
+            ann.__exit__(None, None, None)
+        stack.pop()
+        rec = {
+            "kind": "span",
+            "name": name,
+            "path": path,
+            "ts": round(ts, 6),
+            "seconds": dt,
+        }
+        if err is not None:
+            rec["error"] = err
+        if attrs:
+            rec["attrs"] = attrs
+        _emit(rec)
+
+
+def current_path() -> str:
+    """``>``-joined names of the spans currently open on this thread."""
+    return ">".join(getattr(_local, "stack", []))

@@ -2,10 +2,20 @@
 
 The reference has only lager log lines plus per-type ``stats/1``
 introspection (``src/lasp_orset.erl:156-192``); riak_core's stat subsystem
-is not wired. The TPU build makes observability first-class: every
-convergence loop records per-round residuals and wall time, CRDT ``stats``
-are cheap tensor reductions, and ``profile()`` wraps a block in a
-``jax.profiler`` trace for XLA-level inspection."""
+is not wired. The TPU build makes observability first-class through
+``lasp_tpu.telemetry`` (typed registry + spans + Prometheus/JSONL export);
+this module keeps the original surfaces alive:
+
+- :class:`StepTrace` — the per-runtime round record, now a thin
+  compatibility facade over the telemetry registry: every
+  ``record_round`` still appends to the local round list (``summary()``,
+  ``bench.py`` and the CLI read it unchanged) and ALSO forwards a
+  dispatch count + timing into the process-global registry
+  (``step_dispatches_total`` / ``step_dispatch_seconds``), so runtime
+  activity shows up in a Prometheus scrape without touching callers.
+- :func:`profile` — the ``jax.profiler`` block tracer (re-exported by
+  ``lasp_tpu.telemetry`` as the canonical home).
+"""
 
 from __future__ import annotations
 
@@ -16,14 +26,44 @@ import time
 class StepTrace:
     """Append-only record of bulk-synchronous rounds: residuals, timings,
     and arbitrary counters. One per runtime/graph; cheap enough to always
-    keep on."""
+    keep on. Compatibility facade: the local record is authoritative for
+    ``summary()``; each ``record_round`` also mirrors into the telemetry
+    registry (one *dispatch* per call — fused blocks count their rounds
+    separately via the runtime's ``gossip_rounds_total``)."""
 
     def __init__(self):
         self.rounds: list[dict] = []
         self.counters: dict[str, int] = {}
+        self._tel: "tuple | None" = None  # (generation, counter, histogram)
 
     def record_round(self, residual: int, seconds: float, **extra) -> None:
         self.rounds.append({"residual": residual, "seconds": seconds, **extra})
+        # lazy import: utils.metrics must stay importable before the
+        # telemetry package (which re-exports profile from here) finishes
+        # initializing
+        from ..telemetry import registry as _reg
+
+        if not _reg.enabled():
+            return
+        # instruments cached per registry generation: this runs per step
+        # dispatch, and a name+label lookup each time is measurable
+        # against small steps (the overhead guard's workload)
+        gen = _reg.generation()
+        if self._tel is None or self._tel[0] != gen:
+            reg = _reg.get_registry()
+            self._tel = (
+                gen,
+                reg.counter(
+                    "step_dispatches_total",
+                    help="compiled step/block dispatches issued by runtimes",
+                ),
+                reg.histogram(
+                    "step_dispatch_seconds",
+                    help="wall time per compiled step/block dispatch",
+                ),
+            )
+        self._tel[1].inc()
+        self._tel[2].observe(seconds)
 
     def bump(self, name: str, by: int = 1) -> None:
         self.counters[name] = self.counters.get(name, 0) + by
@@ -48,13 +88,25 @@ class StepTrace:
 
 @contextlib.contextmanager
 def profile(log_dir: str):
-    """``jax.profiler`` trace around a block (view with TensorBoard/xprof)."""
+    """``jax.profiler`` trace around a block (view with TensorBoard/xprof).
+
+    Exception-safe on both edges: a ``start_trace`` failure propagates
+    without attempting ``stop_trace`` (stopping a never-started trace
+    raises its own error, MASKING the original one), and a ``stop_trace``
+    failure while the body is already raising is suppressed so the body's
+    error — the one the user needs — survives."""
     import jax
 
-    jax.profiler.start_trace(log_dir)
+    jax.profiler.start_trace(log_dir)  # a failure here has nothing to stop
     try:
         yield
-    finally:
+    except BaseException:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass  # the body's exception is the one that must propagate
+        raise
+    else:
         jax.profiler.stop_trace()
 
 

@@ -50,3 +50,13 @@ if shutil.which("make") and shutil.which(_cxx):
             )
     except (subprocess.TimeoutExpired, OSError):
         pass  # toolchain wedged: fall through to the graceful skips
+
+
+def pytest_configure(config):
+    # register the tiering marker (ROADMAP tier-1 runs -m 'not slow');
+    # without registration a typo'd mark would silently join the fast
+    # tier instead of warning
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy measurement/soak tests excluded from the tier-1 run",
+    )

@@ -1,0 +1,16 @@
+"""Telemetry-overhead guard (slow): the always-on registry/span layer
+must cost < 5% of the gossip step path — the 'cheap enough to always be
+on' contract, measured with the same helper bench.py embeds in its
+artifact (see telemetry/overhead.py for the noise-robust methodology)."""
+
+import pytest
+
+from lasp_tpu.telemetry.overhead import measure_overhead
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_under_5_percent():
+    out = measure_overhead()
+    assert out["step_seconds"] > 0
+    assert out["telemetry_cost_per_step_s"] >= 0
+    assert out["overhead_frac"] < 0.05, out

@@ -1,0 +1,61 @@
+"""Prometheus text-exposition golden test: the rendering is a scrape
+interface — byte-stable output for a fixed registry state, pinned
+against a checked-in golden file so accidental format drift is loud."""
+
+import os
+
+from lasp_tpu.telemetry.export import dump_jsonl, render_prometheus
+from lasp_tpu.telemetry.registry import MetricRegistry
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden_prometheus.txt")
+
+
+def _fixture_registry() -> MetricRegistry:
+    reg = MetricRegistry()
+    reg.counter("gossip_rounds_total", help="gossip rounds executed").inc(7)
+    reg.gauge("gossip_residual", help="per-var residual", var="v0").set(2)
+    reg.gauge("gossip_residual", help="per-var residual", var="v1").set(0)
+    h = reg.histogram(
+        "merge_seconds",
+        help="merge wall time",
+        buckets=(0.001, 0.01, 0.1),
+        type="lasp_orset",
+    )
+    h.observe(0.0005)
+    h.observe(0.05)
+    h.observe(5.0)
+    reg.counter(
+        "bridge_requests_total", help="requests", verb="update"
+    ).inc(3)
+    # a label value needing escaping: backslash, quote, newline
+    reg.counter("escape_total", help="escapes", k='a"b\\c\nd').inc(1)
+    return reg
+
+
+def test_prometheus_golden():
+    text = render_prometheus(_fixture_registry().snapshot())
+    with open(GOLDEN) as f:
+        assert text == f.read()
+
+
+def test_render_is_deterministic():
+    a = render_prometheus(_fixture_registry().snapshot())
+    b = render_prometheus(_fixture_registry().snapshot())
+    assert a == b
+
+
+def test_jsonl_dump_covers_every_series(tmp_path):
+    import io
+    import json
+
+    buf = io.StringIO()
+    n = dump_jsonl(buf, _fixture_registry().snapshot())
+    lines = [json.loads(x) for x in buf.getvalue().splitlines()]
+    assert len(lines) == n
+    metric_lines = [x for x in lines if x["kind"] == "metric"]
+    names = {x["name"] for x in metric_lines}
+    assert {"gossip_rounds_total", "gossip_residual", "merge_seconds",
+            "bridge_requests_total"} <= names
+    hist = next(x for x in metric_lines if x["name"] == "merge_seconds")
+    assert hist["count"] == 3
+    assert hist["counts"] == [1, 0, 1, 1]  # +Inf overflow slot last

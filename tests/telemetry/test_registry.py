@@ -1,0 +1,133 @@
+"""Registry semantics: counter monotonicity, histogram bucketing,
+snapshot isolation, label series, type safety, the enable switch, and
+the typed CounterGroup (the Store.metrics schema)."""
+
+import pytest
+
+from lasp_tpu.telemetry import registry as R
+from lasp_tpu.telemetry.registry import CounterGroup, MetricRegistry
+
+
+def test_counter_monotonic():
+    reg = MetricRegistry()
+    c = reg.counter("ops_total")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    assert c.value == 5  # the refused decrement changed nothing
+
+
+def test_counter_same_name_same_instrument():
+    reg = MetricRegistry()
+    reg.counter("x_total").inc()
+    reg.counter("x_total").inc()
+    assert reg.counter("x_total").value == 2
+
+
+def test_type_conflict_is_loud():
+    reg = MetricRegistry()
+    reg.counter("x_total")
+    with pytest.raises(TypeError):
+        reg.gauge("x_total")
+    with pytest.raises(TypeError):
+        reg.histogram("x_total")
+
+
+def test_label_series_are_independent():
+    reg = MetricRegistry()
+    reg.counter("m_total", type="a").inc(3)
+    reg.counter("m_total", type="b").inc(1)
+    snap = reg.snapshot()["m_total"]
+    by_label = {s["labels"]["type"]: s["value"] for s in snap["series"]}
+    assert by_label == {"a": 3, "b": 1}
+
+
+def test_histogram_bucketing_and_overflow():
+    reg = MetricRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.05, 0.5, 99.0):
+        h.observe(v)
+    assert h.counts == [1, 2, 1, 1]  # last slot = +Inf overflow
+    assert h.cumulative() == [1, 3, 4, 5]
+    assert h.count == 5
+    assert h.sum == pytest.approx(0.005 + 0.05 + 0.05 + 0.5 + 99.0)
+
+
+def test_histogram_boundary_lands_in_its_le_bucket():
+    # Prometheus semantics: le is INCLUSIVE — an observation exactly on
+    # a boundary counts in that boundary's bucket
+    reg = MetricRegistry()
+    h = reg.histogram("b_seconds", buckets=(0.1, 1.0))
+    h.observe(0.1)
+    assert h.counts == [1, 0, 0]
+
+
+def test_histogram_bad_buckets_raise():
+    reg = MetricRegistry()
+    with pytest.raises(ValueError):
+        reg.histogram("h1", buckets=(1.0, 0.5))  # unsorted
+    with pytest.raises(ValueError):
+        reg.histogram("h2", buckets=(1.0, 1.0))  # duplicate
+    with pytest.raises(ValueError):
+        reg.histogram("h3", buckets=())  # empty
+
+
+def test_snapshot_isolation():
+    reg = MetricRegistry()
+    c = reg.counter("iso_total")
+    h = reg.histogram("iso_seconds")
+    c.inc(2)
+    h.observe(0.2)
+    snap = reg.snapshot()
+    c.inc(10)
+    h.observe(0.9)
+    fam = snap["iso_total"]["series"][0]
+    assert fam["value"] == 2  # frozen at snapshot time
+    hs = snap["iso_seconds"]["series"][0]
+    assert hs["count"] == 1
+
+
+def test_gauge_set_inc_dec():
+    reg = MetricRegistry()
+    g = reg.gauge("depth")
+    g.set(7)
+    g.inc(2)
+    g.dec()
+    assert g.value == 8
+
+
+def test_enable_switch_returns_nulls():
+    prev = R.enabled()
+    try:
+        R.set_enabled(False)
+        c = R.counter("never_total")
+        c.inc(100)  # no-op
+        R.set_enabled(True)
+        assert "never_total" not in R.get_registry().names()
+    finally:
+        R.set_enabled(prev)
+
+
+def test_counter_group_typed():
+    g = CounterGroup(("binds", "reads"))
+    g["binds"] += 1
+    g["binds"] += 1
+    assert g["binds"] == 2
+    with pytest.raises(KeyError):
+        g["typo"] = 1
+    with pytest.raises(ValueError):
+        g["reads"] = -1
+    with pytest.raises(TypeError):
+        del g["binds"]
+    # mapping surface: dict() conversion, update (checkpoint restore),
+    # equality with a plain dict (the persistence round-trip contract)
+    assert dict(g) == {"binds": 2, "reads": 0}
+    g.update({"reads": 5})
+    assert g == {"binds": 2, "reads": 5}
+    assert g.snapshot() == {"binds": 2, "reads": 5}
+    # snapshot is a copy, not a view
+    snap = g.snapshot()
+    g["reads"] += 1
+    assert snap["reads"] == 5

@@ -1,0 +1,93 @@
+"""Span nesting/ordering, ring bounding, error capture, and the JSONL
+sink."""
+
+import json
+
+import pytest
+
+from lasp_tpu.telemetry import spans as S
+from lasp_tpu.telemetry import span
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ring():
+    S.clear()
+    yield
+    S.clear()
+
+
+def test_nesting_paths_and_completion_order():
+    with span("gossip.round"):
+        with span("merge.orswot"):
+            pass
+        with span("merge.orset"):
+            pass
+    evs = S.events()
+    assert [e["name"] for e in evs] == [
+        "merge.orswot", "merge.orset", "gossip.round",
+    ]  # children finish (and record) before their parent
+    assert evs[0]["path"] == "gossip.round>merge.orswot"
+    assert evs[1]["path"] == "gossip.round>merge.orset"
+    assert evs[2]["path"] == "gossip.round"
+    assert all(e["seconds"] >= 0 for e in evs)
+
+
+def test_stack_unwinds_after_exception():
+    with pytest.raises(RuntimeError):
+        with span("outer"):
+            with span("inner"):
+                raise RuntimeError("boom")
+    # both spans recorded, durations kept, error type stamped
+    evs = {e["name"]: e for e in S.events()}
+    assert evs["inner"]["error"] == "RuntimeError"
+    assert evs["outer"]["error"] == "RuntimeError"
+    # and the thread-local stack fully unwound: a fresh span is a root
+    with span("fresh"):
+        pass
+    assert S.events()[-1]["path"] == "fresh"
+
+
+def test_ring_is_bounded():
+    S.configure(ring_size=4)
+    try:
+        for i in range(10):
+            with span(f"s{i}"):
+                pass
+        names = [e["name"] for e in S.events()]
+        assert names == ["s6", "s7", "s8", "s9"]  # oldest dropped
+    finally:
+        S.configure(ring_size=S.DEFAULT_RING_SIZE)
+
+
+def test_attrs_ride_along():
+    with span("mesh.update_batch", type="lasp_orset", ops=3):
+        pass
+    ev = S.events()[-1]
+    assert ev["attrs"] == {"type": "lasp_orset", "ops": 3}
+
+
+def test_jsonl_sink(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    S.configure(jsonl_path=path)
+    try:
+        with span("a"):
+            with span("b"):
+                pass
+    finally:
+        S.configure(jsonl_path="")  # close + disable
+    lines = [json.loads(x) for x in open(path).read().splitlines()]
+    assert [x["name"] for x in lines] == ["b", "a"]
+    assert lines[0]["kind"] == "span"
+
+
+def test_disabled_spans_record_nothing():
+    from lasp_tpu.telemetry import registry as R
+
+    prev = R.enabled()
+    try:
+        R.set_enabled(False)
+        with span("ghost"):
+            pass
+        assert not any(e["name"] == "ghost" for e in S.events())
+    finally:
+        R.set_enabled(prev)

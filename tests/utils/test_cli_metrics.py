@@ -98,3 +98,51 @@ def test_cli_simulate_gcounter_value_key(capsys):
     out = json.loads(capsys.readouterr().out)
     assert out["value"] == 4  # one increment per writer lane
     assert "value_size" not in out
+
+
+def test_profile_start_failure_is_not_masked(monkeypatch):
+    """If start_trace itself raises, the ORIGINAL error must propagate
+    and stop_trace must not run (stopping a never-started trace raises
+    its own error, masking the real one)."""
+    import jax.profiler as jp
+
+    import pytest
+
+    from lasp_tpu.utils.metrics import profile
+
+    stopped = []
+    monkeypatch.setattr(
+        jp, "start_trace",
+        lambda d: (_ for _ in ()).throw(RuntimeError("start failed")),
+    )
+    monkeypatch.setattr(jp, "stop_trace", lambda: stopped.append(1))
+    with pytest.raises(RuntimeError, match="start failed"):
+        with profile("/tmp/never"):
+            raise AssertionError("body must not run")
+    assert stopped == []
+
+
+def test_profile_body_error_survives_stop_failure(monkeypatch):
+    """A stop_trace failure while the body is already raising must not
+    mask the body's exception."""
+    import jax.profiler as jp
+
+    import pytest
+
+    from lasp_tpu.utils.metrics import profile
+
+    monkeypatch.setattr(jp, "start_trace", lambda d: None)
+    monkeypatch.setattr(
+        jp, "stop_trace",
+        lambda: (_ for _ in ()).throw(RuntimeError("stop failed")),
+    )
+    with pytest.raises(ValueError, match="the real error"):
+        with profile("/tmp/never"):
+            raise ValueError("the real error")
+
+
+def test_profile_reexported_from_telemetry():
+    from lasp_tpu.telemetry import profile as tele_profile
+    from lasp_tpu.utils.metrics import profile as util_profile
+
+    assert tele_profile is util_profile

@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Metric-catalog lint: code and docs/OBSERVABILITY.md must agree.
+
+Every metric emitted anywhere under ``lasp_tpu/`` (a literal first
+argument to ``counter(...)`` / ``gauge(...)`` / ``histogram(...)``)
+must have a row in the catalog table of ``docs/OBSERVABILITY.md``, and
+every cataloged name must still be emitted somewhere — drift in either
+direction fails the Makefile ``verify`` target. This is what makes the
+metric key set a STABLE interface across PRs (dashboards and the bridge
+scrape consumers depend on it).
+
+Zero dependencies, stdlib only; exits 0 on agreement, 1 on drift.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "lasp_tpu")
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+#: a literal metric emission: counter("name"... / gauge('name'... /
+#: histogram("name"... — dynamic names are invisible to this lint and
+#: therefore forbidden by convention (docs/OBSERVABILITY.md)
+_EMIT = re.compile(
+    r"""\b(?:counter|gauge|histogram)\(\s*['"]([a-z][a-z0-9_]*)['"]"""
+)
+
+#: a catalog row: a markdown table line whose first cell is `name`
+_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|")
+
+
+def emitted_names() -> set:
+    names: set = set()
+    for root, _dirs, files in os.walk(SRC):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            with open(os.path.join(root, f), encoding="utf-8") as fp:
+                names.update(_EMIT.findall(fp.read()))
+    return names
+
+
+def cataloged_names() -> set:
+    if not os.path.exists(DOC):
+        print(f"check_metrics_catalog: {DOC} does not exist", file=sys.stderr)
+        sys.exit(1)
+    names: set = set()
+    with open(DOC, encoding="utf-8") as fp:
+        for line in fp:
+            m = _ROW.match(line.strip())
+            if m:
+                names.add(m.group(1))
+    return names
+
+
+def main() -> int:
+    code = emitted_names()
+    docs = cataloged_names()
+    missing_doc = sorted(code - docs)
+    missing_code = sorted(docs - code)
+    if missing_doc:
+        print(
+            "metrics emitted in code but MISSING from the "
+            "docs/OBSERVABILITY.md catalog:\n  "
+            + "\n  ".join(missing_doc)
+        )
+    if missing_code:
+        print(
+            "metrics cataloged in docs/OBSERVABILITY.md but emitted "
+            "NOWHERE in lasp_tpu/ (stale rows):\n  "
+            + "\n  ".join(missing_code)
+        )
+    if missing_doc or missing_code:
+        return 1
+    print(f"metrics catalog OK ({len(code)} metrics, code == docs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
