@@ -1,14 +1,28 @@
 # Top-level conveniences; the native engines build via native/Makefile
 # (tests/conftest.py invokes it automatically).
+#
+# Test tiers (see README "Test tiers"):
+#   test-fast — `-m 'not slow'`: the tier-1 quick suite (finishes in a
+#               few minutes; statem soak seeds and heavy measurement
+#               tests are excluded)
+#   test-slow — only the slow tier (full statem soaks, the telemetry
+#               overhead measurement)
+#   test      — everything
 
-.PHONY: test bench native bridge-e2e verify
+.PHONY: test test-fast test-slow bench native bridge-e2e verify
 
 test:
 	python -m pytest tests/ -q
 
-# lint + fast suite: the metrics-catalog check keeps the telemetry key
-# set (docs/OBSERVABILITY.md) in lock-step with the code, then the
-# non-slow tests run (the tier-1 shape)
+test-fast:
+	python -m pytest tests/ -q -m 'not slow'
+
+test-slow:
+	python -m pytest tests/ -q -m 'slow'
+
+# lint + fast suite: the telemetry-catalog check keeps the metric /
+# event / span key sets (docs/OBSERVABILITY.md) in lock-step with the
+# code, then the non-slow tests run (the tier-1 shape)
 verify:
 	python tools/check_metrics_catalog.py
 	python -m pytest tests/ -q -m 'not slow'

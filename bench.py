@@ -162,6 +162,44 @@ def _load_oneshot_capture() -> dict | None:
     }
 
 
+def _load_at_scale_evidence() -> dict | None:
+    """Target-scale summaries from ``docs/artifacts/cpu_evidence_*.jsonl``
+    (the 100K / 1M / 10M engine-path runs captured by earlier rounds),
+    for embedding in a CPU-fallback artifact: the driver's artifact must
+    never understate the engine just because THIS round's hardware
+    degraded to a small CPU run. The newest evidence file wins; records
+    carry their own scenario/config labels."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "docs", "artifacts", "cpu_evidence_*.jsonl",
+    )))
+    if not paths:
+        return None
+    runs: list = []
+    try:
+        with open(paths[-1]) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec and "error" not in rec:
+                    runs.append(rec)
+    except OSError:
+        return None
+    if not runs:
+        return None
+    return {
+        "note": "target-scale engine evidence captured by earlier "
+                "rounds on this host "
+                f"({os.path.basename(paths[-1])}); the headline above "
+                "is this round's reduced-scale fallback measurement",
+        "runs": runs,
+    }
+
+
 def _extract_json(out: str) -> dict | None:
     for line in reversed(out.strip().splitlines()):
         line = line.strip()
@@ -226,6 +264,11 @@ def main() -> int:
                 capture = _load_oneshot_capture()
                 if capture:
                     record.setdefault("detail", {})["tpu_capture"] = capture
+                # fold the at-scale engine evidence in so the artifact
+                # never understates the engine when degraded to CPU
+                at_scale = _load_at_scale_evidence()
+                if at_scale:
+                    record.setdefault("detail", {})["at_scale"] = at_scale
             _emit(record)
             return 0
         errors.append(
@@ -349,6 +392,10 @@ def _child(label: str) -> int:
         "device": str(jax.devices()[0].platform),
         "device_kind": str(kind),
         "attempt": label,
+        # how convergence happened, not just how fast (telemetry PR 2):
+        # diverged-at-seed population, per-block productive-round curve,
+        # worst-replica lag — the scenario computes these untimed
+        "convergence": out.get("convergence"),
     }
 
     # -- north-star: 10M-replica engine-path ad counter ---------------------
@@ -366,6 +413,9 @@ def _child(label: str) -> int:
             lambda n: adcounter_10m(n_replicas=n), ns0, floor=1 << 16,
             deadline=child_start + child_budget - 60,
         )
+        from lasp_tpu.telemetry import get_monitor
+
+        mon_snap = get_monitor().snapshot()
         detail["adcounter_northstar"] = {
             "n_replicas": ns_replicas,
             "requested_replicas": ns0,
@@ -376,6 +426,13 @@ def _child(label: str) -> int:
             "state_bytes_per_replica": ns["state_bytes_per_replica"],
             "engine": ns["engine"],
             "check": ns["check"],
+            # the ConvergenceMonitor's view of the engine-path run (the
+            # monitor is fed by the runtime's step telemetry)
+            "monitor": {
+                "rounds_observed": mon_snap["round"],
+                "residual_curve": mon_snap["residual_curve"][-16:],
+                "quiescence_eta": mon_snap["quiescence_eta"],
+            },
         }
     except Exception as exc:  # headline survives a north-star failure
         detail["adcounter_northstar"] = {"error": f"{type(exc).__name__}: {exc}"}

@@ -35,7 +35,7 @@ from typing import Any, Optional
 from ..dataflow import Graph
 from ..lattice import Threshold
 from ..store import Store, Watch
-from ..telemetry import counter, render_prometheus
+from ..telemetry import counter, get_monitor, render_prometheus
 
 
 def _count_verb(verb: str) -> None:
@@ -108,6 +108,12 @@ class Session:
         registry — the in-process twin of the bridge's ``metrics`` verb
         and ``lasp_tpu metrics`` (docs/OBSERVABILITY.md)."""
         return render_prometheus()
+
+    def health(self) -> dict:
+        """ConvergenceMonitor snapshot + alerts — the in-process twin of
+        the bridge's ``{health}`` verb and ``lasp_tpu top``
+        (docs/OBSERVABILITY.md)."""
+        return get_monitor().health()
 
     # -- combinators ---------------------------------------------------------
     def map(self, src: str, fn, dst: Optional[str] = None) -> str:

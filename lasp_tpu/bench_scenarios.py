@@ -225,10 +225,21 @@ def orset_anti_entropy(
     # count sums to the exact total — convergence landing mid-block is
     # handled without rewinding or block-quantizing.
     s = seed_states()
+    # convergence narration for the artifact (telemetry PR 2): how many
+    # replicas start behind the global join, then the per-block
+    # productive-round curve — "how convergence happened", not just how
+    # fast. One O(log R) join + equality sweep, untimed phase only.
+    from lasp_tpu.mesh.gossip import diverged_rows
+
+    diverged_at_seed = int(
+        jnp.sum(diverged_rows(PackedORSet, spec, s))
+    )
+    productive_per_block: list[int] = []
     rounds = 0
     while True:
         s, prod = fused(s, nbrs)
         prod = int(prod)
+        productive_per_block.append(prod)
         rounds += prod
         if prod < block:
             break
@@ -389,6 +400,15 @@ def orset_anti_entropy(
         "impl_block_seconds": {
             k: (round(v, 6) if isinstance(v, float) else v)
             for k, v in block_seconds.items()
+        },
+        "convergence": {
+            "rounds_to_quiescence": conv_rounds,
+            "productive_rounds_per_block": productive_per_block,
+            "block": block,
+            "diverged_replicas_at_seed": diverged_at_seed,
+            # every diverged replica is behind on this one variable, so
+            # the worst per-replica lag at seed is 1 iff any diverged
+            "worst_replica_lag_at_seed": int(diverged_at_seed > 0),
         },
         "check": "converged+all-live",
     }

@@ -30,6 +30,7 @@ request                                             response
 ``{read, Id}``                                      ``{ok, Value}``
 ``{keys}``                                          ``{ok, [Id...]}``
 ``{metrics}``                                       ``{ok, PromTextBin}`` (telemetry scrape: Prometheus text exposition of the process registry; allowed before ``start``)
+``{health}``                                        ``{ok, JsonBin}`` (ConvergenceMonitor state + alerts as a JSON object — residual/staleness per var, divergence top-K, quiescence ETA, replica/shard lag probe; allowed before ``start``, see docs/OBSERVABILITY.md)
 ==================================================  =========================
 
 Portable CRDT state encodings (id/elem/actor terms are arbitrary ETF
@@ -70,7 +71,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..store import Store
-from ..telemetry import counter, histogram, render_prometheus, span
+from ..telemetry import counter, get_monitor, histogram, render_prometheus, span
 from ..utils.metrics import Timer
 from . import etf
 from .etf import Atom
@@ -81,7 +82,7 @@ _HDR = struct.Struct(">I")
 #: mint unbounded label cardinality in the registry
 _METRIC_VERBS = frozenset({
     "start", "declare", "put", "get", "update", "bind", "merge_batch",
-    "read", "keys", "metrics",
+    "read", "keys", "metrics", "health",
 })
 
 #: declare caps accepted over the wire, per type (mirrors store.ALLOWED_CAPS)
@@ -809,6 +810,17 @@ class _Conn:
             # Deliberately allowed BEFORE {start, Name} — scraping must
             # never require claiming a store
             return (etf.OK, render_prometheus().encode())
+        if verb == "health":
+            # the convergence observatory: global ConvergenceMonitor
+            # snapshot + alerts as JSON (the bridge speaks ETF, but the
+            # payload is for dashboards/operators — JSON crosses every
+            # boundary). Allowed before {start} like {metrics}.
+            import json as _json
+
+            return (
+                etf.OK,
+                _json.dumps(get_monitor().health(), default=repr).encode(),
+            )
         if self.store is None:
             return (etf.ERROR, Atom("not_started"), b"send {start, Name} first")
         try:
@@ -1087,6 +1099,11 @@ class BridgeClient:
         """``{metrics}`` -> ``{ok, <Prometheus text binary>}`` — the
         scrape verb (works before ``start``)."""
         return self.call((Atom("metrics"),))
+
+    def health(self):
+        """``{health}`` -> ``{ok, <JSON binary>}`` — the ConvergenceMonitor
+        snapshot + alerts (works before ``start``)."""
+        return self.call((Atom("health"),))
 
     def close(self) -> None:
         self._sock.close()

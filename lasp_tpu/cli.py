@@ -5,7 +5,10 @@ devices and convergence state; ``simulate`` runs a gossip population to
 its fixed point; ``bench`` runs the BASELINE scenarios; ``metrics``
 prints a telemetry snapshot (Prometheus text + optional JSONL; the
 riak-admin ``status``/``stat`` role — see docs/OBSERVABILITY.md);
-``inspect`` lists a checkpoint's contents.
+``top`` is the live cluster-health view (per-var residual/staleness/
+lag, shard lag, alerts — the convergence observatory); ``trace``
+exports a variable's causal event history as Perfetto/Chrome-trace
+JSON; ``inspect`` lists a checkpoint's contents.
 
 Usage: ``python -m lasp_tpu.cli <verb> [options]``
 """
@@ -225,6 +228,165 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def _observatory_runtime(n_replicas: int):
+    """The live mesh behind ``top``/``trace`` when no --bridge is given:
+    an OR-Set + G-Counter population with a combinator edge (``ads`` ->
+    map -> ``seen_ads``), seeded at scattered replicas but NOT yet
+    converged — so the observatory has real divergence to watch drain.
+    Returns the runtime (its store/graph ride on the instance)."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    n = n_replicas
+    store = Store(n_actors=max(16, n))
+    ads = store.declare(id="ads", type="lasp_orset", n_elems=32)
+    hits = store.declare(id="hits", type="riak_dt_gcounter")
+    graph = Graph(store)
+    graph.map(ads, lambda x: ("seen", x), dst="seen_ads")
+    rt = ReplicatedRuntime(store, graph, n, ring(n, min(2, n - 1)))
+    rt.update_batch(
+        ads,
+        [(r, ("add", f"ad{r}"), f"w{r}") for r in range(0, n, max(1, n // 8))],
+    )
+    rt.update_batch(
+        hits,
+        [(r, ("increment",), f"w{r}") for r in range(0, n, max(1, n // 4))],
+    )
+    return rt
+
+
+def _render_top(health: dict, shard_lag_label: str = "shard lag") -> str:
+    """One refresh frame of the ``top`` view as text (pure function of a
+    health snapshot, so the CLI test can pin the rendering)."""
+    lines = []
+    eta = health.get("quiescence_eta")
+    lines.append(
+        f"convergence: round={health.get('round', 0)} "
+        f"replicas={health.get('n_replicas', 0)} "
+        f"residual={health.get('residual_total')} "
+        f"eta={'?' if eta is None else eta}"
+    )
+    probe = health.get("probe") or {}
+    lag_by_var = probe.get("lag_by_var", {})
+    lines.append(f"{'VAR':<20} {'RESIDUAL':>8} {'STALE':>6} {'LAG':>6}")
+    residual_by_var = health.get("residual_by_var", {})
+    staleness = health.get("staleness", {})
+    for v in sorted(residual_by_var, key=lambda x: -residual_by_var[x]):
+        lines.append(
+            f"{str(v):<20} {residual_by_var[v]:>8} "
+            f"{staleness.get(v, 0):>6} {lag_by_var.get(v, '-'):>6}"
+        )
+    if probe.get("shard_lag"):
+        lines.append(
+            f"{shard_lag_label}: "
+            + "  ".join(
+                f"s{i}={sl}" for i, sl in enumerate(probe["shard_lag"])
+            )
+        )
+        lines.append(
+            f"worst replica: {probe.get('worst_replica')} "
+            f"(lag {probe.get('worst_replica_lag')})"
+        )
+    alerts = health.get("alerts", [])
+    for a in alerts:
+        lines.append(f"ALERT: {a}")
+    if not alerts:
+        lines.append("alerts: none")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live cluster-health view (the riak-admin ``top`` role): per-var
+    residual/staleness/lag table, per-shard lag, alert lines — refreshed
+    from a live bridge's ``{health}`` verb or from a built-in observed
+    mesh stepping toward quiescence."""
+    import time
+
+    from lasp_tpu.telemetry import get_monitor
+
+    rt = None
+    if not args.bridge:
+        if args.replicas < 2:
+            print(
+                "error: --replicas must be >= 2 (nothing to observe)",
+                file=sys.stderr,
+            )
+            return 2
+        rt = _observatory_runtime(args.replicas)
+    iterations = args.iterations
+    i = 0
+    try:
+        while True:
+            if args.bridge:
+                from lasp_tpu.bridge import BridgeClient
+
+                host, _, port = args.bridge.rpartition(":")
+                with BridgeClient(host or "127.0.0.1", int(port)) as c:
+                    resp = c.health()
+                if not (isinstance(resp, tuple) and len(resp) == 2):
+                    raise RuntimeError(f"bridge health verb failed: {resp!r}")
+                health = json.loads(
+                    resp[1].decode()
+                    if isinstance(resp[1], bytes)
+                    else str(resp[1])
+                )
+            else:
+                rt.step()  # one observed gossip round per refresh
+                mon = get_monitor()
+                mon.probe(rt, n_shards=args.shards)
+                health = mon.health()
+            print(_render_top(health))
+            print("---", flush=True)
+            i += 1
+            if iterations and i >= iterations:
+                return 0
+            time.sleep(args.refresh)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_trace(args) -> int:
+    """Causal-history export: drive the observed mesh (variable ``ads``
+    through the ``seen_ads`` map edge), collect the event-log records
+    relevant to ``--var`` — its own binds/updates/deliveries plus, via
+    the dataflow lineage, its upstream sources' — and write a
+    Perfetto/Chrome-trace JSON (open in ui.perfetto.dev or
+    chrome://tracing)."""
+    from lasp_tpu.telemetry import events as tel_events
+    from lasp_tpu.telemetry import get_monitor
+
+    if args.deep:
+        tel_events.set_deep(True)
+    rt = _observatory_runtime(args.replicas)
+    if args.var not in rt.store.ids():
+        # validate BEFORE the convergence run: a typo'd --var must not
+        # cost the whole workload
+        print(
+            f"error: unknown variable {args.var!r} "
+            f"(workload vars: {sorted(map(str, rt.store.ids()))})",
+            file=sys.stderr,
+        )
+        return 2
+    rt.run_to_convergence(max_rounds=args.max_rounds)
+    rt.graph.propagate()  # fold the combinator edges' provenance in
+    get_monitor().probe(rt)
+    lineage = rt.graph.lineage(args.var)
+    history = tel_events.causal_history(args.var, lineage)
+    with open(args.export, "w") as fp:
+        n = tel_events.export_chrome_trace(fp, event_records=history)
+    print(json.dumps({
+        "var": args.var,
+        "events": len(history),
+        "trace_events": n,
+        "lineage": {
+            v: entry["srcs"] for v, entry in lineage.items()
+        },
+        "export": args.export,
+    }))
+    return 0
+
+
 def cmd_inspect(args) -> int:
     from lasp_tpu.store import HostStore
     from lasp_tpu.store.checkpoint import loads_manifest
@@ -329,6 +491,42 @@ def main(argv=None) -> int:
                      help="scrape a live bridge's {metrics} verb instead "
                           "of running the built-in workload")
 
+    top = sub.add_parser(
+        "top",
+        help="live cluster-health view: per-var residual/staleness/lag "
+             "table + shard lag + alerts, refreshed against a running "
+             "mesh (or --bridge scraping a live {health} verb)",
+    )
+    top.add_argument("--replicas", type=int, default=64,
+                     help="population of the built-in observed mesh")
+    top.add_argument("--refresh", type=float, default=1.0,
+                     metavar="SECONDS", help="delay between frames")
+    top.add_argument("--iterations", type=int, default=0,
+                     help="stop after N frames (0 = until interrupted)")
+    top.add_argument("--shards", type=int, default=None,
+                     help="shard count for the lag aggregation "
+                          "(default: the runtime's partition plan, else 1)")
+    top.add_argument("--bridge", default=None, metavar="HOST:PORT",
+                     help="scrape a live bridge's {health} verb instead "
+                          "of running the built-in mesh")
+
+    tr = sub.add_parser(
+        "trace",
+        help="export a variable's causal event history (its own events "
+             "plus upstream combinator sources) as Perfetto/Chrome-trace "
+             "JSON",
+    )
+    tr.add_argument("--var", required=True,
+                    help="variable to trace (workload vars: ads, "
+                         "seen_ads, hits)")
+    tr.add_argument("--export", required=True, metavar="FILE",
+                    help="output path for the Chrome-trace JSON")
+    tr.add_argument("--replicas", type=int, default=64)
+    tr.add_argument("--max-rounds", type=int, default=256)
+    tr.add_argument("--deep", action="store_true",
+                    help="turn on deep tracing (per-op / per-merge / "
+                         "per-edge events) for the driven workload")
+
     ins = sub.add_parser("inspect", help="list a checkpoint's contents")
     ins.add_argument("path")
 
@@ -347,6 +545,8 @@ def main(argv=None) -> int:
         "bench": cmd_bench,
         "scenario": cmd_scenario,
         "metrics": cmd_metrics,
+        "top": cmd_top,
+        "trace": cmd_trace,
         "inspect": cmd_inspect,
         "bridge": cmd_bridge,
     }[args.verb](args)

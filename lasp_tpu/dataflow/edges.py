@@ -74,6 +74,14 @@ class Edge:
     #: no host->device upload per propagate
     _tables_cache = None
 
+    def describe(self) -> dict:
+        """Provenance record — which variables feed this edge's output,
+        through which combinator. The causal event log
+        (``telemetry/events.py``) attaches this to ``edge_recompute``
+        events, and ``Graph.lineage`` aggregates it so ``lasp_tpu trace
+        --var`` can walk a derived value back to its source updates."""
+        return {"kind": self.kind, "srcs": list(self.srcs), "dst": self.dst}
+
     def refresh(self, store) -> bool:
         """Fold newly interned source terms into host tables; returns True if
         anything changed (drives the refresh-to-fixpoint loop for chained

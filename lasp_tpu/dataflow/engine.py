@@ -265,6 +265,35 @@ class Graph:
             dst_var.ivar_payloads = src_var.ivar_payloads
         return self._add(BindToEdge(src, dst, store))
 
+    # -- provenance -----------------------------------------------------------
+    def lineage(self, var_id: str) -> dict:
+        """Transitive upstream provenance of ``var_id`` through the
+        combinator edges: ``{derived_var: {"kinds": [...], "srcs":
+        [...]}}`` for every edge output on some path into ``var_id``
+        (including ``var_id`` itself when it is derived). This is the
+        map ``lasp_tpu trace --var`` and
+        ``telemetry.events.causal_history`` use to pull SOURCE updates
+        into a derived variable's history."""
+        by_dst: dict = {}
+        for e in self.edges:
+            by_dst.setdefault(e.dst, []).append(e)
+        out: dict = {}
+        frontier, visited = [var_id], set()
+        while frontier:
+            v = frontier.pop()
+            if v in visited:
+                continue
+            visited.add(v)
+            for e in by_dst.get(v, ()):
+                d = e.describe()
+                ent = out.setdefault(v, {"kinds": [], "srcs": []})
+                ent["kinds"].append(d["kind"])
+                for s in d["srcs"]:
+                    if s not in ent["srcs"]:
+                        ent["srcs"].append(s)
+                    frontier.append(s)
+        return out
+
     # -- round compilation ---------------------------------------------------
     def refresh(self) -> None:
         """Host pass: fold newly interned terms into edge tables, looping
@@ -371,6 +400,22 @@ class Graph:
                          "kind",
                     kind=kind,
                 ).inc(n)
+            # causal log: one coarse record per propagate run; the deep
+            # tier adds per-edge recompute provenance (srcs -> dst, the
+            # trail `lasp_tpu trace --var` reconstructs values from)
+            from ..telemetry import events as tel_events
+
+            tel_events.emit(
+                "propagate", rounds=rounds, sweeps=executed,
+                edges=len(self.edges),
+            )
+            if tel_events.deep_enabled():
+                for e in self.edges:
+                    d = e.describe()
+                    tel_events.emit_deep(
+                        "edge_recompute", var=d["dst"], kind=d["kind"],
+                        srcs=d["srcs"], sweeps=executed,
+                    )
         pre_ingest = self.store.mutations
         writes = self.store.ingest(states)
         if self.store.mutations == pre_ingest + writes:

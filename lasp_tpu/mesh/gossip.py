@@ -153,12 +153,21 @@ def converged(codec, spec, states) -> jax.Array:
     return jnp.all(eq)
 
 
+def diverged_rows(codec, spec, states) -> jax.Array:
+    """``bool[R]``: which replica rows still differ from the global join
+    — the per-replica lag mask behind the ConvergenceMonitor's probe
+    (``telemetry/convergence.py``): summed over variables it says WHICH
+    replica/shard is behind, where :func:`divergence` only says how
+    many."""
+    top = join_all(codec, spec, states)
+    eq = jax.vmap(lambda s: codec.equal(spec, s, top))(states)
+    return ~eq
+
+
 def divergence(codec, spec, states) -> jax.Array:
     """Number of replicas not yet at the global join — the convergence
     residual reported by the benchmarks (rounds-to-convergence metric)."""
-    top = join_all(codec, spec, states)
-    eq = jax.vmap(lambda s: codec.equal(spec, s, top))(states)
-    return jnp.sum(~eq)
+    return jnp.sum(diverged_rows(codec, spec, states))
 
 
 def round_traffic_bytes(states, fanout: int) -> int:

@@ -33,7 +33,8 @@ import numpy as np
 
 from ..lattice.base import Threshold, replicate
 from ..ops.flatpack import FlatORSet, FlatORSetSpec
-from ..telemetry import counter, histogram, span
+from ..telemetry import counter, events as tel_events, histogram, span
+from ..telemetry.convergence import get_monitor, record_membership
 from ..utils.metrics import StepTrace, Timer
 from .gossip import (
     divergence,
@@ -176,6 +177,10 @@ class ReplicatedRuntime:
         self._step = None
         self._fused_steps_cache: dict[int, object] = {}
         self._n_edges = -1
+        #: True only inside update_batch's per-op fallback loop, where
+        #: the batch owns the causal-event emission (update_at must not
+        #: double-log each op)
+        self._suppress_op_events = False
         self.trace = StepTrace()
         #: per-round wire estimate (bytes), refreshed by _ensure_step
         self._round_traffic = 0
@@ -509,7 +514,8 @@ class ReplicatedRuntime:
             help="host-path CRDT merge wall time by type",
             type=var.type_name,
         ).observe(mt.elapsed)
-        if bool(var.codec.is_inflation(var.spec, row, merged)):
+        inflated = bool(var.codec.is_inflation(var.spec, row, merged))
+        if inflated:
             new_row = self._from_dense_row(var_id, merged)
             if guarded:
                 # commit only now: the write applied AND inflated (a
@@ -522,6 +528,18 @@ class ReplicatedRuntime:
         self.states[var_id] = jax.tree_util.tree_map(
             lambda x, r: x.at[replica].set(r), self.states[var_id], new_row
         )
+        if not getattr(self, "_suppress_op_events", False):
+            # inside update_batch's per-op fallback the BATCH owns both
+            # tiers (one coarse record + the deep per-op loop) — emitting
+            # here too would double-count every op
+            tel_events.emit(
+                "update", var=var_id, replica=replica, op=str(op[0]),
+                inflated=inflated,
+            )
+            tel_events.emit_deep(
+                "merge", var=var_id, replica=replica, type=var.type_name,
+                seconds=round(mt.elapsed, 9),
+            )
         self.graph.refresh()
 
     def update_batch(self, var_id: str, ops) -> None:
@@ -645,6 +663,19 @@ class ReplicatedRuntime:
                 "update_batch_ops_total",
                 help="client ops submitted through update_batch",
             ).inc(len(ops))
+            # ONE coarse causal record per batch (hot-path rule); the
+            # deep tier logs per-op provenance when an operator turned
+            # it on (events.set_deep)
+            tel_events.emit(
+                "update", var=var_id, ops=len(ops), type=tn,
+                failed=dispatch_exc is not None,
+            )
+            if tel_events.deep_enabled():
+                for r, op, actor in ops:
+                    tel_events.emit_deep(
+                        "update", var=var_id, replica=r, op=str(op[0]),
+                        actor=repr(actor),
+                    )
             # a mid-batch CapacityError/PreconditionError persists the ops
             # before the failure (sequential semantics) — their interned
             # terms must still fold into the edge tables, or a caller that
@@ -809,8 +840,16 @@ class ReplicatedRuntime:
                 "not for population-scale seeding)",
                 stacklevel=3,
             )
-            for r, op, actor in ops:
-                self.update_at(r, var_id, op, actor)
+            # suppress update_at's per-call coarse events: the batch's
+            # finally block logs the ONE coarse record this dispatch
+            # owes (one-coarse-record-per-batch, docs/OBSERVABILITY.md);
+            # per-op records stay the deep tier's job
+            self._suppress_op_events = True
+            try:
+                for r, op, actor in ops:
+                    self.update_at(r, var_id, op, actor)
+            finally:
+                self._suppress_op_events = False
 
     @staticmethod
     def _map_reset_remove_batch(var, ops) -> bool:
@@ -1780,9 +1819,25 @@ class ReplicatedRuntime:
         self._record_rounds(1)
         tel = self._instruments()
         if tel is not None:
-            tel["round_seconds"].observe(elapsed)
-            for g, r in zip(tel["residual"], res_vec.tolist()):
+            res_list = res_vec.tolist()
+            for g, r in zip(tel["residual"], res_list):
                 g.set(int(r))
+            tel["round_seconds"].observe(elapsed)
+            # the convergence observatory's hot feed: per-var residuals
+            # into the global monitor, one coarse delivery event with
+            # round provenance into the causal log (deep tracing stays
+            # off-path; both are covered by the overhead guard)
+            mon = get_monitor()
+            mon.observe_round(
+                self.var_ids, res_list, elapsed, self.n_replicas
+            )
+            tel_events.set_round(mon.round)
+            tel_events.emit(
+                "delivery",
+                residual=int(residual),
+                seconds=round(elapsed, 6),
+                n_replicas=self.n_replicas,
+            )
 
     def fused_steps(self, block: int, edge_mask=None) -> int:
         """Run ``block`` FULL steps (dataflow sweep + triggers + gossip +
@@ -1829,7 +1884,27 @@ class ReplicatedRuntime:
         first_zero = int(first_zero)
         self.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
         self._record_rounds(block)  # fori always executes the whole block
+        self._observe_opaque_block(block, first_zero >= 0, t.elapsed)
         return first_zero
+
+    def _observe_opaque_block(self, rounds: int, quiescent: "bool | None",
+                              elapsed: float) -> None:
+        """Convergence-observatory feed for the fused/on-device entry
+        points, whose per-round residual vectors never reach the host:
+        advance the monitor's round clock and log one delivery event per
+        DISPATCH (not per round — the hot-path rule)."""
+        if self._instruments() is None:  # telemetry disabled
+            return
+        mon = get_monitor()
+        mon.observe_opaque_rounds(rounds, quiescent)
+        tel_events.set_round(mon.round)
+        tel_events.emit(
+            "delivery",
+            rounds=int(rounds),
+            quiescent=quiescent,
+            seconds=round(elapsed, 6),
+            n_replicas=self.n_replicas,
+        )
 
     def run_to_convergence(
         self, max_rounds: int = 10_000, edge_mask=None, block: int = 1
@@ -1920,6 +1995,9 @@ class ReplicatedRuntime:
         # (the same convention fused_steps' trace rows use)
         self.trace.record_round(0 if signed_rounds > 0 else -1, t.elapsed)
         self._record_rounds(abs(signed_rounds))
+        self._observe_opaque_block(
+            abs(signed_rounds), signed_rounds > 0, t.elapsed
+        )
         if signed_rounds > 0:
             self._record_quiescence(signed_rounds)
         if signed_rounds < 0 and strict:
@@ -1984,6 +2062,9 @@ class ReplicatedRuntime:
                 exists=states.exists.at[rows, elems, tokens].set(True),
                 removed=states.removed.at[rows, elems, tokens].set(False),
             )
+        tel_events.emit(
+            "update", var=var_id, ops=int(rows.size), op="seed_tokens",
+        )
 
     def seed_increments(self, var_id: str, rows, lanes, by=1) -> None:
         """Device-side bulk G-Counter increments at ``(rows[i], lanes[i])``
@@ -2020,6 +2101,10 @@ class ReplicatedRuntime:
                               jnp.asarray(rows).shape)
         self.states[var_id] = states._replace(
             counts=states.counts.at[jnp.asarray(rows), jnp.asarray(lanes)].add(by)
+        )
+        tel_events.emit(
+            "update", var=var_id, ops=int(np.asarray(rows).size),
+            op="seed_increments",
         )
         if staged:
             # register AFTER the scatter: a shape error above must not
@@ -2142,6 +2227,10 @@ class ReplicatedRuntime:
             max_rounds, edge_mask, block,
         )
         if row is not None:
+            tel_events.emit(
+                "threshold_fire", var=var_id, replica=replica,
+                rounds=rounds, verb="read_until",
+            )
             return row
         raise TimeoutError(
             f"threshold not met at replica {replica} within {rounds} rounds"
@@ -2216,6 +2305,10 @@ class ReplicatedRuntime:
             probe, max_rounds, edge_mask, block
         )
         if hit is not None:
+            tel_events.emit(
+                "threshold_fire", var=hit[0], replica=replica,
+                rounds=rounds, verb="read_any_until",
+            )
             return hit
         raise TimeoutError(
             f"no threshold met at replica {replica} within {rounds} rounds"
@@ -2314,9 +2407,16 @@ class ReplicatedRuntime:
         rounds, code = (packed // n_reads) // 4, (packed // n_reads) % 4
         self.trace.record_round(0 if code == 0 else -1, t.elapsed)
         self._record_rounds(rounds)
+        self._observe_opaque_block(
+            rounds, True if code == 2 else None, t.elapsed
+        )
         verb = "read_until" if n_reads == 1 else "read_any_until"
         if code == 0:
             var_id, thr = resolved[which]
+            tel_events.emit(
+                "threshold_fire", var=var_id, replica=replica,
+                rounds=rounds, verb=verb,
+            )
             row = self.read_at(replica, var_id, thr)
             if row is None:
                 # met on-device must be met on-host; a mismatch means the
@@ -2584,6 +2684,15 @@ class ReplicatedRuntime:
                 self.states[v] = jax.tree_util.tree_map(
                     lambda a, b: jnp.concatenate([a, b], axis=0), st, fresh
                 )
+        if new_n > old_n:
+            record_membership("join", old_n, new_n)
+        elif new_n < old_n:
+            record_membership(
+                "leave_graceful" if graceful else "leave_crash",
+                old_n, new_n,
+            )
+        else:
+            record_membership("topology_swap", old_n, new_n)
         self.n_replicas = new_n
         self.neighbors = jnp.asarray(new_neighbors)
         self._host_neighbors = np.asarray(new_neighbors)

@@ -21,7 +21,20 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from jax import shard_map as _shard_map
+try:  # jax >= 0.5 promotes shard_map to the top-level namespace
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check kwarg was renamed check_rep -> check_vma across
+# jax versions; probe the signature once instead of pinning either name
+import inspect as _inspect
+
+_SM_NOCHECK = (
+    {"check_vma": False}
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else {"check_rep": False}
+)
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -82,7 +95,7 @@ def ring_gossip_round_fn(codec, spec, mesh: Mesh, k: int = 2,
         return acc
 
     return _shard_map(
-        local, mesh=mesh, in_specs=P(axis), out_specs=P(axis), check_vma=False
+        local, mesh=mesh, in_specs=P(axis), out_specs=P(axis), **_SM_NOCHECK
     )
 
 
@@ -129,7 +142,7 @@ def sharded_join_all(codec, spec, states, mesh: Mesh, axis: str = "replicas"):
         return join_all(codec, spec, gathered)
 
     return _shard_map(
-        local, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False
+        local, mesh=mesh, in_specs=P(axis), out_specs=P(), **_SM_NOCHECK
     )(states)
 
 
@@ -248,6 +261,16 @@ def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
         "gossip_partition_cross_edges",
         help="neighbor-table edges crossing a shard boundary",
     ).set(stats["cross_edges"])
+    # the plan decides how the population maps onto shards — a
+    # membership-class fact for the causal log (an operator tracing a
+    # lagging shard needs to know when the shard layout last changed)
+    from ..telemetry import events as tel_events
+
+    tel_events.emit(
+        "membership", kind="partition_plan", n_shards=int(n_shards),
+        cut_rows=int(stats["send_rows"]),
+        cross_edges=int(stats["cross_edges"]),
+    )
     return {
         "send_idx": send_idx.astype(np.int32),
         "idx": idx.astype(np.int32),
@@ -344,7 +367,7 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
     return _shard_map(
         local, mesh=mesh,
         in_specs=(P(axis), tbl_spec, P(axis, None)),
-        out_specs=P(axis), check_vma=False,
+        out_specs=P(axis), **_SM_NOCHECK,
     )
 
 

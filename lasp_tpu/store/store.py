@@ -44,6 +44,7 @@ from ..lattice import (
     Threshold,
     get_type,
 )
+from ..telemetry import events as tel_events
 from ..telemetry.registry import CounterGroup, counter, histogram
 from ..utils.interning import Interner
 from ..utils.metrics import Timer
@@ -578,6 +579,7 @@ class Store:
             # migrates it)
             self.admit_map_fields(var, op)
         state = self._apply_op(var, var.state, op, actor)
+        tel_events.emit("update", var=id, op=str(op[0]))
         return self.bind(id, state)
 
     def _apply_op(self, var: Variable, state, op: tuple, actor):
@@ -698,6 +700,7 @@ class Store:
         self.metrics["binds"] += 1
         counter("store_binds_total", help="bind verbs dispatched").inc()
         if bool(var.codec.equal(var.spec, var.state, state)):
+            tel_events.emit("bind", var=id, outcome="noop")
             return var.state
         with Timer() as t:
             merged = var.codec.merge(var.spec, var.state, state)
@@ -706,11 +709,16 @@ class Store:
             help="host-path CRDT merge wall time by type",
             type=var.type_name,
         ).observe(t.elapsed)
+        tel_events.emit_deep(
+            "merge", var=id, type=var.type_name,
+            seconds=round(t.elapsed, 9),
+        )
         if bool(var.codec.is_inflation(var.spec, var.state, merged)):
             self.metrics["inflations"] += 1
             counter(
                 "store_inflations_total", help="binds that inflated"
             ).inc()
+            tel_events.emit("bind", var=id, outcome="inflated")
             self._write(var, merged)
         else:
             # non-inflation silently ignored (src/lasp_core.erl:305-311)
@@ -719,6 +727,7 @@ class Store:
                 "store_ignored_binds_total",
                 help="binds ignored by the inflation gate",
             ).inc()
+            tel_events.emit("bind", var=id, outcome="ignored")
         return var.state
 
     def bind_raw(self, id: str, state) -> Any:
@@ -759,6 +768,9 @@ class Store:
             if watch.done:
                 continue  # retired by a sibling's callback mid-loop
             if bool(var.codec.threshold_met(var.spec, var.state, watch.threshold)):
+                tel_events.emit(
+                    "threshold_fire", var=var.id, kind=watch.kind
+                )
                 watch.fire((var.id, var.type_name, var.state))
             else:
                 still.append(watch)

@@ -10,6 +10,15 @@ One import surface for every emitter and consumer:
 - ``span`` — nested timing regions into a bounded ring + optional JSONL
   log (see :mod:`.spans`); ``annotate=True`` adds a ``jax.profiler``
   annotation when jax is already imported.
+- ``events`` — the typed, bounded CAUSAL EVENT LOG (see :mod:`.events`):
+  bind / update / delivery / threshold-fire / membership records with
+  logical-round + replica/shard provenance, JSONL sink and
+  Perfetto/Chrome-trace export (``lasp_tpu trace``).
+- ``get_monitor()`` — the process-global :class:`ConvergenceMonitor`
+  (see :mod:`.convergence`): per-variable residual/staleness, divergence
+  top-K, quiescence ETA, per-replica/per-shard lag probes, pluggable
+  alerts — the state behind the bridge's ``{health}`` verb and
+  ``lasp_tpu top``.
 - ``render_prometheus`` / ``dump_jsonl`` — the scrape/offline surfaces
   (see :mod:`.export`); served by the bridge's ``metrics`` verb and the
   ``lasp_tpu metrics`` CLI.
@@ -26,6 +35,8 @@ The metric catalog and span taxonomy live in docs/OBSERVABILITY.md;
 
 from __future__ import annotations
 
+from . import convergence, events
+from .convergence import ConvergenceMonitor, get_monitor
 from .export import dump_jsonl, metric_events, render_prometheus
 from .registry import (
     DEFAULT_BUCKETS,
@@ -43,13 +54,18 @@ from .registry import (
     set_enabled,
 )
 from .spans import clear as clear_spans
-from .spans import configure, current_path, events, span
+from .spans import configure, current_path, span
+from .spans import events as span_events
 from ..utils.metrics import profile
 
 __all__ = [
+    "ConvergenceMonitor",
     "DEFAULT_BUCKETS",
     "Counter",
     "CounterGroup",
+    "convergence",
+    "events",
+    "get_monitor",
     "Gauge",
     "Histogram",
     "MetricRegistry",
@@ -69,4 +85,5 @@ __all__ = [
     "reset",
     "set_enabled",
     "span",
+    "span_events",
 ]

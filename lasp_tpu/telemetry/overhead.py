@@ -34,6 +34,7 @@ import time
 
 import numpy as np
 
+from . import events as _events
 from . import registry as _registry
 from .spans import span
 
@@ -93,6 +94,22 @@ def _measure(n_replicas: int, step_samples: int,
     emission_off = emission_pass(False)
     per_step_cost = max(0.0, emission_on - emission_off)
 
+    # the causal event log rides inside _emit_step_telemetry (the
+    # delivery event + ConvergenceMonitor feed), so per_step_cost above
+    # already covers it; this isolates the marginal cost of ONE event
+    # emission so the artifact shows the log's own price too
+    def event_pass(flag: bool) -> float:
+        _registry.set_enabled(flag)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(emission_samples):
+                _events.emit("delivery", residual=0, seconds=0.0)
+            return (time.perf_counter() - t0) / emission_samples
+        finally:
+            _registry.set_enabled(prev)
+
+    event_cost = max(0.0, event_pass(True) - event_pass(False))
+
     _registry.set_enabled(False)
     try:
         step_s = min(
@@ -103,6 +120,10 @@ def _measure(n_replicas: int, step_samples: int,
 
     overhead = per_step_cost / step_s if step_s > 0 else 0.0
     return {
+        "event_emit_cost_s": round(event_cost, 9),
+        "event_log": {
+            k: _events.stats()[k] for k in ("ring_size", "deep")
+        },
         "telemetry_cost_per_step_s": round(per_step_cost, 9),
         "step_seconds": round(step_s, 6),
         "telemetry_on_s": round(step_s + per_step_cost, 6),

@@ -27,41 +27,34 @@ from __future__ import annotations
 
 import collections
 import contextlib
-import json
-import os
 import sys
 import threading
 import time
 
 from . import registry as _registry
+from .sink import JsonlSink
 
 DEFAULT_RING_SIZE = 2048
 
 _local = threading.local()
 _lock = threading.Lock()
 _ring: collections.deque = collections.deque(maxlen=DEFAULT_RING_SIZE)
-_jsonl_path: "str | None" = None
-_jsonl_file = None
-_jsonl_checked = False
+#: the shared locked writer (telemetry/sink.py): serialize-and-write is
+#: ONE critical section per record, so concurrent emitters (bridge
+#: connection threads, mesh batch dispatch) can never interleave
+#: partial lines — the same discipline the causal event log uses
+_sink = JsonlSink("LASP_TELEMETRY_JSONL")
 
 
 def configure(jsonl_path: "str | None" = None,
               ring_size: "int | None" = None) -> None:
     """(Re)configure the sinks. ``jsonl_path=None`` keeps any current
     file; pass ``""`` to close and disable the JSONL sink."""
-    global _ring, _jsonl_path, _jsonl_file, _jsonl_checked
-    with _lock:
-        if ring_size is not None:
+    global _ring
+    _sink.configure(jsonl_path)
+    if ring_size is not None:
+        with _lock:
             _ring = collections.deque(_ring, maxlen=int(ring_size))
-        if jsonl_path is not None:
-            if _jsonl_file is not None:
-                try:
-                    _jsonl_file.close()
-                except OSError:
-                    pass
-            _jsonl_file = None
-            _jsonl_path = jsonl_path or None
-            _jsonl_checked = True  # explicit configure beats the env var
 
 
 def events() -> list:
@@ -76,29 +69,9 @@ def clear() -> None:
 
 
 def _emit(rec: dict) -> None:
-    global _jsonl_file, _jsonl_path, _jsonl_checked
     with _lock:
         _ring.append(rec)
-        if not _jsonl_checked:
-            # first event decides the env-var default exactly once
-            _jsonl_path = os.environ.get("LASP_TELEMETRY_JSONL") or None
-            _jsonl_checked = True
-        if _jsonl_path is None:
-            return
-        try:
-            if _jsonl_file is None:
-                _jsonl_file = open(_jsonl_path, "a", buffering=1)
-            _jsonl_file.write(json.dumps(rec) + "\n")
-        except OSError as exc:
-            # a broken sink must not break the traced operation — disable
-            # it loudly ONCE instead of failing every span from now on
-            print(
-                f"lasp_tpu.telemetry: JSONL sink {_jsonl_path!r} failed "
-                f"({exc}); span logging to file disabled",
-                file=sys.stderr,
-            )
-            _jsonl_path = None
-            _jsonl_file = None
+    _sink.append(rec)
 
 
 @contextlib.contextmanager

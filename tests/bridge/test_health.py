@@ -1,0 +1,49 @@
+"""Bridge `{health}` verb: the ConvergenceMonitor state + alerts as a
+JSON binary, served before `{start, Name}` like `{metrics}`."""
+
+import json
+
+from lasp_tpu import telemetry
+from lasp_tpu.bridge import BridgeClient, BridgeServer
+from lasp_tpu.bridge.etf import Atom
+
+
+def test_health_verb_before_start():
+    telemetry.reset()
+    with BridgeServer(port=0) as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            resp = c.health()  # deliberately BEFORE start
+    assert isinstance(resp, tuple) and len(resp) == 2
+    assert str(resp[0]) == "ok"
+    health = json.loads(resp[1].decode())
+    for key in ("round", "residual_by_var", "staleness", "top_divergent",
+                "quiescence_eta", "alerts", "thresholds"):
+        assert key in health, key
+    assert isinstance(health["alerts"], list)
+
+
+def test_health_reflects_mesh_activity():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    telemetry.reset()
+    store = Store(n_actors=8)
+    v = store.declare(id="seen", type="lasp_gset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), 8, ring(8, 2))
+    rt.update_at(0, v, ("add", "x"), "w")
+    rounds = rt.run_to_convergence(max_rounds=16)
+    with BridgeServer(port=0) as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            resp = c.health()
+    health = json.loads(resp[1].decode())
+    assert health["round"] == rounds
+    assert health["residual_by_var"]["seen"] == 0
+    assert health["n_replicas"] == 8
+    # the health verb is metered like every other verb
+    with BridgeServer(port=0) as server:
+        with BridgeClient("127.0.0.1", server.port) as c:
+            c.health()
+            resp = c.call((Atom("metrics"),))
+    text = resp[1].decode()
+    assert 'bridge_requests_total{verb="health"}' in text

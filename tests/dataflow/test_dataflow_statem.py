@@ -194,7 +194,15 @@ class Oracle:
         )
 
 
-@pytest.mark.parametrize("seed", range(N_SEEDS))
+# test tiering (README "Test tiers"): half the seeds run in the quick
+# tier (`pytest -m "not slow"`), the rest in the slow soak tier
+@pytest.mark.parametrize(
+    "seed",
+    [
+        seed if seed < 4 else pytest.param(seed, marks=pytest.mark.slow)
+        for seed in range(N_SEEDS)
+    ],
+)
 def test_dataflow_statem(seed):
     rng = random.Random(seed)
     store = Store(n_actors=4)

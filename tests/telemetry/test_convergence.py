@@ -1,0 +1,201 @@
+"""ConvergenceMonitor tests: the hot residual feed (staleness, top-K,
+ETA), the on-demand lag probe (per-replica / per-shard), alerts, and
+the health snapshot the `{health}` verb serves."""
+
+import pytest
+
+from lasp_tpu import telemetry
+from lasp_tpu.telemetry import registry as R
+from lasp_tpu.telemetry.convergence import ConvergenceMonitor, get_monitor
+
+
+@pytest.fixture()
+def mon():
+    return ConvergenceMonitor()
+
+
+def test_observe_round_residual_and_staleness(mon):
+    mon.observe_round(("a", "b"), [4, 2], 0.01, n_replicas=8)
+    mon.observe_round(("a", "b"), [1, 0], 0.01)
+    mon.observe_round(("a", "b"), [1, 0], 0.01)
+    snap = mon.snapshot()
+    assert snap["round"] == 3
+    assert snap["residual_by_var"] == {"a": 1, "b": 0}
+    # b last changed at round 1 -> stale for 2 rounds; a changed this round
+    assert snap["staleness"] == {"a": 0, "b": 2}
+    assert snap["total_changes_by_var"] == {"a": 6, "b": 2}
+    assert mon.top_divergent() == [("a", 1), ("b", 0)]
+
+
+def test_quiescence_eta_contracting_and_not(mon):
+    mon.observe_round(("a",), [64])
+    mon.observe_round(("a",), [32])  # halving: eta = log2(32) = 5
+    assert mon.quiescence_eta() == 5
+    mon.observe_round(("a",), [32])  # stalled: no converging trend
+    assert mon.quiescence_eta() is None
+    mon.observe_round(("a",), [0])
+    assert mon.quiescence_eta() == 0
+
+
+def test_eta_unknown_after_opaque_unconverged_tail(mon):
+    # quiesce, then an opaque fused block that did NOT reach the fixed
+    # point: the stale zero must not read as "converged" (eta 0)
+    mon.observe_round(("a",), [4])
+    mon.observe_round(("a",), [0])
+    assert mon.quiescence_eta() == 0
+    mon.observe_opaque_rounds(8, quiescent=False)
+    assert mon.quiescence_eta() is None
+    assert mon.snapshot()["residual_total"] is None
+
+
+def test_probe_shard_split_handles_remainder():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=8)
+    v = store.declare(id="rem", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, Graph(store), 8, ring(8, 2))
+    rt.update_at(0, v, ("add", "x"), "w")
+    probe = ConvergenceMonitor().probe(rt, n_shards=3)
+    # 8 rows over 3 shards: near-equal blocks, never silently empty
+    assert len(probe["shard_lag"]) == 3
+    assert max(probe["shard_lag"]) == 1
+
+
+def test_alerts_accept_a_prebuilt_snapshot(mon):
+    mon.observe_round(("a",), [1], n_replicas=2)
+    snap = mon.snapshot()
+    assert mon.alerts(snap) == mon.alerts()
+
+
+def test_opaque_rounds_advance_the_clock(mon):
+    mon.observe_round(("a",), [5], n_replicas=4)
+    mon.observe_opaque_rounds(10, quiescent=True)
+    snap = mon.snapshot()
+    assert snap["round"] == 11
+    # a terminal quiescent marker zeroes the residual view
+    assert snap["residual_by_var"] == {"a": 0}
+    assert snap["quiescence_eta"] == 0
+
+
+def test_membership_observation(mon):
+    mon.observe_round(("a",), [1], n_replicas=8)
+    mon.observe_membership("leave_crash", 8, 5)
+    snap = mon.snapshot()
+    assert snap["n_replicas"] == 5
+    assert snap["memberships"] == [(1, "leave_crash", 8, 5)]
+    assert snap["probe"] is None  # a stale probe never survives a resize
+
+
+def test_stuck_alert_needs_divergence(mon):
+    mon.thresholds["max_stale_rounds"] = 3
+    mon.observe_round(("a",), [2], n_replicas=4)
+    for _ in range(4):
+        mon.observe_round(("a",), [0])
+    # residual 0 and no probe: quiescent-and-stale is NOT stuck
+    assert mon.alerts() == []
+    # a probe showing rows behind the join makes the same staleness stuck
+    mon.last_probe = {"lag_by_var": {"a": 2}, "worst_replica_lag": 2,
+                      "worst_replica": 1, "shard_lag": []}
+    alerts = mon.alerts()
+    assert any("stuck: a" in a for a in alerts)
+
+
+def test_replica_lag_alert_and_custom_alert(mon):
+    mon.thresholds["max_replica_lag"] = 1
+    mon.observe_round(("a",), [1], n_replicas=4)
+    mon.last_probe = {"lag_by_var": {"a": 3}, "worst_replica_lag": 3,
+                      "worst_replica": 2, "shard_lag": [0, 3]}
+    alerts = mon.alerts()
+    assert any("lagging: replica 2" in a for a in alerts)
+    mon.add_alert("custom-fire", lambda snap: snap["round"] >= 1)
+    assert "custom-fire" in mon.alerts()
+
+
+def test_unknown_threshold_is_loud():
+    with pytest.raises(TypeError, match="unknown alert thresholds"):
+        ConvergenceMonitor(thresholds={"max_stale": 3})
+
+
+def test_probe_per_replica_and_shard_lag():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=8)
+    v = store.declare(id="g", type="lasp_gset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), 8, ring(8, 2))
+    rt.update_at(0, v, ("add", "x"), "w0")
+    mon = ConvergenceMonitor()
+    probe = mon.probe(rt, n_shards=4)
+    # only replica 0 holds x: the other 7 are one variable behind
+    assert probe["lag_by_var"] == {"g": 7}
+    assert probe["worst_replica_lag"] == 1
+    assert probe["shard_lag"] == [1, 1, 1, 1]
+    assert probe["n_replicas"] == 8
+    # the probe lands in the snapshot and the gauges
+    assert mon.snapshot()["probe"]["lag_by_var"] == {"g": 7}
+    snap = R.get_registry().snapshot()
+    lag = {
+        s["labels"]["var"]: s["value"]
+        for s in snap["convergence_lag_replicas"]["series"]
+    }
+    assert lag["g"] == 7
+    shard = {
+        s["labels"]["shard"]: s["value"]
+        for s in snap["convergence_shard_lag"]["series"]
+    }
+    assert shard == {"0": 1, "1": 1, "2": 1, "3": 1}
+    rt.run_to_convergence(max_rounds=16)
+    probe2 = mon.probe(rt, n_shards=4)
+    assert probe2["worst_replica_lag"] == 0
+    assert probe2["shard_lag"] == [0, 0, 0, 0]
+
+
+def test_runtime_step_feeds_global_monitor():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    telemetry.reset()  # detach any state earlier tests accumulated
+    store = Store(n_actors=8)
+    v = store.declare(id="fed", type="lasp_gset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), 8, ring(8, 2))
+    rt.update_at(3, v, ("add", "x"), "w")
+    rounds = rt.run_to_convergence(max_rounds=16)
+    mon = get_monitor()
+    snap = mon.snapshot()
+    assert snap["round"] == rounds
+    assert snap["residual_by_var"]["fed"] == 0  # final round is quiescent
+    assert snap["n_replicas"] == 8
+    # the residual curve narrates the drain to the fixed point
+    assert [t for _r, t in snap["residual_curve"]][-1] == 0
+    # staleness gauges landed for the fed variable
+    reg = R.get_registry().snapshot()
+    assert any(
+        s["labels"] == {"var": "fed"}
+        for s in reg["convergence_staleness"]["series"]
+    )
+    # delivery events carry the round clock
+    from lasp_tpu.telemetry import events as E
+
+    deliveries = E.events(etype="delivery")
+    assert deliveries and deliveries[-1]["round"] == rounds
+
+
+def test_health_includes_alerts(mon):
+    mon.observe_round(("a",), [1], n_replicas=2)
+    h = mon.health()
+    assert "alerts" in h and h["round"] == 1
+
+
+def test_generation_reset_detaches_state():
+    mon = ConvergenceMonitor()
+    mon.observe_round(("a",), [3], n_replicas=4)
+    telemetry.reset()  # bumps the registry generation
+    mon.observe_round(("b",), [1], n_replicas=2)
+    snap = mon.snapshot()
+    # pre-reset state was dropped with the old generation's instruments
+    assert set(snap["residual_by_var"]) == {"b"}
+    assert snap["round"] == 1

@@ -1,0 +1,236 @@
+"""Causal event log tests: typing, bounding, ordering, sinks, export,
+and the concurrent-emitter discipline (one locked serialize-and-write
+per record — tests/telemetry/test_events.py::test_threaded_emitters
+is the stress test of the shared sink)."""
+
+import json
+import threading
+
+import pytest
+
+from lasp_tpu.telemetry import events as E
+from lasp_tpu.telemetry import registry as R
+from lasp_tpu.telemetry import spans as S
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    E.clear()
+    E.configure(jsonl_path="", ring_size=E.DEFAULT_RING_SIZE)
+    yield
+    E.clear()
+    E.configure(jsonl_path="", ring_size=E.DEFAULT_RING_SIZE)
+    E.set_deep(False)
+
+
+def test_unknown_event_type_is_loud():
+    with pytest.raises(ValueError, match="unknown event type"):
+        E.emit("definitely_not_a_type", var="x")
+
+
+def test_records_carry_provenance_and_order():
+    E.emit("bind", var="a", outcome="inflated")
+    E.set_round(7)
+    E.emit("update", var="a", replica=3, op="add")
+    evs = E.events()
+    assert [e["etype"] for e in evs] == ["bind", "update"]
+    assert evs[0]["seq"] < evs[1]["seq"]
+    assert evs[1]["round"] == 7
+    assert evs[1]["replica"] == 3
+    assert evs[1]["attrs"]["op"] == "add"
+    # filtered views
+    assert [e["etype"] for e in E.events(etype="update")] == ["update"]
+    assert E.events(var="a", etype="bind")[0]["attrs"]["outcome"] == "inflated"
+
+
+def test_ring_bounds_and_counts_drops():
+    E.configure(ring_size=4)
+    for i in range(10):
+        E.emit("update", var="v", i=i)
+    st = E.stats()
+    assert st["ring"] == 4
+    assert st["dropped"] == 6
+    assert [e["attrs"]["i"] for e in E.events()] == [6, 7, 8, 9]
+
+
+def test_disabled_registry_silences_the_log():
+    R.set_enabled(False)
+    try:
+        E.emit("bind", var="x")
+    finally:
+        R.set_enabled(True)
+    assert E.events() == []
+
+
+def test_deep_tier_off_by_default():
+    E.emit_deep("merge", var="x", type="lasp_orset")
+    assert E.events() == []
+    E.set_deep(True)
+    try:
+        E.emit_deep("merge", var="x", type="lasp_orset")
+    finally:
+        E.set_deep(False)
+    assert [e["etype"] for e in E.events()] == ["merge"]
+
+
+def test_jsonl_sink(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    E.configure(jsonl_path=path)
+    E.emit("membership", kind="join", old_n=4, new_n=8)
+    E.configure(jsonl_path="")  # close
+    [line] = open(path).read().splitlines()
+    rec = json.loads(line)
+    assert rec["etype"] == "membership"
+    assert rec["attrs"]["new_n"] == 8
+
+
+def test_chrome_trace_export_is_valid(tmp_path):
+    E.emit("update", var="a", replica=1, op="add")
+    with S.span("gossip.round"):
+        E.emit("delivery", residual=2)
+    path = tmp_path / "trace.json"
+    with open(path, "w") as fp:
+        n = E.export_chrome_trace(fp)
+    doc = json.loads(path.read_text())
+    assert isinstance(doc["traceEvents"], list) and len(doc["traceEvents"]) == n
+    cats = {t["cat"] for t in doc["traceEvents"]}
+    assert cats == {"event", "span"}
+    for t in doc["traceEvents"]:
+        assert {"name", "ph", "ts", "pid", "tid"} <= set(t)
+        assert t["ph"] in ("X", "i")
+        if t["ph"] == "X":
+            assert t["dur"] >= 0
+    # the instant event carries its provenance columns
+    inst = [t for t in doc["traceEvents"] if t["name"] == "update"][0]
+    assert inst["args"]["var"] == "a"
+    assert inst["args"]["replica"] == 1
+
+
+def test_causal_history_walks_lineage():
+    E.emit("update", var="src", op="add")
+    E.emit("update", var="unrelated", op="add")
+    E.emit("membership", kind="join", old_n=2, new_n=4)
+    E.emit("bind", var="derived", outcome="inflated")
+    lineage = {"derived": {"kinds": ["map"], "srcs": ["src"]}}
+    hist = E.causal_history("derived", lineage)
+    assert [e.get("var", e["etype"]) for e in hist] == [
+        "src", "membership", "derived",
+    ]
+    seqs = [e["seq"] for e in hist]
+    assert seqs == sorted(seqs)
+
+
+def test_threaded_emitters_never_interleave_records(tmp_path):
+    """Satellite: spans + events from concurrent threads (the mesh
+    batch-dispatch / bridge-connection shape) — every JSONL line must
+    parse, every record must arrive, and the event ring's seq must be
+    gap-free. Before the shared-sink lock, concurrent writers could
+    interleave partial lines."""
+    epath = str(tmp_path / "ev.jsonl")
+    spath = str(tmp_path / "sp.jsonl")
+    E.configure(jsonl_path=epath, ring_size=100_000)
+    S.configure(jsonl_path=spath)
+    n_threads, per_thread = 8, 200
+    barrier = threading.Barrier(n_threads)
+
+    def worker(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            # long attrs make torn writes likely without the lock
+            E.emit("update", var=f"v{tid}", replica=tid,
+                   payload="x" * 64, i=i)
+            with S.span(f"t{tid}", i=i, pad="y" * 64):
+                pass
+
+    threads = [
+        threading.Thread(target=worker, args=(t,)) for t in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    E.configure(jsonl_path="")
+    S.configure(jsonl_path="")
+    total = n_threads * per_thread
+    for path, expect in ((epath, total), (spath, total)):
+        lines = open(path).read().splitlines()
+        assert len(lines) >= expect  # other tests may not have appended
+        parsed = [json.loads(line) for line in lines]  # raises on a torn line
+        assert len(parsed) == len(lines)
+    evs = E.events(etype="update")
+    mine = [e for e in evs if e["attrs"].get("payload", "").startswith("x")]
+    assert len(mine) == total
+    # per-thread arrival order is preserved under the global seq
+    for tid in range(n_threads):
+        tids = [e["attrs"]["i"] for e in mine if e["var"] == f"v{tid}"]
+        assert tids == sorted(tids)
+    seqs = sorted(e["seq"] for e in mine)
+    assert len(set(seqs)) == total  # no duplicated seq
+
+
+def test_event_types_match_catalog_lint():
+    """The lint's STATIC parse of EVENT_TYPES must agree with the live
+    set (a refactor moving the declaration would silently blind the
+    catalog check)."""
+    import importlib.util
+    import os
+
+    tool = os.path.join(
+        os.path.dirname(__file__), "..", "..", "tools",
+        "check_metrics_catalog.py",
+    )
+    spec = importlib.util.spec_from_file_location("catalog_lint", tool)
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    assert lint.declared_event_types() == set(E.EVENT_TYPES)
+
+
+def test_batch_fallback_emits_one_coarse_update(tmp_path):
+    """update_batch's per-op update_at fallback must log ONE coarse
+    'update' record for the whole batch, not one per op (the
+    one-coarse-record-per-batch rule)."""
+    import warnings
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=8)
+    # a map embedding an orset field has no vectorized batch kernel:
+    # update_batch falls back to per-op update_at
+    m = store.declare(
+        id="m", type="riak_dt_map",
+        fields=[("s", "lasp_orset", {"n_elems": 4})],
+    )
+    rt = ReplicatedRuntime(store, Graph(store), 4, ring(4, 2))
+    E.clear()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rt.update_batch(m, [
+            (0, ("update", "s", ("add", f"x{i}")), "w0") for i in range(3)
+        ])
+    coarse = E.events(etype="update", var=m)
+    assert len(coarse) == 1, coarse
+    assert coarse[0]["attrs"]["ops"] == 3
+    assert not rt._suppress_op_events  # flag never leaks past the batch
+
+
+def test_sink_survives_unserializable_record(tmp_path, capsys):
+    from lasp_tpu.telemetry.sink import JsonlSink
+
+    path = str(tmp_path / "s.jsonl")
+    sink = JsonlSink()
+    sink.configure(path)
+    loop: dict = {}
+    loop["self"] = loop  # circular: json.dumps raises even with default=
+    sink.append({"kind": "event", "bad": loop})  # must not raise
+    sink.append({"kind": "event", "ok": 1})
+    lines = open(path).read().splitlines()
+    assert len(lines) == 1  # bad record dropped, sink still live
+    assert json.loads(lines[0])["ok"] == 1
+
+
+def test_stats_surface():
+    E.emit("bind", var="x")
+    st = E.stats()
+    assert st["seq"] == 1 and st["ring"] == 1 and st["deep"] is False

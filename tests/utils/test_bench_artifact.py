@@ -1,0 +1,43 @@
+"""Bench-artifact content tests: the CPU-fallback `at_scale` fold-in
+(the driver artifact must never understate the engine) and the headline
+scenario's convergence narration."""
+
+import importlib.util
+import os
+
+
+def _load_bench():
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "..", "bench.py"
+    )
+    spec = importlib.util.spec_from_file_location("bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_at_scale_evidence_folds_target_scales():
+    bench = _load_bench()
+    ev = bench._load_at_scale_evidence()
+    assert ev is not None, "docs/artifacts/cpu_evidence_*.jsonl must load"
+    assert "note" in ev and ev["runs"]
+    scenarios = {r["scenario"] for r in ev["runs"]}
+    # the target-scale ladder: 100K gossip, 1M pipeline, 10M ad counter
+    assert {"orset_100000", "pipeline_1048576",
+            "adcounter_10485760"} <= scenarios
+    # every folded run is labeled evidence, never an error record
+    assert all("error" not in r for r in ev["runs"])
+
+
+def test_headline_scenario_narrates_convergence():
+    from lasp_tpu.bench_scenarios import orset_anti_entropy
+
+    out = orset_anti_entropy(256, block=4)
+    conv = out["convergence"]
+    assert conv["rounds_to_quiescence"] == out["rounds"]
+    # the per-block productive curve sums to the exact round count
+    assert sum(conv["productive_rounds_per_block"]) == out["rounds"]
+    assert conv["block"] == 4
+    # every replica but at most one starts behind the global join
+    assert conv["diverged_replicas_at_seed"] > 0
+    assert conv["worst_replica_lag_at_seed"] == 1

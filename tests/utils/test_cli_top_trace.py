@@ -1,0 +1,118 @@
+"""CLI tests for the convergence observatory verbs: ``lasp_tpu top``
+(live per-variable residual/staleness table + shard lag + alerts
+against a running mesh) and ``lasp_tpu trace --var --export``
+(Perfetto/Chrome-trace causal history through a combinator edge)."""
+
+import json
+
+import pytest
+
+from lasp_tpu import cli
+from lasp_tpu import telemetry
+from lasp_tpu.telemetry import events as E
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    telemetry.reset()
+    E.clear()
+    yield
+    telemetry.reset()
+    E.clear()
+
+
+def test_cli_top_renders_live_mesh(capsys):
+    rc = cli.main([
+        "top", "--replicas", "16", "--iterations", "3",
+        "--refresh", "0", "--shards", "4",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    frames = [f for f in out.split("---") if f.strip()]
+    assert len(frames) == 3
+    # the table names every workload variable with residual/stale/lag
+    for var in ("ads", "seen_ads", "hits"):
+        assert var in frames[0]
+    assert "RESIDUAL" in frames[0] and "STALE" in frames[0]
+    assert "shard lag: s0=" in frames[0] and "s3=" in frames[0]
+    assert "worst replica:" in frames[0]
+    # the observed mesh steps between frames: the round counter advances
+    rounds = [
+        int(line.split("round=")[1].split()[0])
+        for line in out.splitlines()
+        if line.startswith("convergence: round=")
+    ]
+    assert rounds == sorted(rounds) and rounds[0] < rounds[-1]
+
+
+def test_cli_top_rejects_degenerate_population(capsys):
+    assert cli.main(["top", "--replicas", "1", "--iterations", "1"]) == 2
+
+
+def test_cli_top_bridge_scrape(capsys):
+    from lasp_tpu.bridge import BridgeServer
+
+    with BridgeServer(port=0) as server:
+        rc = cli.main([
+            "top", "--bridge", f"127.0.0.1:{server.port}",
+            "--iterations", "1", "--refresh", "0",
+        ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "convergence: round=" in out
+    assert "alerts: none" in out or "ALERT" in out
+
+
+def test_cli_trace_exports_chrome_json(tmp_path, capsys):
+    path = str(tmp_path / "trace.json")
+    rc = cli.main([
+        "trace", "--var", "seen_ads", "--export", path,
+        "--replicas", "16",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["var"] == "seen_ads"
+    # the lineage walks the map edge back to the source variable
+    assert summary["lineage"] == {"seen_ads": ["ads"]}
+    assert summary["events"] > 0
+    doc = json.loads(open(path).read())
+    evs = doc["traceEvents"]
+    assert evs and all(
+        {"name", "ph", "ts", "pid", "tid"} <= set(t) for t in evs
+    )
+    assert {t["ph"] for t in evs} <= {"X", "i"}
+    # the causal history reaches the SOURCE updates through the edge
+    updates = [
+        t for t in evs
+        if t["cat"] == "event" and t["name"] == "update"
+    ]
+    assert any(t["args"].get("var") == "ads" for t in updates)
+    # population context (deliveries) rides along, ordered by ts
+    assert any(t["name"] == "delivery" for t in evs)
+    ts = [t["ts"] for t in evs]
+    assert ts == sorted(ts)
+
+
+def test_cli_trace_deep_carries_edge_provenance(tmp_path, capsys):
+    path = str(tmp_path / "deep.json")
+    rc = cli.main([
+        "trace", "--var", "seen_ads", "--export", path,
+        "--replicas", "8", "--deep",
+    ])
+    E.set_deep(False)
+    assert rc == 0
+    doc = json.loads(open(path).read())
+    recomputes = [
+        t for t in doc["traceEvents"] if t["name"] == "edge_recompute"
+    ]
+    assert recomputes, "deep trace must carry edge provenance"
+    assert recomputes[0]["args"]["var"] == "seen_ads"
+    assert recomputes[0]["args"]["srcs"] == ["ads"]
+
+
+def test_cli_trace_unknown_var(tmp_path, capsys):
+    rc = cli.main([
+        "trace", "--var", "nope", "--export", str(tmp_path / "x.json"),
+        "--replicas", "8",
+    ])
+    assert rc == 2

@@ -1,13 +1,26 @@
 #!/usr/bin/env python3
-"""Metric-catalog lint: code and docs/OBSERVABILITY.md must agree.
+"""Telemetry-catalog lint: code and docs/OBSERVABILITY.md must agree.
 
-Every metric emitted anywhere under ``lasp_tpu/`` (a literal first
-argument to ``counter(...)`` / ``gauge(...)`` / ``histogram(...)``)
-must have a row in the catalog table of ``docs/OBSERVABILITY.md``, and
-every cataloged name must still be emitted somewhere — drift in either
-direction fails the Makefile ``verify`` target. This is what makes the
-metric key set a STABLE interface across PRs (dashboards and the bridge
-scrape consumers depend on it).
+Three interfaces, one doc, linted BOTH ways (drift in either direction
+fails the Makefile ``verify`` target):
+
+- **metrics** — every literal first argument to ``counter(...)`` /
+  ``gauge(...)`` / ``histogram(...)`` under ``lasp_tpu/`` must have a
+  row in the doc's "Metric catalog" table, and every cataloged name
+  must still be emitted somewhere;
+- **event types** — every literal first argument to ``events.emit(...)``
+  / ``events.emit_deep(...)`` must have a row in the "Event catalog"
+  table, and vice versa (plus: every cataloged event type must be a
+  member of ``telemetry.events.EVENT_TYPES`` — parsed statically, no
+  imports);
+- **span names** — every literal ``span("...")`` name must match a row
+  of the "Span taxonomy" table; dynamic spans (``span(f"merge.{...}")``)
+  are checked by their literal prefix against templated rows like
+  ``merge.<crdt_type>``. Every cataloged span row must still have an
+  emission site.
+
+Dynamic metric/event names are invisible to this lint and therefore
+forbidden by convention (docs/OBSERVABILITY.md).
 
 Zero dependencies, stdlib only; exits 0 on agreement, 1 on drift.
 """
@@ -23,60 +36,202 @@ SRC = os.path.join(REPO, "lasp_tpu")
 DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
 #: a literal metric emission: counter("name"... / gauge('name'... /
-#: histogram("name"... — dynamic names are invisible to this lint and
-#: therefore forbidden by convention (docs/OBSERVABILITY.md)
-_EMIT = re.compile(
+#: histogram("name"...
+_EMIT_METRIC = re.compile(
     r"""\b(?:counter|gauge|histogram)\(\s*['"]([a-z][a-z0-9_]*)['"]"""
 )
 
+#: a literal event emission: events.emit("type"... / events.emit_deep(
+#: "type"... (matches the tel_events/_events aliases too)
+_EMIT_EVENT = re.compile(
+    r"""events\.emit(?:_deep)?\(\s*['"]([a-z][a-z0-9_]*)['"]"""
+)
+
+#: span sites: a literal name, or an f-string's literal prefix up to the
+#: first interpolation (span(f"merge.{t}") -> "merge.")
+_SPAN_LITERAL = re.compile(r"""\bspan\(\s*['"]([a-z][a-z0-9_.]*)['"]""")
+_SPAN_FPREFIX = re.compile(r"""\bspan\(\s*f['"]([a-z][a-z0-9_.]*)\{""")
+
 #: a catalog row: a markdown table line whose first cell is `name`
-_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_]*)`\s*\|")
+_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_.<>]*)`\s*\|")
+
+#: EVENT_TYPES members in telemetry/events.py: "name",  # comment
+_EVENT_TYPE_DECL = re.compile(r"""^\s*['"]([a-z][a-z0-9_]*)['"],""")
 
 
-def emitted_names() -> set:
-    names: set = set()
+def _walk_sources():
     for root, _dirs, files in os.walk(SRC):
         for f in files:
-            if not f.endswith(".py"):
+            if f.endswith(".py"):
+                with open(os.path.join(root, f), encoding="utf-8") as fp:
+                    yield fp.read()
+
+
+def emitted() -> dict:
+    """{"metrics": set, "events": set, "span_literals": set,
+    "span_prefixes": set} found in code."""
+    out = {
+        "metrics": set(), "events": set(),
+        "span_literals": set(), "span_prefixes": set(),
+    }
+    for text in _walk_sources():
+        out["metrics"].update(_EMIT_METRIC.findall(text))
+        out["events"].update(_EMIT_EVENT.findall(text))
+        out["span_literals"].update(_SPAN_LITERAL.findall(text))
+        out["span_prefixes"].update(_SPAN_FPREFIX.findall(text))
+    return out
+
+
+def declared_event_types() -> set:
+    """EVENT_TYPES members, parsed statically from telemetry/events.py."""
+    path = os.path.join(SRC, "telemetry", "events.py")
+    names: set = set()
+    with open(path, encoding="utf-8") as fp:
+        in_block = False
+        for line in fp:
+            if "EVENT_TYPES = frozenset({" in line:
+                in_block = True
                 continue
-            with open(os.path.join(root, f), encoding="utf-8") as fp:
-                names.update(_EMIT.findall(fp.read()))
+            if in_block:
+                if line.strip().startswith("})"):
+                    break
+                m = _EVENT_TYPE_DECL.match(line)
+                if m:
+                    names.add(m.group(1))
     return names
 
 
-def cataloged_names() -> set:
+def cataloged() -> dict:
+    """Doc rows per section: {"metrics": set, "events": set,
+    "spans": set} — section-aware so `bind` the event type can never be
+    confused with a metric row."""
     if not os.path.exists(DOC):
         print(f"check_metrics_catalog: {DOC} does not exist", file=sys.stderr)
         sys.exit(1)
-    names: set = set()
+    section = None
+    out = {"metrics": set(), "events": set(), "spans": set()}
     with open(DOC, encoding="utf-8") as fp:
         for line in fp:
+            if line.startswith("##"):
+                title = line.lstrip("#").strip().lower()
+                if "metric catalog" in title:
+                    section = "metrics"
+                elif "event catalog" in title:
+                    section = "events"
+                elif "span taxonomy" in title:
+                    section = "spans"
+                else:
+                    section = None
+                continue
+            if section is None:
+                continue
             m = _ROW.match(line.strip())
             if m:
-                names.add(m.group(1))
-    return names
+                out[section].add(m.group(1))
+    return out
+
+
+def _span_doc_matches(name: str, doc_spans: set) -> bool:
+    """A code span name (literal or f-prefix ending in '.') matches a doc
+    row exactly, or a templated row (`merge.<crdt_type>`) by the part
+    before '<'."""
+    if name in doc_spans:
+        return True
+    for row in doc_spans:
+        prefix = row.split("<", 1)[0]
+        if "<" in row and name.startswith(prefix):
+            return True
+        if name.endswith(".") and row.startswith(name):
+            return True
+    return False
+
+
+def _span_code_matches(row: str, code: dict) -> bool:
+    """A doc span row still has some emission site."""
+    if row in code["span_literals"]:
+        return True
+    prefix = row.split("<", 1)[0]
+    for p in code["span_prefixes"]:
+        if p == prefix or row.startswith(p):
+            return True
+    for lit in code["span_literals"]:
+        if "<" in row and lit.startswith(prefix):
+            return True
+    return False
 
 
 def main() -> int:
-    code = emitted_names()
-    docs = cataloged_names()
-    missing_doc = sorted(code - docs)
-    missing_code = sorted(docs - code)
+    code = emitted()
+    docs = cataloged()
+    problems: list[str] = []
+
+    missing_doc = sorted(code["metrics"] - docs["metrics"])
     if missing_doc:
-        print(
+        problems.append(
             "metrics emitted in code but MISSING from the "
-            "docs/OBSERVABILITY.md catalog:\n  "
+            "docs/OBSERVABILITY.md Metric catalog:\n  "
             + "\n  ".join(missing_doc)
         )
-    if missing_code:
-        print(
+    stale_doc = sorted(docs["metrics"] - code["metrics"])
+    if stale_doc:
+        problems.append(
             "metrics cataloged in docs/OBSERVABILITY.md but emitted "
             "NOWHERE in lasp_tpu/ (stale rows):\n  "
-            + "\n  ".join(missing_code)
+            + "\n  ".join(stale_doc)
         )
-    if missing_doc or missing_code:
+
+    ev_missing_doc = sorted(code["events"] - docs["events"])
+    if ev_missing_doc:
+        problems.append(
+            "event types emitted in code but MISSING from the Event "
+            "catalog:\n  " + "\n  ".join(ev_missing_doc)
+        )
+    ev_stale = sorted(docs["events"] - code["events"])
+    if ev_stale:
+        problems.append(
+            "event types cataloged but emitted nowhere (stale rows):\n  "
+            + "\n  ".join(ev_stale)
+        )
+    declared = declared_event_types()
+    undeclared = sorted(docs["events"] - declared)
+    if undeclared:
+        problems.append(
+            "event types cataloged but absent from "
+            "telemetry.events.EVENT_TYPES:\n  " + "\n  ".join(undeclared)
+        )
+    untabled = sorted(declared - docs["events"])
+    if untabled:
+        problems.append(
+            "EVENT_TYPES members missing from the Event catalog:\n  "
+            + "\n  ".join(untabled)
+        )
+
+    span_missing_doc = sorted(
+        n for n in code["span_literals"] | code["span_prefixes"]
+        if not _span_doc_matches(n, docs["spans"])
+    )
+    if span_missing_doc:
+        problems.append(
+            "span names emitted in code but MISSING from the Span "
+            "taxonomy:\n  " + "\n  ".join(span_missing_doc)
+        )
+    span_stale = sorted(
+        row for row in docs["spans"] if not _span_code_matches(row, code)
+    )
+    if span_stale:
+        problems.append(
+            "span rows cataloged but emitted nowhere (stale rows):\n  "
+            + "\n  ".join(span_stale)
+        )
+
+    if problems:
+        print("\n".join(problems))
         return 1
-    print(f"metrics catalog OK ({len(code)} metrics, code == docs)")
+    print(
+        f"telemetry catalog OK ({len(code['metrics'])} metrics, "
+        f"{len(code['events'])} event types, "
+        f"{len(docs['spans'])} span rows; code == docs)"
+    )
     return 0
 
 
