@@ -398,6 +398,18 @@ def _child(label: str) -> int:
         "convergence": out.get("convergence"),
     }
 
+    # -- frontier-vs-dense sparse-update arm (~seconds): dirty-set
+    # scheduling's home regime — <5% of replicas written, both arm
+    # timings recorded in the scenario's own impl_block_seconds; the
+    # headline above is the dense-regime guard (no regression from
+    # frontier bookkeeping: the packed anti-entropy path is untouched) --
+    try:
+        from lasp_tpu.bench_scenarios import frontier_sparse
+
+        detail["frontier_sparse"] = frontier_sparse()
+    except Exception as exc:
+        detail["frontier_sparse"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- north-star: 10M-replica engine-path ad counter ---------------------
     ns0 = cfg.bench_northstar_replicas or (
         10 * (1 << 20) if on_tpu else (1 << 13)

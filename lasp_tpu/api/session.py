@@ -38,6 +38,12 @@ from ..store import Store, Watch
 from ..telemetry import counter, get_monitor, render_prometheus
 
 
+#: one-time flag for the locality-renumbering note (see
+#: Session.replicate): emitted at the FIRST reordering replicate of the
+#: process, not per call — it is a heads-up, not an error
+_locality_note_emitted = False
+
+
 def _count_verb(verb: str) -> None:
     counter(
         "session_ops_total",
@@ -211,6 +217,23 @@ class Session:
             neighbors = builder()
             if locality and topology != "ring":
                 perm, neighbors = locality_order(neighbors)
+                global _locality_note_emitted
+                if not _locality_note_emitted:
+                    _locality_note_emitted = True
+                    import warnings
+
+                    warnings.warn(
+                        "Session.replicate(locality=True) renumbers the "
+                        f"{topology!r} topology's replica indices (a graph "
+                        "isomorphism that keeps sharded gossip's cut "
+                        "small); experiments keyed to the raw builder's "
+                        "indices (e.g. scale_free hubs at low ids) must "
+                        "translate through rt.locality_perm, or pass "
+                        "locality=False. This note prints once per "
+                        "process (docs/GUIDE.md §replication).",
+                        UserWarning,
+                        stacklevel=2,
+                    )
         rt = ReplicatedRuntime(
             self.store, self.graph, n_replicas, neighbors,
             packed=packed, **kwargs,

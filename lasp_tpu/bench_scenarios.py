@@ -653,6 +653,179 @@ def _adcounter_10m_impl(n_replicas: int, threshold: int) -> dict:
     }
 
 
+def frontier_sparse(
+    n_replicas: int = 1 << 13,
+    fanout: int = 3,
+    write_frac: float = 0.02,
+    n_elems: int = 256,
+    n_vars: int = 8,
+    write_vars: int = 2,
+    block: int = 4,
+    seed: int = 13,
+) -> dict:
+    """Sparse-update convergence A/B — the regime frontier (dirty-set)
+    scheduling exists for (the ISSUE-3 motivation: the reference's
+    anti-entropy only repairs replicas OBSERVED divergent,
+    ``src/lasp_update_fsm.erl:189-216``, while dense bulk-synchronous
+    rounds gather and join the entire store every round): a store of
+    ``n_vars`` variables where only ``write_vars`` receive client
+    writes, and those at under 5% of replicas (``write_frac``) — the
+    steady state of any real deployment, where most variables are
+    quiescent at any instant. The population re-converges twice from
+    identical seeds: once with the dense scheduler (fused blocks — every
+    variable, every replica, every round) and once with the frontier
+    engine (``run_to_convergence(mode="frontier")`` — untouched
+    variables are skipped outright, touched ones gather/join only rows
+    reachable from the dirty set, with the dense-crossover fallback).
+
+    Both arms are timed WARM over best-of replays (a cold pass compiles
+    every executable, then states + frontier restore from a snapshot
+    and the identical schedule replays); the frontier arm additionally
+    AUTOTUNES its crossover (measured break-even density, the
+    pallas-vs-xla measure-then-ship move) and re-times. Both arm
+    timings land in ``impl_block_seconds``, and the arms' fixed points
+    are checked bit-identical across every variable."""
+    import jax
+    import jax.numpy as jnp
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.store import Store
+
+    n_writes = max(1, int(write_frac * n_replicas))
+    write_vars = min(write_vars, n_vars)
+    nbrs = random_regular(n_replicas, fanout, seed=seed)
+
+    def build() -> "tuple[ReplicatedRuntime, list]":
+        store = Store(n_actors=4)
+        graph = Graph(store)
+        ids = [
+            store.declare(id=f"v{i}", type="lasp_gset", n_elems=n_elems)
+            for i in range(n_vars)
+        ]
+        rt = ReplicatedRuntime(store, graph, n_replicas, nbrs)
+        rng = np.random.RandomState(seed)
+        for v in ids[:write_vars]:
+            rows = rng.choice(n_replicas, size=n_writes, replace=False)
+            rt.update_batch(
+                v,
+                [
+                    (int(r), ("add", f"w{int(r) % 8}"), f"client{int(r)}")
+                    for r in rows
+                ],
+            )
+        return rt, ids
+
+    def snapshot(rt):
+        return (
+            {k: jax.tree_util.tree_map(jnp.array, st)
+             for k, st in rt.states.items()},
+            {k: m.copy() for k, m in rt._frontier.items()},
+        )
+
+    def restore(rt, snap):
+        states, frontier = snap
+        for k, st in states.items():
+            rt.states[k] = jax.tree_util.tree_map(jnp.array, st)
+        rt._frontier = {k: m.copy() for k, m in frontier.items()}
+
+    def timed_rep(rt, ids, run):
+        """One measured replay from the snapshot (states + frontier
+        restored first by the caller)."""
+        rows_before = getattr(rt, "frontier_rows_total", 0)
+        rounds, secs = _timed(run)
+        jax.block_until_ready([rt.states[v] for v in ids])
+        return secs, rounds, (
+            getattr(rt, "frontier_rows_total", 0) - rows_before
+        )
+
+    results = {}
+    finals = {}
+    autotuned = None
+    for arm in ("dense", "frontier"):
+        rt, ids = build()
+        snap = snapshot(rt)
+        run = (
+            (lambda: rt.run_to_convergence(block=block))
+            if arm == "dense"
+            else (lambda: rt.run_to_convergence(mode="frontier"))
+        )
+        cold_rounds = run()  # compiles every executable in the schedule
+        reps = []
+        for _ in range(2):  # best-of-2 warm replays (loaded-host noise)
+            restore(rt, snap)
+            secs, rounds, rows = timed_rep(rt, ids, run)
+            assert rounds == cold_rounds  # identical replayed schedule
+            reps.append((secs, rounds, rows))
+        if arm == "frontier":
+            # AUTOTUNE: measured break-even frontier density — dense
+            # per-round per-var cost over frontier per-row cost (the
+            # pallas-vs-xla move: measure, then ship the winner's
+            # setting). One untimed replay compiles any fresh bucket the
+            # re-scheduled run needs, then a timed replay competes with
+            # the default-crossover reps.
+            secs, _r, rows = min(reps)
+            d_row = results["dense"]["seconds"] / max(
+                cold_rounds * n_replicas * n_vars, 1
+            )
+            if rows:
+                autotuned = round(min(1.0, d_row / (secs / rows)), 4)
+                rt.frontier_crossover = autotuned
+                restore(rt, snap)
+                run()  # untimed: compile the re-scheduled kernels
+                restore(rt, snap)
+                reps.append(timed_rep(rt, ids, run))
+        secs, rounds, rows = min(reps)
+        results[arm] = {
+            "seconds": secs, "rounds": rounds, "rows_touched": rows,
+        }
+        assert all(rt.divergence(v) == 0 for v in ids)
+        finals[arm] = (
+            {v: jax.tree_util.tree_map(np.asarray, rt.states[v])
+             for v in ids},
+            {v: rt.coverage_value(v) for v in ids},
+        )
+        del rt
+
+    # property check at the bench shape: the two schedulers land the
+    # SAME per-replica states for EVERY variable, not just the same
+    # decoded values
+    assert finals["dense"][1] == finals["frontier"][1]
+    same = jax.tree_util.tree_map(
+        lambda a, b: bool(np.array_equal(a, b)),
+        finals["dense"][0], finals["frontier"][0],
+    )
+    assert all(jax.tree_util.tree_leaves(same)), "arm states diverged"
+
+    dense_s, frontier_s = (
+        results["dense"]["seconds"], results["frontier"]["seconds"],
+    )
+    rows = results["frontier"]["rows_touched"]
+    chosen = "frontier" if frontier_s <= dense_s else "dense"
+    return {
+        "scenario": f"frontier_sparse_{n_replicas}",
+        "n_replicas": n_replicas,
+        "n_vars": n_vars,
+        "write_vars": write_vars,
+        "write_density": round(n_writes / n_replicas, 4),
+        "fanout": fanout,
+        "rounds": results["frontier"]["rounds"],
+        "frontier_rows_touched": rows,
+        "dense_rows_touched": (
+            results["dense"]["rounds"] * n_replicas * n_vars
+        ),
+        "impl_block_seconds": {
+            "dense": round(dense_s, 6),
+            "frontier": round(frontier_s, 6),
+        },
+        "gossip_impl": chosen,
+        "frontier_speedup": round(dense_s / frontier_s, 2),
+        "autotuned_crossover": autotuned,
+        "engine": "ReplicatedRuntime(frontier_step)",
+        "check": "fixed points bit-identical across schedulers",
+    }
+
+
 def packed_vs_dense(n_replicas: int = 1 << 20, blocks: int = 4, block: int = 8) -> dict:
     """Same engine workload (OR-Set source + map edge + random gossip),
     identical seeds and round counts, run twice: dense codec state vs the
@@ -930,4 +1103,5 @@ SCENARIOS = {
     "packed_vs_dense": packed_vs_dense,
     "bridge_throughput": bridge_throughput,
     "partitioned_gossip": partitioned_gossip,
+    "frontier_sparse": frontier_sparse,
 }

@@ -451,7 +451,14 @@ def _validate_portable(var, portable: Any, _pending=None) -> None:
             # Fresh NESTED map triples take their temp shim's spec at
             # commit time — the temp shims' own pending growth runs
             # first (appended during the inner frames), so nested
-            # subfields are already folded in
+            # subfields are already folded in.
+            # NOTE: this grows the STORE variable's spec directly — a
+            # ReplicatedRuntime built over the same store still holds
+            # population planes for the old field axis. That skew is
+            # resolved lazily: the runtime's `_population` re-checks
+            # spec/state field-axis agreement on every verb and
+            # re-lays-out (bottom planes, observably a no-op) the next
+            # time anything touches the variable.
             def _commit_fresh(
                 var=var,
                 keys=[k for (k, _c, _e) in fresh],

@@ -20,6 +20,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..lattice.orset import ORSetSpec
 from ..lattice.gset import GSetSpec
@@ -87,6 +88,14 @@ class Graph:
         self._round_fn_pure = None  # un-jitted round, vmapped by the mesh layer
         self._var_ids: tuple = ()
         self._clean_mark: tuple | None = None  # (store.mutations, n_edges)
+        #: frontier scheduling over edges: has edge i contributed at
+        #: least once since the last _build? (a never-run edge is always
+        #: eligible); plus the per-eligible-subset jit cache
+        self._edge_ran: list = []
+        self._subset_fns: dict = {}
+        #: store.mutations value whose writes this graph has fully
+        #: propagated — feeds Store.dirty_since for the initial frontier
+        self._dirty_cursor: int = 0
 
     # -- derived-output declaration -----------------------------------------
     def _derived_orset_spec(self, n_elems: int, token_space: int) -> ORSetSpec:
@@ -341,12 +350,74 @@ class Graph:
 
         self._round_fn_pure = round_fn
         self._jitted = jax.jit(round_fn)
+        # frontier bookkeeping starts over: every edge owes one run
+        # against the rebuilt tables/universes
+        self._edge_ran = [False] * len(edges)
+        self._subset_fns = {}
+
+    def _subset_round(self, idx: tuple):
+        """Jitted sweep over ONLY the edges named by ``idx`` (indices into
+        ``self.edges``) — the frontier-scheduled round: skipped edges'
+        contributions are unchanged since their last run and already
+        merged into their dst (idempotent join), so re-evaluating them is
+        pure waste. Returns ``(fn, dst_order)`` where ``fn(states,
+        tables) -> (new_states, changed: bool[len(dst_order)])`` — the
+        per-dst change flags seed the next round's dirty set."""
+        cached = self._subset_fns.get(idx)
+        if cached is not None:
+            return cached
+        # bounded: distinct dirty patterns each compile an executable; a
+        # long-lived process alternating write sets must not accumulate
+        # them without limit (FIFO eviction — dicts preserve insertion
+        # order, and a re-compile after eviction is just a warm retrace)
+        if len(self._subset_fns) >= 64:
+            self._subset_fns.pop(next(iter(self._subset_fns)))
+        sel = [(i, self.edges[i]) for i in idx]
+        dst_order: list = []
+        for _i, e in sel:
+            if e.dst not in dst_order:
+                dst_order.append(e.dst)
+        meta = {v: self._meta(v) for v in dst_order}
+
+        def round_fn(states, tables):
+            contribs: dict[str, list] = {}
+            for i, e in sel:
+                c = e.contribution(tables[i], *[states[s] for s in e.srcs])
+                contribs.setdefault(e.dst, []).append(c)
+            new_states = dict(states)
+            changed = []
+            for dst in dst_order:
+                codec, spec = meta[dst]
+                cur = states[dst]
+                new = cur
+                for c in contribs[dst]:
+                    merged = codec.merge(spec, new, c)
+                    new = _select(
+                        codec.is_inflation(spec, new, merged), merged, new
+                    )
+                changed.append(~codec.equal(spec, cur, new))
+                new_states[dst] = new
+            return new_states, jnp.stack(changed)
+
+        out = (jax.jit(round_fn), tuple(dst_order))
+        self._subset_fns[idx] = out
+        return out
 
     def propagate(self, max_rounds: int | None = None) -> int:
         """Run jitted rounds to the fixed point; ingest results back into the
         store (waking threshold watches). Returns the number of rounds that
         performed work. Replaces every ``timer:sleep`` in the reference test
-        suite with a convergence predicate (SURVEY.md §4)."""
+        suite with a convergence predicate (SURVEY.md §4).
+
+        Frontier-scheduled: each round sweeps ONLY the edges whose
+        sources moved — seeded from the store's dirty set
+        (``Store.dirty_vars``, marked on every bind/update/ingest write),
+        then per-round from the dsts the previous sweep changed. An edge
+        whose sources are all clean contributes exactly what it already
+        merged (idempotent join), so skipping it cannot change the fixed
+        point or the round count — same states, same rounds, less work
+        (one write into a 50-edge graph recomputes its own chain, not
+        the whole graph)."""
         if not self.edges:
             return 0
         if self._clean_mark == (self.store.mutations, len(self.edges)):
@@ -361,14 +432,37 @@ class Graph:
         states = {v: self.store.state(v) for v in self._var_ids}
         limit = max_rounds if max_rounds is not None else len(self.edges) + 1
         rounds = 0
-        executed = 0  # jitted sweeps issued (incl. the final quiescent one)
+        executed = 0  # jitted sweeps issued
+        runs = [0] * len(self.edges)  # per-edge contribution evaluations
+        dirty = self.store.dirty_since(self._dirty_cursor) & set(
+            self._var_ids
+        )
         try:
             with span("dataflow.propagate", edges=len(self.edges)):
                 with Timer() as t:
                     for _ in range(limit):
-                        states, residual = self._jitted(states, tables)
+                        eligible = tuple(
+                            i
+                            for i, e in enumerate(self.edges)
+                            if not self._edge_ran[i]
+                            or (dirty & set(e.srcs))
+                        )
+                        if not eligible:
+                            break  # empty frontier: no edge can move
+                        fn, dst_order = self._subset_round(eligible)
+                        states, changed_vec = fn(states, tables)
                         executed += 1
-                        if int(residual) == 0:
+                        for i in eligible:
+                            self._edge_ran[i] = True
+                            runs[i] += 1
+                        dirty = {
+                            d
+                            for d, c in zip(
+                                dst_order, np.asarray(changed_vec).tolist()
+                            )
+                            if c
+                        }
+                        if not dirty:
                             break
                         rounds += 1
                     else:
@@ -387,19 +481,35 @@ class Graph:
                 "dataflow_propagate_seconds",
                 help="wall time of a propagate-to-fixpoint run",
             ).observe(t.elapsed)
-            # every sweep re-evaluates every edge's contribution (Jacobi
-            # iteration) — the per-edge recompute count, by combinator
-            # kind
+            # per-edge recompute counts, by combinator kind — with
+            # frontier scheduling an edge only recomputes in sweeps
+            # where it was eligible; the skipped evaluations are counted
+            # too (the "work the frontier saved" metric)
             by_kind: dict = {}
-            for e in self.edges:
-                by_kind[e.kind] = by_kind.get(e.kind, 0) + executed
+            skipped_by_kind: dict = {}
+            for i, e in enumerate(self.edges):
+                by_kind[e.kind] = by_kind.get(e.kind, 0) + runs[i]
+                skipped_by_kind[e.kind] = (
+                    skipped_by_kind.get(e.kind, 0) + executed - runs[i]
+                )
             for kind, n in by_kind.items():
-                counter(
-                    "dataflow_edge_recomputes_total",
-                    help="edge contribution evaluations, by combinator "
-                         "kind",
-                    kind=kind,
-                ).inc(n)
+                if n:
+                    counter(
+                        "dataflow_edge_recomputes_total",
+                        help="edge contribution evaluations, by combinator "
+                             "kind",
+                        kind=kind,
+                    ).inc(n)
+            total_skipped = 0
+            for kind, n in skipped_by_kind.items():
+                if n:
+                    total_skipped += n
+                    counter(
+                        "dataflow_edges_skipped_total",
+                        help="edge evaluations skipped by frontier "
+                             "scheduling (source set clean), by kind",
+                        kind=kind,
+                    ).inc(n)
             # causal log: one coarse record per propagate run; the deep
             # tier adds per-edge recompute provenance (srcs -> dst, the
             # trail `lasp_tpu trace --var` reconstructs values from)
@@ -409,18 +519,26 @@ class Graph:
                 "propagate", rounds=rounds, sweeps=executed,
                 edges=len(self.edges),
             )
+            if total_skipped:
+                tel_events.emit(
+                    "frontier_skip", skipped=int(total_skipped),
+                    sweeps=executed, edges=len(self.edges),
+                )
             if tel_events.deep_enabled():
-                for e in self.edges:
+                for i, e in enumerate(self.edges):
                     d = e.describe()
                     tel_events.emit_deep(
                         "edge_recompute", var=d["dst"], kind=d["kind"],
-                        srcs=d["srcs"], sweeps=executed,
+                        srcs=d["srcs"], sweeps=runs[i],
                     )
         pre_ingest = self.store.mutations
         writes = self.store.ingest(states)
         if self.store.mutations == pre_ingest + writes:
-            # ingest's own writes ARE the fixed point — mark clean
+            # ingest's own writes ARE the fixed point — mark clean and
+            # advance THIS graph's dirty cursor past them (marks are
+            # shared store state; other graphs keep their own cursors)
             self._clean_mark = (self.store.mutations, len(self.edges))
+            self._dirty_cursor = self.store.mutations
         else:
             # a watch callback wrote during ingest; stay dirty so the next
             # propagate folds that write in

@@ -3,7 +3,15 @@ queries, and failure injection over a leading replica axis sharded across
 device meshes — the TPU rebuild of the reference's riak_core distribution
 layer and request-coordination FSMs (SURVEY.md §2.5/§2.6/§7.4)."""
 
-from .gossip import converged, divergence, gossip_round, join_all, quorum_read
+from .gossip import (
+    converged,
+    divergence,
+    frontier_reach,
+    gossip_round,
+    gossip_round_rows,
+    join_all,
+    quorum_read,
+)
 from .runtime import ActorCollisionError, ReplicatedRuntime
 from .topology import (
     edge_failure_mask,
@@ -21,7 +29,9 @@ __all__ = [
     "converged",
     "divergence",
     "edge_failure_mask",
+    "frontier_reach",
     "gossip_round",
+    "gossip_round_rows",
     "join_all",
     "locality_order",
     "partition_mask",

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _tree_where(pred, a, b):
@@ -111,6 +112,69 @@ def gossip_round_shift(codec, spec, states, offsets, edge_mask=None):
             nbr = _tree_where(edge_mask[:, k], nbr, states)
         acc = vmerge(acc, nbr)
     return acc
+
+
+def frontier_reach(frontier, neighbors, include_self: bool = False):
+    """Host-side frontier expansion of dirty-set gossip scheduling:
+    ``bool[R]`` of replicas that CAN change in the next pull round —
+    a replica is frontier-reachable iff one of its fan-in neighbors
+    (the rows it gathers FROM) inflated last round. The JITSPMM /
+    Tascade move (PAPERS.md): touch only the rows that can still
+    change. ``include_self`` adds the dirty rows themselves — needed
+    when a local per-row sweep (dataflow edges / triggers) can change
+    a row from its own state; pure anti-entropy never needs it (a
+    row's own dirtiness cannot change the row again under pull)."""
+    f = np.asarray(frontier, dtype=bool)
+    reach = f[np.asarray(neighbors)].any(axis=1)
+    if include_self:
+        reach = reach | f
+    return reach
+
+
+def gossip_round_rows(codec, spec, states, neighbors, rows, edge_mask=None):
+    """Masked pull-gossip round: join neighbor states into ONLY the
+    replica rows named by ``rows`` (the frontier-reachable set); all
+    other rows ride through untouched. Returns ``(new_states,
+    changed)`` where ``changed: bool[F]`` flags which of the processed
+    rows actually inflated — the next round's frontier seed.
+
+    Work scales with ``len(rows) * fanout * state``, not the
+    population: this is the delta-gossip kernel behind
+    ``ReplicatedRuntime.frontier_step``. Bit-identical to
+    :func:`gossip_round` on the same round WHENEVER ``rows`` is a
+    superset of the rows that round could change (the frontier-reach
+    invariant — asserted by tests/mesh/test_frontier.py across codecs
+    and edge masks). ``rows`` may contain duplicates (bucket padding):
+    idempotent joins make the duplicate scatter writes identical."""
+    rows = jnp.asarray(rows)
+    nbr_idx = neighbors[rows]  # [F, K]
+    old = jax.tree_util.tree_map(lambda x: x[rows], states)
+    op = _leafwise_op(codec)
+    if op is not None and edge_mask is None:
+
+        def leaf(x, o):
+            acc = o
+            for k in range(nbr_idx.shape[1]):
+                acc = op(acc, x[nbr_idx[:, k]])
+            return acc
+
+        new_rows = jax.tree_util.tree_map(leaf, states, old)
+    else:
+        vmerge = jax.vmap(lambda a, b: codec.merge(spec, a, b))
+        acc = old
+        for k in range(nbr_idx.shape[1]):
+            nbr = jax.tree_util.tree_map(lambda x: x[nbr_idx[:, k]], states)
+            if edge_mask is not None:
+                # dead edge: the row's own state rides in (idempotent
+                # no-op), exactly the dense round's substitution
+                nbr = _tree_where(edge_mask[rows, k], nbr, old)
+            acc = vmerge(acc, nbr)
+        new_rows = acc
+    changed = ~jax.vmap(lambda a, b: codec.equal(spec, a, b))(old, new_rows)
+    new_states = jax.tree_util.tree_map(
+        lambda x, nr: x.at[rows].set(nr), states, new_rows
+    )
+    return new_states, changed
 
 
 def join_all(codec, spec, states):

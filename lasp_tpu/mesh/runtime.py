@@ -38,7 +38,9 @@ from ..telemetry.convergence import get_monitor, record_membership
 from ..utils.metrics import StepTrace, Timer
 from .gossip import (
     divergence,
+    frontier_reach,
     gossip_round,
+    gossip_round_rows,
     gossip_round_shift,
     join_all,
     quorum_read,
@@ -182,6 +184,33 @@ class ReplicatedRuntime:
         #: double-log each op)
         self._suppress_op_events = False
         self.trace = StepTrace()
+        #: per-var dirty-replica frontier masks (host ``np.bool_[R]``):
+        #: the rows whose state changed since their out-neighbors last
+        #: pulled them, seeded by client writes and expanded each round
+        #: by reverse-neighbor reachability. The frontier engine
+        #: (:meth:`frontier_step`) schedules masked gossip from these;
+        #: every path that loses row-level knowledge (dense blocks that
+        #: did not quiesce, resize, checkpoint restore) degrades a mask
+        #: to all-dirty — conservative, never unsound. Direct
+        #: ``rt.states[v] = ...`` assignment bypasses the bookkeeping:
+        #: call :meth:`mark_dirty` after it.
+        self._frontier: dict[str, np.ndarray] = {}
+        #: the edge_mask the frontier masks are RELATIVE to (identity,
+        #: not value): quiescence observed under failure injection only
+        #: proves a fixed point of the MASKED graph — rows separated by
+        #: dead edges still hold undelivered state. Any stepping call
+        #: with a different mask (or none) first degrades every frontier
+        #: to all-dirty (see _frontier_sync_mask).
+        self._frontier_mask_ref = None
+        #: frontier density above which :meth:`frontier_step` runs the
+        #: dense round for a variable instead of the row-sparse kernel
+        #: (the gather/scatter bookkeeping stops paying once most rows
+        #: are reachable). Autotunable per run — the frontier_sparse
+        #: bench scenario derives it from measured arm timings.
+        self.frontier_crossover = 0.25
+        #: set by shard(): states live under a NamedSharding (frontier
+        #: telemetry then also reports per-shard dirty counts)
+        self._frontier_shards: "int | None" = None
         #: per-round wire estimate (bytes), refreshed by _ensure_step
         self._round_traffic = 0
         #: cached hot-path instruments: (registry generation, var_ids,
@@ -209,6 +238,13 @@ class ReplicatedRuntime:
                     )
             elif v not in self.states:
                 self.states[v] = replicate(self.store.state(v), self.n_replicas)
+        for v in self.states:
+            # a freshly replicated variable's rows are identical, so its
+            # frontier starts empty (gossip on it is a no-op until a
+            # client write dirties a row)
+            self._frontier.setdefault(
+                v, np.zeros(self.n_replicas, dtype=bool)
+            )
         self.var_ids = tuple(self.states)
         self._n_edges = len(graph.edges)
         self._step = None
@@ -485,12 +521,17 @@ class ReplicatedRuntime:
         ``debug_actors=True`` to turn that misuse into a loud
         :class:`ActorCollisionError` at the second write site."""
         var = self.store.variable(var_id)
-        if var.type_name == "riak_dt_map" and self.store.admit_map_fields(
-            var, op
-        ):
-            # dynamic field admission grew the field axis: re-layout the
-            # population before gathering this replica's row
-            self._grow_map_population(var)
+        if var.type_name == "riak_dt_map":
+            # sync a LATE-DECLARED map's population BEFORE any spec
+            # growth: admitting a fresh {Name, Type} key first would
+            # grow the spec and then KeyError in _grow_map_population
+            # (no population row yet), leaving spec and population out
+            # of lock-step
+            self._population(var_id)
+            if self.store.admit_map_fields(var, op):
+                # dynamic field admission grew the field axis: re-layout
+                # the population before gathering this replica's row
+                self._grow_map_population(var)
         # boolean on purpose: the commit below re-derives keys AFTER the
         # apply interns the actor (picking up the ("lane", idx) alias);
         # reusing the pre-intern keys here would drop it
@@ -528,6 +569,8 @@ class ReplicatedRuntime:
         self.states[var_id] = jax.tree_util.tree_map(
             lambda x, r: x.at[replica].set(r), self.states[var_id], new_row
         )
+        if inflated:
+            self._mark_dirty_rows(var_id, [replica])
         if not getattr(self, "_suppress_op_events", False):
             # inside update_batch's per-op fallback the BATCH owns both
             # tiers (one coarse record + the deep per-op loop) — emitting
@@ -573,6 +616,10 @@ class ReplicatedRuntime:
         var = self.store.variable(var_id)
         tn = var.type_name
         if tn == "riak_dt_map":
+            # late-declare sync BEFORE admission (the update_at rule): a
+            # grown spec with no population row leaves the two out of
+            # lock-step when _grow_map_population KeyErrors
+            self._population(var_id)
             # dynamic schema: pre-admit every first-touched field key in the
             # batch and re-layout the population ONCE. Sound because
             # admission is observably a no-op until its update lands (bottom
@@ -676,6 +723,12 @@ class ReplicatedRuntime:
                         "update", var=var_id, replica=r, op=str(op[0]),
                         actor=repr(actor),
                     )
+            # frontier bookkeeping: the rows the batch touched are a
+            # SUPERSET of the rows it changed (non-inflations over-mark
+            # — a dirty-but-unchanged row costs one wasted gather next
+            # round, never a missed delivery); failed batches applied a
+            # prefix, still covered by the superset
+            self._mark_dirty_rows(var_id, [r for r, _op, _a in ops])
             # a mid-batch CapacityError/PreconditionError persists the ops
             # before the failure (sequential semantics) — their interned
             # terms must still fold into the edge tables, or a caller that
@@ -1435,8 +1488,12 @@ class ReplicatedRuntime:
     def apply_batch(self, var_id: str, fn) -> None:
         """Device-side batched update: ``fn(states[R, ...]) -> states`` —
         the bulk client-op kernel for large simulations (e.g.
-        ``ORSet.apply_masks`` with per-replica add/remove masks)."""
+        ``ORSet.apply_masks`` with per-replica add/remove masks). The
+        opaque ``fn`` may touch any row, so the variable's whole
+        frontier goes dirty (pass specific rows to :meth:`mark_dirty`
+        afterwards to tighten it)."""
         self.states[var_id] = fn(self.states[var_id])
+        self.mark_dirty(var_id)
 
     # -- the step ------------------------------------------------------------
     def _build_step(self):
@@ -1797,6 +1854,7 @@ class ReplicatedRuntime:
         Returns the number of (replica, variable) states the step CHANGED
         (0 on the final, quiescent round)."""
         tables = self._ensure_step()
+        self._frontier_sync_mask(edge_mask)
         with span("gossip.round", annotate=True):
             with Timer() as t:
                 # _run_step_fn syncs on the residual vector, closing the
@@ -1805,6 +1863,7 @@ class ReplicatedRuntime:
                     self._step, edge_mask, tables
                 )
         residual = int(res_vec.sum())
+        self._frontier_after_dense(res_vec)
         self._emit_step_telemetry(res_vec, residual, t.elapsed)
         return residual
 
@@ -1854,6 +1913,7 @@ class ReplicatedRuntime:
         rounds after the first zero are no-ops — running the remainder of
         the block is harmless."""
         tables = self._ensure_step()
+        self._frontier_sync_mask(edge_mask)
         fn = self._fused_steps_cache.get(block)
         if fn is None:
             step = self._step_pure
@@ -1882,6 +1942,7 @@ class ReplicatedRuntime:
                     fn, edge_mask, tables
                 )
         first_zero = int(first_zero)
+        self._frontier_after_opaque(first_zero >= 0)
         self.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
         self._record_rounds(block)  # fori always executes the whole block
         self._observe_opaque_block(block, first_zero >= 0, t.elapsed)
@@ -1907,13 +1968,33 @@ class ReplicatedRuntime:
         )
 
     def run_to_convergence(
-        self, max_rounds: int = 10_000, edge_mask=None, block: int = 1
+        self, max_rounds: int = 10_000, edge_mask=None, block: int = 1,
+        mode: str = "dense",
     ) -> int:
         """Step until no state changes (the join fixed point); returns
         rounds taken — the rounds-to-convergence metric (BASELINE.md).
         With ``block > 1`` rounds run in fused blocks (one dispatch per
         block); the returned round count is still exact — the fused kernel
-        reports the in-block index of the first quiescent round."""
+        reports the in-block index of the first quiescent round.
+
+        ``mode`` selects the scheduler: ``"dense"`` (default — every
+        round gathers and joins the whole population), ``"frontier"``
+        (dirty-set scheduling: each round touches only rows reachable
+        from the per-var frontier, raising if this runtime's shape —
+        dataflow edges, triggers, partitioned gossip — needs the dense
+        sweep), or ``"auto"`` (frontier when supported, dense
+        otherwise). Round counts and per-round states are identical
+        across modes (tests/mesh/test_frontier.py)."""
+        if mode not in ("dense", "frontier", "auto"):
+            raise ValueError(f"unknown mode {mode!r}")
+        if mode != "dense":
+            reason = self._frontier_unsupported()
+            if reason is None:
+                return self._frontier_convergence(max_rounds, edge_mask)
+            if mode == "frontier":
+                raise RuntimeError(
+                    f"frontier gossip unavailable here: {reason}"
+                )
         if block > 1:
             rounds = 0
             while rounds < max_rounds:
@@ -1961,6 +2042,7 @@ class ReplicatedRuntime:
         if max_rounds < 1:
             raise ValueError("max_rounds must be >= 1")
         tables = self._ensure_step()
+        self._frontier_sync_mask(edge_mask)
         fn = self._fused_steps_cache.get("while")
         if fn is None:
             step = self._step_pure
@@ -1991,6 +2073,7 @@ class ReplicatedRuntime:
                     fn, edge_mask, tables, jnp.int32(max_rounds)
                 )
         signed_rounds = int(signed_rounds)
+        self._frontier_after_opaque(signed_rounds > 0)
         # 0 = reached the fixed point; -1 = budget ran out unconverged
         # (the same convention fused_steps' trace rows use)
         self.trace.record_round(0 if signed_rounds > 0 else -1, t.elapsed)
@@ -2005,6 +2088,363 @@ class ReplicatedRuntime:
                 f"no convergence within {-signed_rounds} rounds"
             )
         return signed_rounds
+
+    # -- frontier / delta gossip (dirty-set scheduling) -----------------------
+    def mark_dirty(self, var_id: "str | None" = None, rows=None) -> None:
+        """Mark replica rows frontier-dirty. The op verbs (``update_at``,
+        ``update_batch``, ``seed_*``) mark automatically; call this after
+        DIRECT state surgery (``rt.states[v] = ...``) so the frontier
+        engine does not schedule around a write it never saw. ``var_id``
+        None = every variable; ``rows`` None = every row."""
+        targets = (var_id,) if var_id is not None else tuple(self.states)
+        for v in targets:
+            if v not in self.states:
+                raise KeyError(v)
+            if rows is None:
+                self._frontier[v] = np.ones(self.n_replicas, dtype=bool)
+            else:
+                self._mark_dirty_rows(v, rows)
+
+    def _mark_dirty_rows(self, var_id: str, rows) -> None:
+        f = self._frontier.get(var_id)
+        if f is None or f.shape[0] != self.n_replicas:
+            f = self._frontier[var_id] = np.zeros(self.n_replicas, bool)
+        f[np.asarray(rows, dtype=np.int64)] = True
+
+    def _frontier_sync_mask(self, edge_mask) -> None:
+        """Frontier knowledge is only valid relative to the edge_mask it
+        was learned under (a masked round cannot deliver over dead
+        edges, so rows it retires from the frontier may still owe their
+        state to mask-separated peers). Called by every stepping entry:
+        a mask change — including masked -> unmasked, the
+        partition-heals case — degrades every frontier to all-dirty
+        before any scheduling happens. Identity comparison on purpose:
+        callers hold one mask object across a run (the property-test
+        shape); a re-built equal mask degrades conservatively, never
+        unsoundly."""
+        if edge_mask is not self._frontier_mask_ref:
+            for v in list(self._frontier):
+                self._frontier_fill(v, True)
+            self._frontier_mask_ref = edge_mask
+
+    def _frontier_fill(self, var_id: str, value: bool) -> None:
+        """Set one frontier mask to all-``value``, reusing the existing
+        array when shapes allow (the dense step paths run this per
+        dispatch — at 10M replicas a fresh alloc per var would churn)."""
+        f = self._frontier.get(var_id)
+        if f is not None and f.shape[0] == self.n_replicas:
+            f.fill(value)
+        else:
+            self._frontier[var_id] = np.full(self.n_replicas, value, bool)
+
+    def _frontier_after_dense(self, res_vec) -> None:
+        """Conservative per-var frontier update after an UNFUSED dense
+        round: residual 0 proves the var quiescent (empty frontier);
+        nonzero changed unknown rows (all-dirty)."""
+        for v, r in zip(self.var_ids, np.asarray(res_vec).tolist()):
+            self._frontier_fill(v, bool(r))
+
+    def _frontier_after_opaque(self, quiescent: bool) -> None:
+        """After a fused block / on-device while dispatch, per-row
+        knowledge never reached the host: quiescence clears every
+        frontier, anything else degrades them all to all-dirty."""
+        for v in self.var_ids:
+            self._frontier_fill(v, not quiescent)
+
+    def frontier_size(self, var_id: str) -> int:
+        """Current dirty-row count of one variable's frontier."""
+        self._population(var_id)
+        return int(self._frontier[var_id].sum())
+
+    def _frontier_unsupported(self) -> "str | None":
+        """None when the frontier engine can schedule this runtime, else
+        the human-readable reason the dense sweep is required."""
+        if self.graph.edges or self._triggers:
+            return (
+                "dataflow edges / triggers sweep every replica row "
+                "locally (a row can change from its own state)"
+            )
+        if self._partition is not None:
+            return (
+                "partitioned boundary-exchange gossip bakes a dense row "
+                "plan (shard with partition=False for frontier runs)"
+            )
+        return None
+
+    def frontier_step(self, edge_mask=None) -> int:
+        """ONE frontier-scheduled anti-entropy round: per variable,
+        expand the dirty mask by reverse-neighbor reachability, gather +
+        join ONLY the reachable rows (``gossip.gossip_round_rows``), and
+        reseed the frontier with the rows that actually inflated.
+        Variables with an empty frontier are skipped outright (no
+        dispatch); a variable whose reachable set exceeds
+        ``frontier_crossover * n_replicas`` falls back to the dense
+        round for that variable (the sparse bookkeeping stops paying).
+        Returns the total number of (replica, variable) states changed —
+        the same residual contract as :meth:`step`, with bit-identical
+        per-round states (tests/mesh/test_frontier.py)."""
+        reason = self._frontier_unsupported()
+        if reason is not None:
+            raise RuntimeError(f"frontier_step unavailable: {reason}")
+        self._check_poisoned()
+        if self._n_edges != len(self.graph.edges):
+            self._sync_graph()
+        self._frontier_sync_mask(edge_mask)
+        if not self._round_traffic:
+            # the dense entry points refresh this in _ensure_step; the
+            # frontier path owes the same metadata-only walk once
+            fan = (
+                int(self._host_neighbors.shape[1])
+                if self._host_neighbors.ndim == 2
+                else 0
+            )
+            self._round_traffic = round_traffic_bytes(self._states, fan)
+        per_var_changed: list[int] = []
+        rows_touched = 0
+        skipped = 0
+        dense_falls = 0
+        with span("gossip.frontier_round", annotate=True):
+            with Timer() as t:
+                for v in self.var_ids:
+                    f = self._frontier.get(v)
+                    if f is None or f.shape[0] != self.n_replicas:
+                        f = self._frontier[v] = np.ones(
+                            self.n_replicas, bool
+                        )
+                    if not f.any():
+                        skipped += 1
+                        per_var_changed.append(0)
+                        continue
+                    reach = frontier_reach(f, self._host_neighbors)
+                    if edge_mask is not None:
+                        # a dead edge delivers nothing: reachability
+                        # counts live fan-in only (matches the dense
+                        # round's own-state substitution)
+                        live = (
+                            np.asarray(f)[self._host_neighbors]
+                            & np.asarray(edge_mask, bool)
+                        )
+                        reach = live.any(axis=1)
+                    rows = np.flatnonzero(reach)
+                    if rows.size == 0:
+                        # dirty rows whose every out-edge is dead: they
+                        # can deliver nothing — retire them
+                        self._frontier[v] = np.zeros(self.n_replicas, bool)
+                        skipped += 1
+                        per_var_changed.append(0)
+                        continue
+                    if rows.size > self.frontier_crossover * self.n_replicas:
+                        changed_mask = self._frontier_dense_round(
+                            v, edge_mask
+                        )
+                        dense_falls += 1
+                        rows_touched += self.n_replicas
+                    else:
+                        changed_mask = self._frontier_sparse_round(
+                            v, rows, edge_mask
+                        )
+                        rows_touched += int(rows.size)
+                    self._frontier[v] = changed_mask
+                    per_var_changed.append(int(changed_mask.sum()))
+        total = sum(per_var_changed)
+        #: host-visible work accounting (the frontier_sparse bench derives
+        #: its crossover autotune from this)
+        self.frontier_rows_last = rows_touched
+        self.frontier_rows_total = (
+            getattr(self, "frontier_rows_total", 0) + rows_touched
+        )
+        self._emit_frontier_telemetry(
+            per_var_changed, total, rows_touched, skipped, dense_falls,
+            t.elapsed,
+        )
+        return total
+
+    #: sparse-round row buckets are padded to powers of two (floor 16) so
+    #: one compiled kernel serves a band of frontier sizes instead of one
+    #: executable per distinct row count
+    _FRONTIER_MIN_BUCKET = 16
+
+    def _frontier_bucket(self, n: int) -> int:
+        b = self._FRONTIER_MIN_BUCKET
+        while b < n:
+            b <<= 1
+        return min(b, self.n_replicas)
+
+    def _frontier_sparse_round(self, var_id: str, rows: np.ndarray,
+                               edge_mask) -> np.ndarray:
+        """Dispatch the row-sparse kernel for one variable; returns the
+        new frontier mask (the rows that inflated)."""
+        bucket = self._frontier_bucket(rows.size)
+        if bucket < rows.size:  # n_replicas-capped bucket: go dense-wide
+            padded = rows
+            bucket = rows.size
+        else:
+            padded = np.full(bucket, rows[0], dtype=np.int64)
+            padded[: rows.size] = rows
+        key = ("frontier", var_id, int(bucket), edge_mask is None)
+        fn = self._fused_steps_cache.get(key)
+        if fn is None:
+            codec, spec = self._mesh_meta(var_id)
+
+            def sparse(states_v, neighbors, mask, row_idx):
+                return gossip_round_rows(
+                    codec, spec, states_v, neighbors, row_idx, mask
+                )
+
+            fn = jax.jit(sparse, donate_argnums=self._frontier_donate())
+            self._fused_steps_cache[key] = fn
+        new_states, changed = self._run_frontier_fn(
+            var_id, fn, edge_mask, jnp.asarray(padded)
+        )
+        self.states[var_id] = new_states
+        mask = np.zeros(self.n_replicas, dtype=bool)
+        changed = np.asarray(changed)[: rows.size]
+        mask[rows[changed]] = True
+        return mask
+
+    def _frontier_dense_round(self, var_id: str, edge_mask) -> np.ndarray:
+        """Dense crossover arm of :meth:`frontier_step` for ONE variable:
+        the full-population round plus a per-row change vector (exactly
+        what the frontier needs to stay row-accurate through the dense
+        fallback)."""
+        key = ("frontier_dense", var_id, edge_mask is None)
+        fn = self._fused_steps_cache.get(key)
+        if fn is None:
+            codec, spec = self._mesh_meta(var_id)
+            offsets = self._shift_offsets
+
+            def dense(states_v, neighbors, mask, _rows):
+                if offsets is not None:
+                    new = gossip_round_shift(
+                        codec, spec, states_v, offsets, mask
+                    )
+                else:
+                    new = gossip_round(codec, spec, states_v, neighbors, mask)
+                changed = jax.vmap(
+                    lambda a, b: ~codec.equal(spec, a, b)
+                )(states_v, new)
+                return new, changed
+
+            fn = jax.jit(dense, donate_argnums=self._frontier_donate())
+            self._fused_steps_cache[key] = fn
+        new_states, changed = self._run_frontier_fn(
+            var_id, fn, edge_mask, jnp.zeros((1,), jnp.int32)
+        )
+        self.states[var_id] = new_states
+        return np.asarray(changed)
+
+    def _frontier_donate(self) -> tuple:
+        """The frontier kernels donate their states operand EVERYWHERE
+        (this jax's CPU backend supports aliasing, and without it every
+        sparse round's row scatter copies the full population — the
+        exact O(R) cost the frontier exists to skip). Both callers
+        rebind ``self.states[var]`` immediately; ``donate_steps=False``
+        opts out, same as the dense step."""
+        return (0,) if self.donate_steps else ()
+
+    def _run_frontier_fn(self, var_id: str, fn, edge_mask, rows):
+        """Per-var twin of :meth:`_run_step_fn`: dispatch + sync inside
+        the poison guard (donated buffers die on a failed dispatch)."""
+        states_in = self.states[var_id]
+        try:
+            new_states, changed = fn(
+                states_in, self.neighbors, edge_mask, rows
+            )
+            jax.block_until_ready(changed)  # device sync: errors land here
+            return new_states, changed
+        except Exception as exc:
+            if self._frontier_donate() and any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for leaf in jax.tree_util.tree_leaves(states_in)
+            ):
+                self._poisoned = f"{type(exc).__name__}: {str(exc)[:200]}"
+            raise
+
+    def _frontier_convergence(self, max_rounds: int, edge_mask) -> int:
+        """Frontier-scheduled :meth:`run_to_convergence` body: rounds run
+        until a round changes nothing (the final quiescent round is
+        counted, the dense convention — a frontier already empty at
+        entry makes that round free: no dispatch, just the empty-reach
+        observation)."""
+        for i in range(max_rounds):
+            if self.frontier_step(edge_mask) == 0:
+                return self._record_quiescence(i + 1)
+        raise RuntimeError(f"no convergence within {max_rounds} rounds")
+
+    def _emit_frontier_telemetry(self, per_var_changed, total: int,
+                                 rows_touched: int, skipped: int,
+                                 dense_falls: int, elapsed: float) -> None:
+        """The frontier round's host-side emission — the frontier twin of
+        :meth:`_emit_step_telemetry`: the trace row and monitor feed are
+        identical (same residual contract), bytes scale with the rows
+        actually gathered, and the frontier gauges/events ride on top."""
+        self.trace.record_round(total, elapsed)
+        tel = self._instruments()
+        if tel is not None:
+            tel["rounds"].inc(1)
+            frac = rows_touched / max(self.n_replicas * len(self.var_ids), 1)
+            tel["bytes"].inc(int(self._round_traffic * frac))
+            for c, edges_of_kind in tel["edge_recomputes"]:
+                c.inc(edges_of_kind)
+            counter(
+                "gossip_frontier_rounds_total",
+                help="frontier-scheduled gossip rounds executed",
+            ).inc()
+            if dense_falls:
+                counter(
+                    "gossip_frontier_dense_fallbacks_total",
+                    help="per-var dense rounds taken because the frontier "
+                         "density crossed frontier_crossover",
+                ).inc(dense_falls)
+            from ..telemetry import gauge
+
+            mon = get_monitor()
+            for v, g, c in zip(self.var_ids, tel["residual"],
+                               per_var_changed):
+                g.set(int(c))
+                gauge(
+                    "gossip_frontier_rows",
+                    help="dirty-replica frontier size after the last "
+                         "frontier round, per var",
+                    var=v,
+                ).set(int(self._frontier[v].sum()))
+            if self._frontier_shards and self.var_ids:
+                from .shard_gossip import shard_frontier_counts
+
+                union = np.zeros(self.n_replicas, bool)
+                for v in self.var_ids:
+                    union |= self._frontier[v]
+                for s, n in enumerate(
+                    shard_frontier_counts(union, self._frontier_shards)
+                ):
+                    gauge(
+                        "gossip_frontier_shard_rows",
+                        help="dirty rows per contiguous shard block "
+                             "(union over vars)",
+                        shard=s,
+                    ).set(int(n))
+            tel["round_seconds"].observe(elapsed)
+            mon.observe_round(
+                self.var_ids, per_var_changed, elapsed, self.n_replicas
+            )
+            mon.observe_frontier(
+                self.var_ids,
+                [int(self._frontier[v].sum()) for v in self.var_ids],
+            )
+            tel_events.set_round(mon.round)
+            tel_events.emit(
+                "delivery",
+                residual=int(total),
+                seconds=round(elapsed, 6),
+                n_replicas=self.n_replicas,
+                frontier_rows=int(rows_touched),
+            )
+            if skipped:
+                tel_events.emit(
+                    "frontier_skip",
+                    skipped=int(skipped),
+                    of=len(self.var_ids),
+                )
 
     # -- vectorized population seeding ---------------------------------------
     def intern_terms(self, var_id: str, terms) -> np.ndarray:
@@ -2062,6 +2502,7 @@ class ReplicatedRuntime:
                 exists=states.exists.at[rows, elems, tokens].set(True),
                 removed=states.removed.at[rows, elems, tokens].set(False),
             )
+        self._mark_dirty_rows(var_id, np.asarray(rows).ravel())
         tel_events.emit(
             "update", var=var_id, ops=int(rows.size), op="seed_tokens",
         )
@@ -2102,6 +2543,7 @@ class ReplicatedRuntime:
         self.states[var_id] = states._replace(
             counts=states.counts.at[jnp.asarray(rows), jnp.asarray(lanes)].add(by)
         )
+        self._mark_dirty_rows(var_id, np.asarray(rows).ravel())
         tel_events.emit(
             "update", var=var_id, ops=int(np.asarray(rows).size),
             op="seed_increments",
@@ -2116,11 +2558,41 @@ class ReplicatedRuntime:
         """The variable's [R, ...] states, syncing in variables declared
         after the runtime was built — the single late-declare rule every
         read AND write verb routes through. Unknown ids raise KeyError
-        without the (expensive, cache-invalidating) graph sync."""
+        without the (expensive, cache-invalidating) graph sync.
+
+        Maps additionally re-check the SPEC/STATE field-axis agreement
+        here: the bridge's merge_batch/import path admits dynamic
+        ``{Name, Type}`` keys directly on the store variable
+        (``bridge/server.py`` ``_validate_portable``), behind any
+        ReplicatedRuntime's back — the population is then re-laid-out
+        (bottom planes for the admitted fields, observably a no-op) the
+        next time any verb routes through. A population carrying MORE
+        fields than the spec cannot happen by growth and raises."""
         if var_id not in self.states:
             if var_id not in self.store.ids():
                 raise KeyError(var_id)
             self._sync_graph()
+        var = self.store.variable(var_id)
+        if var.type_name == "riak_dt_map":
+            from ..lattice.map import CrdtMap
+
+            states = self.states[var_id]
+            if states.dots.shape[-2] > var.spec.n_fields:
+                raise RuntimeError(
+                    f"{var_id}: population states carry "
+                    f"{states.dots.shape[-2]} field planes but the spec "
+                    f"declares {var.spec.n_fields} — the spec shrank "
+                    "behind this runtime's back (field axes only grow; "
+                    "rebuild the runtime from the store)"
+                )
+            # grow() recurses into nested submap fields and returns the
+            # SAME object when nothing changed, so in-sync populations
+            # pay one host-side walk and no cache invalidation
+            grown = CrdtMap.grow(var.spec, states)
+            if grown is not states:
+                self.states[var_id] = grown
+                self._step = None
+                self._fused_steps_cache.clear()
         return self.states[var_id]
 
     def coverage_value(self, var_id: str):
@@ -2344,6 +2816,7 @@ class ReplicatedRuntime:
             for v, t in reads
         ]
         tables = self._ensure_step()
+        self._frontier_sync_mask(edge_mask)
         n_reads = len(resolved)
         key = ("read_any_until",
                tuple((v, bool(t.strict)) for v, t in resolved))
@@ -2405,6 +2878,8 @@ class ReplicatedRuntime:
         packed = int(packed)
         which = packed % n_reads
         rounds, code = (packed // n_reads) // 4, (packed // n_reads) % 4
+        if rounds > 0 or code == 2:
+            self._frontier_after_opaque(code == 2)
         self.trace.record_round(0 if code == 0 else -1, t.elapsed)
         self._record_rounds(rounds)
         self._observe_opaque_block(
@@ -2697,9 +3172,20 @@ class ReplicatedRuntime:
         self.neighbors = jnp.asarray(new_neighbors)
         self._host_neighbors = np.asarray(new_neighbors)
         self._shift_offsets = shift_offsets(new_neighbors, new_n)
+        # membership changed: fresh rows start at bottom and must be
+        # caught up by gossip even from QUIESCENT peers, and the handoff
+        # merge dirtied row 0 — row-level knowledge is gone either way,
+        # so every frontier degrades to all-dirty (conservative: the
+        # frontier engine then behaves exactly like dense until the
+        # dirty set re-collapses)
+        for v in list(self._frontier):
+            self._frontier[v] = np.ones(new_n, dtype=bool)
         # a boundary-exchange plan is topology-specific: drop it (re-apply
-        # shard(partition=True) after the membership change)
+        # shard(partition=True) after the membership change); the
+        # per-shard frontier gauges go with it (stale shard extents
+        # would mislead the operator view until the next shard())
         self._partition = None
+        self._frontier_shards = None
         # guard registry across membership changes (surviving rows keep
         # their indices — head rows on shrink, appended rows on grow):
         # a DEPARTED actor's tokens may still circulate via gossip, so a
@@ -2823,6 +3309,11 @@ class ReplicatedRuntime:
         else:
             # re-sharding without partition returns to the gather path
             self._partition = None
+        # sharding moves buffers, not values: frontiers stay valid. The
+        # shard extent feeds the per-shard frontier gauges.
+        from .shard_gossip import axis_extent
+
+        self._frontier_shards = axis_extent(mesh, part_axis)
         self._step = None
         self._fused_steps_cache.clear()
 

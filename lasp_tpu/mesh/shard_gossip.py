@@ -371,6 +371,43 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
     )
 
 
+def shard_frontier_counts(frontier, n_shards: int):
+    """``int64[S]``: dirty-replica frontier rows per contiguous shard
+    block (the block sharding every ``rt.shard`` layout uses). Feeds the
+    ``gossip_frontier_shard_rows`` gauges — "which shard still has delta
+    to push" — and lets an operator see a frontier collapse stall on one
+    shard (a lagging device) instead of reading it off the ICI profile.
+    Trailing rows of a non-divisible population fold into the last
+    block, matching how the partitioner pads."""
+    import numpy as np
+
+    f = np.asarray(frontier, dtype=bool)
+    n = f.shape[0]
+    block = max(n // int(n_shards), 1)
+    counts = np.zeros(int(n_shards), dtype=np.int64)
+    for s in range(int(n_shards)):
+        lo = s * block
+        hi = (s + 1) * block if s < n_shards - 1 else n
+        counts[s] = int(f[lo:hi].sum())
+    return counts
+
+
+def frontier_cut_rows(frontier, plan: dict) -> int:
+    """How many of the boundary-exchange plan's cut rows are currently
+    frontier-dirty — the rows whose next exchange actually carries new
+    state. A full cut with an empty dirty intersection means the
+    exchange is shipping pure no-ops (the dense-path cost the frontier
+    engine exists to skip). Upper bound: the plan's pad slots alias each
+    shard's block-row 0, so a dirty row 0 can count once per shard."""
+    import numpy as np
+
+    f = np.asarray(frontier, dtype=bool)
+    B = plan["block"]
+    send = np.asarray(plan["send_idx"])  # [S, M] block-local ids, pad 0
+    rows = send + np.arange(send.shape[0])[:, None] * B
+    return int(np.unique(rows[f[rows]]).size)
+
+
 def axis_extent(mesh: Mesh, axis) -> int:
     """Total shard count of a mesh axis name or tuple of names."""
     names = (axis,) if isinstance(axis, str) else tuple(axis)

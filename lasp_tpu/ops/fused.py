@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..mesh.gossip import gossip_round
+from ..mesh.gossip import _tree_where, gossip_round
 
 
 def fused_gossip_rounds(codec, spec, states, neighbors, n_rounds: int, edge_mask=None):
@@ -52,3 +52,43 @@ def fused_gossip_rounds_count(
         0, n_rounds, body, (states, jnp.zeros((), jnp.int32))
     )
     return out, prod
+
+
+def fused_frontier_rounds(
+    codec, spec, states, neighbors, frontier, n_rounds: int, edge_mask=None
+):
+    """Frontier-carried twin of :func:`fused_gossip_rounds_count`: run up
+    to ``n_rounds`` pull rounds inside one ``lax.while_loop`` with a
+    device-resident dirty mask ``frontier: bool[R]``, EXITING EARLY the
+    moment the frontier empties (nothing can change any further round —
+    post-convergence no-ops are never executed, without a host probe).
+
+    Each round only rows reachable from the frontier may change
+    (``reach[r] = any(frontier[neighbors[r, :]])``, dead edges excluded
+    under ``edge_mask``); the new frontier is exactly the rows the round
+    inflated. Per-round compute here stays dense (the masked select is
+    for exact frontier semantics, not work skipping — this variant
+    serves SHARDED populations, where a host-scheduled row gather would
+    fight the partitioner; the work-skipping host path is
+    ``mesh.gossip.gossip_round_rows``). Returns ``(new_states,
+    new_frontier, n_productive)``."""
+
+    def cond(carry):
+        _s, f, i = carry
+        return (i < n_rounds) & jnp.any(f)
+
+    def body(carry):
+        s, f, i = carry
+        fanin = f[neighbors]  # [R, K]
+        if edge_mask is not None:
+            fanin = fanin & edge_mask
+        reach = jnp.any(fanin, axis=1)
+        new = gossip_round(codec, spec, s, neighbors, edge_mask)
+        new = _tree_where(reach, new, s)
+        changed = jax.vmap(lambda a, b: ~codec.equal(spec, a, b))(s, new)
+        return new, changed, i + 1
+
+    out, f, i = jax.lax.while_loop(
+        cond, body, (states, frontier, jnp.int32(0))
+    )
+    return out, f, i

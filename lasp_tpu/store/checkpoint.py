@@ -155,14 +155,36 @@ def _get_state(hs: HostStore, var_id: str, template, manifest_entry: dict):
         # device arrays, not numpy views: codec ops use .at[] updates
         out.append(jnp.asarray(np.frombuffer(raw, dtype=dtype).reshape(shape)))
     if len(out) < len(leaves):
-        # schema migration: round 5 appended the reset-remove tombs
-        # planes to MapState, which flatten AFTER every pre-existing
-        # leaf. A pre-round-5 reset-map snapshot therefore stores a
-        # strict prefix of today's leaves — the missing trailing planes
-        # take the template's bottoms (zero baselines: the old engine
-        # bottom-reset contents at the source, so nothing needs
-        # subtracting). Shape mismatches still fail loudly below.
-        out.extend(leaves[len(out):])
+        # schema migration, NARROWLY gated: round 5 appended the
+        # reset-remove tombs planes to MapState, which flatten AFTER
+        # every pre-existing leaf — a pre-round-5 RESET-MODE map
+        # snapshot therefore stores a strict prefix of today's leaves,
+        # and ONLY the tombs suffix may take the template's bottoms
+        # (zero baselines: the old engine bottom-reset contents at the
+        # source, so nothing needs subtracting). Any other short
+        # snapshot — a different type, a non-reset map, or a fill that
+        # would cover more than the tombs planes — is a TRUNCATED
+        # checkpoint and must fail loudly, not load half a state.
+        missing = leaves[len(out):]
+        tombs = getattr(template, "tombs", None)
+        n_tombs = (
+            len(jax.tree_util.tree_leaves(tombs))
+            if tombs is not None
+            else 0
+        )
+        if (
+            manifest_entry.get("type_name") == "riak_dt_map"
+            and n_tombs
+            and len(missing) == n_tombs
+        ):
+            out.extend(missing)
+        else:
+            raise IOError(
+                f"checkpoint truncated for {var_id}: snapshot has "
+                f"{len(manifest_entry['leaves'])} leaves, current layout "
+                f"needs {len(leaves)} (only a reset-mode riak_dt_map may "
+                "backfill, and only its tombs planes)"
+            )
     if len(out) != len(leaves):
         raise IOError(
             f"checkpoint leaf count mismatch for {var_id}: snapshot has "
@@ -307,6 +329,10 @@ def load_runtime(path: str, graph=None, n_replicas=None, neighbors=None):
             rt.states[var_id] = _get_state(
                 hs, var_id, rt.states[var_id], entry
             )
+            # restored rows carry no row-level change provenance: the
+            # frontier degrades to all-dirty (the conservative rule the
+            # delta-gossip engine uses everywhere knowledge is lost)
+            rt.mark_dirty(var_id)
         if n_replicas is not None and n_replicas != manifest["n_replicas"]:
             if neighbors is None:
                 raise ValueError(

@@ -269,6 +269,15 @@ class Store:
         #: bumped on every effective write; lets the dataflow engine skip
         #: propagation when nothing changed since its last fixed point
         self.mutations = 0
+        #: per-variable write stamps (var -> ``mutations`` value at its
+        #: last write) — the store-level dirty marks that let
+        #: ``Graph.propagate`` recompute only edges whose sources moved
+        #: (frontier scheduling's host twin). Stamped by every write
+        #: path (:meth:`_write` — bind / update / ingest / bind_raw —
+        #: plus state surgery like compaction/redeclare); consumers keep
+        #: their own cursor (:meth:`dirty_since`), so several graphs can
+        #: share one store without stealing each other's marks.
+        self.dirty_seq: dict = {}
 
     # -- declare ------------------------------------------------------------
     def declare(
@@ -546,6 +555,9 @@ class Store:
         var.spec = spec
         var.state = codec.new(spec)
         var.elems = elems
+        # layout swap: downstream edges must re-run against it
+        self.mutations += 1
+        self.dirty_seq[id] = self.mutations
         # keep auxiliary universes consistent with the new type (declare()
         # parity): an ivar needs a payload interner, other types none
         var.ivar_payloads = (
@@ -563,6 +575,13 @@ class Store:
 
     def ids(self) -> list:
         return list(self._vars)
+
+    def dirty_since(self, cursor: int) -> set:
+        """Variables written after ``cursor`` (a ``mutations`` value the
+        caller saved) — the consumer half of the dirty marks (see
+        ``dirty_seq``): each dataflow graph keeps its own cursor, so
+        marks are never consumed destructively."""
+        return {v for v, m in self.dirty_seq.items() if m > cursor}
 
     # -- update / bind ------------------------------------------------------
     def update(self, id: str, op: tuple, actor) -> Any:
@@ -759,6 +778,7 @@ class Store:
         (``src/lasp_core.erl:838-844`` + ``reply_to_all`` :774-794)."""
         var.state = state
         self.mutations += 1
+        self.dirty_seq[var.id] = self.mutations
         # snapshot: watch callbacks may retire siblings (read_any) or park
         # new watches on this same variable while we iterate
         pending = var.waiting
@@ -1091,6 +1111,10 @@ class Store:
                 ),
             )
             shim.elems = fresh
+            # reindexing changes the bit layout every edge projection
+            # reads: the next propagate must re-run edges off this var
+            self.mutations += 1
+            self.dirty_seq[map_id] = self.mutations
         return reclaimed
 
     @staticmethod
@@ -1128,4 +1152,7 @@ class Store:
         if reclaimed:
             var.state = self.reindex_orset_state(var.state, order)
             var.elems = fresh
+            # element layout changed under any attached edges
+            self.mutations += 1
+            self.dirty_seq[id] = self.mutations
         return reclaimed

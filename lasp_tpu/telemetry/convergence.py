@@ -86,6 +86,9 @@ class ConvergenceMonitor:
         self.residual_curve: list = []
         self.memberships: list = []  # [(round, kind, old_n, new_n)]
         self.last_probe: "dict | None" = None
+        #: var -> dirty-replica frontier size after the last frontier
+        #: round (delta-gossip scheduling; empty when dense-only)
+        self.frontier: dict = {}
         self._tel: "dict | None" = None
 
     def _check_generation(self) -> None:
@@ -144,6 +147,15 @@ class ConvergenceMonitor:
                 if quiescent:
                     for ent in self.vars.values():
                         ent["residual"] = 0
+
+    def observe_frontier(self, var_ids, sizes) -> None:
+        """Dirty-set sizes after a frontier-scheduled round — the
+        delta-gossip twin of the residual feed: residual says how many
+        rows CHANGED, the frontier says how many can still change."""
+        with self._lock:
+            self._check_generation()
+            for v, n in zip(var_ids, sizes):
+                self.frontier[v] = int(n)
 
     def observe_membership(self, kind: str, old_n: int, new_n: int) -> None:
         with self._lock:
@@ -280,6 +292,22 @@ class ConvergenceMonitor:
             "mean_replica_lag": round(float(lag.mean()), 4) if n else 0.0,
             "shard_lag": shard_lag,
         }
+        part = getattr(runtime, "_partition", None)
+        masks = getattr(runtime, "_frontier", None)
+        if part is not None and masks:
+            # dirty ∩ cut: how many boundary-exchange rows actually carry
+            # new state — a full cut with an empty intersection means the
+            # exchange ships pure no-ops (the delta-gossip waste signal)
+            from ..mesh.shard_gossip import frontier_cut_rows
+
+            union = np.zeros((n,), dtype=bool)
+            for m in masks.values():
+                if m.shape[0] == n:
+                    union |= m
+            probe["frontier_cut_rows"] = frontier_cut_rows(
+                union, part["plan"]
+            )
+            probe["cut_rows"] = int(part["plan"]["stats"]["send_rows"])
         if _registry.enabled():
             reg = _registry.get_registry()
             for v, behind in per_var.items():
@@ -395,6 +423,7 @@ class ConvergenceMonitor:
                     key=lambda x: (-x[1], x[0]),
                 )[: self.top_k],
                 "quiescence_eta": self._eta_locked(),
+                "frontier_by_var": dict(self.frontier),
                 "residual_curve": curve[-64:],
                 "memberships": list(self.memberships),
                 "probe": self.last_probe,

@@ -58,6 +58,7 @@ EVENT_TYPES = frozenset({
     "membership",      # resize / partition plan / checkpoint restore
     "propagate",       # one dataflow propagate-to-fixpoint run
     "edge_recompute",  # DEEP: one edge's recompute provenance
+    "frontier_skip",   # dirty-set scheduling skipped vars/edges outright
 })
 
 _lock = threading.Lock()

@@ -164,3 +164,33 @@ def test_replicate_locality_ordering():
     assert rt2.locality_perm is None
     rt3 = s2.replicate(16, topology="scale_free", locality=False)
     assert rt3.locality_perm is None
+
+
+def test_locality_reorder_note_emitted_once():
+    """Session.replicate(locality=True) renumbers irregular topologies —
+    the one-time heads-up (ISSUE-3 satellite) must fire exactly once per
+    process, point at rt.locality_perm, and stay silent for ring /
+    explicit-neighbors / locality=False replicates."""
+    import warnings
+
+    import lasp_tpu.api.session as session_mod
+    from lasp_tpu.mesh import ring
+
+    session_mod._locality_note_emitted = False
+    s = Session(n_actors=4)
+    s.declare("lasp_gset", n_elems=4)
+    with pytest.warns(UserWarning, match="locality_perm"):
+        rt = s.replicate(16, topology="scale_free", fanout=3, seed=1)
+    assert rt.locality_perm is not None
+    # second reordering replicate: silent (once per process)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        s.replicate(16, topology="random", fanout=3, seed=2)
+
+    # non-reordering paths never warn, even with the flag reset
+    session_mod._locality_note_emitted = False
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        s.replicate(16, topology="ring")
+        s.replicate(16, topology="scale_free", locality=False)
+        s.replicate(16, neighbors=ring(16, 2))

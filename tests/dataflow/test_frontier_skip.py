@@ -1,0 +1,124 @@
+"""Edge-level frontier scheduling in Graph.propagate: edges whose source
+set is clean are skipped, and skipping never changes values, rounds, or
+the fixed point (the idempotent-join argument, checked empirically)."""
+
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.store import Store
+from lasp_tpu.telemetry import get_registry
+
+
+def _skip_count():
+    fam = get_registry().snapshot().get("dataflow_edges_skipped_total")
+    if not fam:
+        return 0
+    return sum(s["value"] for s in fam["series"])
+
+
+def _build():
+    store = Store(n_actors=4)
+    g = Graph(store)
+    a = store.declare(id="a", type="lasp_gset", n_elems=8)
+    b = g.map(a, lambda x: x * 10, dst="b", dst_elems=8)
+    c = g.map(b, lambda x: x + 1, dst="c", dst_elems=8)
+    x = store.declare(id="x", type="lasp_gset", n_elems=8)
+    y = g.map(x, lambda t: -t, dst="y", dst_elems=8)
+    return store, g, (a, b, c, x, y)
+
+
+def test_untouched_chain_is_skipped():
+    store, g, (a, b, c, x, y) = _build()
+    store.update(a, ("add", 1), "w")
+    store.update(x, ("add", 5), "w")
+    g.propagate()  # first run: every edge owes its initial evaluation
+    assert store.value(c) == {11}
+    assert store.value(y) == {-5}
+
+    # a write into ONLY the a->b->c chain: the x->y edge must be skipped
+    before = _skip_count()
+    store.update(a, ("add", 2), "w")
+    rounds = g.propagate()
+    assert rounds >= 1
+    assert store.value(c) == {11, 21}
+    assert store.value(y) == {-5}  # untouched chain unchanged
+    assert _skip_count() > before
+
+
+def test_skipping_matches_full_recompute_values():
+    """The same write/propagate interleaving against a FRESH graph (whose
+    first propagate recomputes everything) lands identical values —
+    skipping is unobservable except in work counters."""
+    store, g, ids = _build()
+    a, b, c, x, y = ids
+    store.update(a, ("add", 1), "w")
+    g.propagate()
+    store.update(x, ("add", 3), "w")
+    g.propagate()
+    store.update(a, ("add", 2), "w")
+    g.propagate()
+
+    ref_store, ref_g, ref_ids = _build()
+    ra, _rb, rc, rx, ry = ref_ids
+    ref_store.update(ra, ("add", 1), "w")
+    ref_store.update(rx, ("add", 3), "w")
+    ref_store.update(ra, ("add", 2), "w")
+    ref_g.propagate()
+    for v, rv in ((c, rc), (y, ry), (b, _rb)):
+        assert store.value(v) == ref_store.value(rv)
+
+
+def test_clean_propagate_is_free():
+    store, g, (a, *_rest) = _build()
+    store.update(a, ("add", 1), "w")
+    g.propagate()
+    # nothing written since: zero rounds, zero sweeps (the _clean_mark
+    # fast path), and the dirty cursor holds
+    assert g.propagate() == 0
+
+
+def test_watch_write_during_ingest_stays_dirty():
+    """A threshold watch writing mid-ingest must keep the graph dirty so
+    the next propagate folds the callback's write in — the frontier
+    cursor must not swallow it."""
+    store = Store(n_actors=4)
+    g = Graph(store)
+    a = store.declare(id="a", type="lasp_gset", n_elems=8)
+    b = g.map(a, lambda x: x, dst="b", dst_elems=8)
+    other = store.declare(id="o", type="lasp_gset", n_elems=8)
+    g.map(other, lambda x: x, dst="o2", dst_elems=8)
+
+    fired = []
+
+    def cb(result):
+        fired.append(result)
+        store.update(other, ("add", 7), "w")
+
+    from lasp_tpu.lattice import Threshold
+
+    var_b = store.variable(b)
+    # parked strict-above-bottom watch: fires on b's FIRST inflation,
+    # which happens inside propagate's ingest
+    w = store.read(b, Threshold(var_b.codec.new(var_b.spec), strict=True))
+    assert not w.done
+    w.callback = cb
+    store.update(a, ("add", 1), "w")
+    g.propagate()
+    assert fired  # the watch fired mid-ingest
+    g.propagate()  # folds the callback's write into o2
+    assert store.value("o2") == store.value(other) == {7}
+
+
+def test_dirty_cursor_is_per_graph():
+    """Two graphs over one store must not steal each other's marks."""
+    store = Store(n_actors=4)
+    g1 = Graph(store)
+    g2 = Graph(store)
+    a = store.declare(id="a", type="lasp_gset", n_elems=8)
+    g1.map(a, lambda x: x, dst="d1", dst_elems=8)
+    g2.map(a, lambda x: x, dst="d2", dst_elems=8)
+    store.update(a, ("add", 1), "w")
+    g1.propagate()  # consumes ITS view of the marks
+    g2.propagate()  # must still see the write
+    assert store.value("d1") == {1}
+    assert store.value("d2") == {1}

@@ -1,0 +1,437 @@
+"""Frontier (delta) gossip property tier: dirty-set scheduling must be
+BIT-IDENTICAL to dense gossip — same fixed point AND same per-round
+states — across codecs, edge_mask failure injection, and shard
+boundaries (ISSUE-3 acceptance). The frontier's whole soundness
+argument is one invariant: the scheduled row set is always a superset
+of the rows that round could change; these tests check the consequence
+directly instead of trusting the argument."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.lattice import GCounter, GCounterSpec, GSet, GSetSpec, ORSWOT, ORSWOTSpec
+from lasp_tpu.lattice.base import replicate
+from lasp_tpu.mesh import ReplicatedRuntime, random_regular, ring
+from lasp_tpu.mesh.gossip import (
+    frontier_reach,
+    gossip_round,
+    gossip_round_rows,
+)
+from lasp_tpu.mesh.topology import edge_failure_mask
+from lasp_tpu.ops import PackedORSet, PackedORSetSpec
+from lasp_tpu.ops.fused import fused_frontier_rounds, fused_gossip_rounds_count
+from lasp_tpu.store import Store
+
+
+def _tree_eq(a, b) -> bool:
+    flags = jax.tree_util.tree_map(
+        lambda x, y: bool(jnp.array_equal(x, y)), a, b
+    )
+    return all(jax.tree_util.tree_leaves(flags))
+
+
+def _seed_cases(n):
+    """(codec, spec, states, dirty_rows) per codec family: a handful of
+    rows carry non-bottom state (the client-write shape)."""
+    rng = np.random.RandomState(3)
+    rows = np.unique(rng.randint(0, n, size=max(2, n // 20)))
+    cases = []
+
+    gspec = GSetSpec(n_elems=16)
+    g = replicate(GSet.new(gspec), n)
+    g = g._replace(
+        mask=g.mask.at[jnp.asarray(rows), jnp.asarray(rows % 16)].set(True)
+    )
+    cases.append(("lasp_gset", GSet, gspec, g, rows))
+
+    cspec = GCounterSpec(n_actors=8)
+    c = replicate(GCounter.new(cspec), n)
+    c = c._replace(
+        counts=c.counts.at[jnp.asarray(rows), jnp.asarray(rows % 8)].set(
+            jnp.asarray((rows % 5 + 1).astype(np.int32))
+        )
+    )
+    cases.append(("riak_dt_gcounter", GCounter, cspec, c, rows))
+
+    ospec = ORSWOTSpec(n_elems=8, n_actors=8)
+    o = replicate(ORSWOT.new(ospec), n)
+    for i, r in enumerate(rows):
+        row = jax.tree_util.tree_map(lambda x: x[int(r)], o)
+        row = ORSWOT.add(ospec, row, int(r) % 8, int(r) % 8)
+        if i % 2:  # some removes too: dots churn under equal clocks
+            row = ORSWOT.add(ospec, row, (int(r) + 1) % 8, int(r) % 8)
+            row = ORSWOT.remove(ospec, row, int(r) % 8)
+        o = jax.tree_util.tree_map(
+            lambda x, v: x.at[int(r)].set(v), o, row
+        )
+    cases.append(("riak_dt_orswot", ORSWOT, ospec, o, rows))
+
+    pspec = PackedORSetSpec(n_elems=8, n_actors=4, tokens_per_actor=2)
+    p = replicate(PackedORSet.new(pspec), n)
+    p = jax.vmap(
+        lambda i, s: PackedORSet.add(
+            pspec, s, i % pspec.n_elems, i % pspec.n_actors
+        )
+    )(jnp.asarray(rows), jax.tree_util.tree_map(lambda x: x[rows], p))
+    base = replicate(PackedORSet.new(pspec), n)
+    p = jax.tree_util.tree_map(
+        lambda full, sub: full.at[jnp.asarray(rows)].set(sub), base, p
+    )
+    cases.append(("lasp_orset(packed)", PackedORSet, pspec, p, rows))
+    return cases
+
+
+@pytest.mark.parametrize("masked", [False, True])
+def test_rows_kernel_bit_identical_per_round(masked):
+    """gossip_round_rows over the frontier-reach set reproduces every
+    dense round exactly, for every codec family, to the fixed point."""
+    n, k = 96, 3
+    nbrs_np = random_regular(n, k, seed=1)
+    nbrs = jnp.asarray(nbrs_np)
+    mask_np = edge_failure_mask(n, k, 0.3, seed=2) if masked else None
+    mask = jnp.asarray(mask_np) if masked else None
+    for name, codec, spec, states, rows in _seed_cases(n):
+        dense = states
+        sparse = states
+        frontier = np.zeros(n, dtype=bool)
+        frontier[rows] = True
+        for rnd in range(64):
+            new_dense = gossip_round(codec, spec, dense, nbrs, mask)
+            reach = frontier_reach(frontier, nbrs_np)
+            if masked:
+                reach = (
+                    frontier[nbrs_np] & np.asarray(mask_np)
+                ).any(axis=1)
+            idx = np.flatnonzero(reach)
+            if idx.size:
+                sparse, changed = gossip_round_rows(
+                    codec, spec, sparse, nbrs, jnp.asarray(idx), mask
+                )
+                frontier = np.zeros(n, dtype=bool)
+                frontier[idx[np.asarray(changed)]] = True
+            else:
+                frontier = np.zeros(n, dtype=bool)
+            assert _tree_eq(new_dense, sparse), (name, rnd)
+            quiescent = _tree_eq(dense, new_dense)
+            dense = new_dense
+            if quiescent:
+                assert not frontier.any(), name  # frontier agrees: done
+                break
+        else:
+            pytest.fail(f"{name}: no convergence in 64 rounds")
+
+
+def test_rows_kernel_accepts_duplicate_padding():
+    n = 32
+    nbrs = jnp.asarray(random_regular(n, 3, seed=5))
+    _nm, codec, spec, states, rows = _seed_cases(n)[0]
+    ref = gossip_round(codec, spec, states, nbrs)
+    all_rows = np.arange(n)
+    padded = np.concatenate([all_rows, all_rows[:7]])  # duplicates
+    out, _ = gossip_round_rows(codec, spec, states, nbrs, jnp.asarray(padded))
+    assert _tree_eq(ref, out)
+
+
+def test_fused_frontier_rounds_matches_dense_and_early_exits():
+    n = 64
+    nbrs = jnp.asarray(random_regular(n, 3, seed=7))
+    _nm, codec, spec, states, rows = _seed_cases(n)[0]
+    f0 = jnp.zeros(n, dtype=bool).at[jnp.asarray(rows)].set(True)
+    budget = 50
+    out_f, f_end, prod = fused_frontier_rounds(
+        codec, spec, states, nbrs, f0, budget
+    )
+    out_d, prod_d = fused_gossip_rounds_count(
+        codec, spec, states, nbrs, budget
+    )
+    assert _tree_eq(out_f, out_d)
+    assert not bool(jnp.any(f_end))
+    # early exit: productive rounds + the frontier-emptying round, far
+    # under the budget (dense fori always burns all 50)
+    assert int(prod) <= int(prod_d) + 1 < budget
+
+    # empty frontier: zero rounds, states untouched
+    out0, f0_end, prod0 = fused_frontier_rounds(
+        codec, spec, states, nbrs, jnp.zeros(n, bool), budget
+    )
+    assert int(prod0) == 0 and _tree_eq(out0, states)
+
+
+@pytest.mark.parametrize("topology", ["random", "ring"])
+@pytest.mark.parametrize("crossover", [0.25, 0.0])
+def test_runtime_frontier_vs_dense_bit_identical(topology, crossover):
+    """Engine-level property: frontier_step and step produce identical
+    per-round states, residuals, and round counts — including when the
+    crossover forces every frontier round onto the dense per-var arm
+    (crossover=0)."""
+    # ring diameter is n/2: keep it small enough for the round cap
+    n = 128 if topology == "random" else 48
+    nbrs = (
+        random_regular(n, 3, seed=11) if topology == "random" else ring(n, 2)
+    )
+
+    def build():
+        store = Store(n_actors=4)
+        v1 = store.declare(id="a", type="lasp_gset", n_elems=16)
+        v2 = store.declare(id="b", type="riak_dt_gcounter", n_actors=4)
+        rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+        rng = np.random.RandomState(2)
+        rows = rng.choice(n, 6, replace=False)
+        rt.update_batch(
+            v1, [(int(r), ("add", f"e{r % 4}"), f"c{r}") for r in rows]
+        )
+        rt.update_batch(v2, [(int(rows[0]), ("increment", 3), "w0")])
+        return rt, (v1, v2)
+
+    rt_f, ids = build()
+    rt_f.frontier_crossover = crossover
+    rt_d, _ = build()
+    for rnd in range(64):
+        rf, rd = rt_f.frontier_step(), rt_d.step()
+        assert rf == rd, rnd
+        for v in ids:
+            assert _tree_eq(rt_f.states[v], rt_d.states[v]), (v, rnd)
+        if rd == 0:
+            break
+    else:
+        pytest.fail("no convergence")
+    assert all(rt_f.divergence(v) == 0 for v in ids)
+    # the skipped-var accounting: variable "b" quiesces rounds before
+    # "a"; its empty frontier must have produced skip events
+    from lasp_tpu.telemetry import events as tel_events
+
+    assert any(
+        r["etype"] == "frontier_skip" for r in tel_events.events()
+    )
+
+
+def test_run_to_convergence_modes_agree():
+    n = 96
+
+    def build():
+        store = Store(n_actors=4)
+        v = store.declare(id="a", type="lasp_gset", n_elems=8)
+        rt = ReplicatedRuntime(
+            store, Graph(store), n, random_regular(n, 3, seed=4)
+        )
+        rt.update_batch(v, [(5, ("add", "x"), "c5"), (40, ("add", "y"), "c40")])
+        return rt, v
+
+    rt_f, v = build()
+    rt_d, _ = build()
+    rounds_f = rt_f.run_to_convergence(mode="frontier")
+    rounds_d = rt_d.run_to_convergence(block=4)
+    assert rounds_f == rounds_d
+    assert _tree_eq(rt_f.states[v], rt_d.states[v])
+
+
+def test_frontier_with_edge_mask_matches_dense():
+    n, k = 96, 3
+    nbrs = random_regular(n, k, seed=9)
+    mask = jnp.asarray(edge_failure_mask(n, k, 0.4, seed=1))
+
+    def build():
+        store = Store(n_actors=4)
+        v = store.declare(id="a", type="lasp_gset", n_elems=8)
+        rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+        rt.update_batch(v, [(0, ("add", "x"), "c0"), (70, ("add", "y"), "c70")])
+        return rt, v
+
+    rt_f, v = build()
+    rt_d, _ = build()
+    for _ in range(64):
+        rf, rd = rt_f.frontier_step(mask), rt_d.step(mask)
+        assert rf == rd
+        assert _tree_eq(rt_f.states[v], rt_d.states[v])
+        if rd == 0:
+            return
+    pytest.fail("no fixed point under the static mask")
+
+
+def test_frontier_mode_refuses_edges_and_triggers():
+    store = Store(n_actors=4)
+    g = Graph(store)
+    v = store.declare(id="a", type="lasp_gset", n_elems=8)
+    g.map(v, lambda x: x, dst="out", dst_elems=8)
+    rt = ReplicatedRuntime(store, g, 16, ring(16, 2))
+    with pytest.raises(RuntimeError, match="edges / triggers"):
+        rt.frontier_step()
+    with pytest.raises(RuntimeError, match="frontier gossip unavailable"):
+        rt.run_to_convergence(mode="frontier")
+    # auto falls back to dense and still converges
+    assert rt.run_to_convergence(mode="auto") >= 1
+
+
+def test_packed_mode_frontier():
+    """Packed wire-format populations ride the same sparse kernels (the
+    flat codec is leafwise-or)."""
+    n = 64
+
+    def build():
+        store = Store(n_actors=4)
+        v = store.declare(
+            id="s", type="lasp_orset", n_elems=8, n_actors=4,
+            tokens_per_actor=2,
+        )
+        rt = ReplicatedRuntime(
+            store, Graph(store), n, random_regular(n, 3, seed=6),
+            packed=True,
+        )
+        rt.update_batch(
+            v, [(3, ("add", "p"), "w3"), (50, ("add", "q"), "w50")]
+        )
+        return rt, v
+
+    rt_f, v = build()
+    rt_d, _ = build()
+    rounds_f = rt_f.run_to_convergence(mode="frontier")
+    rounds_d = rt_d.run_to_convergence()
+    assert rounds_f == rounds_d
+    assert _tree_eq(rt_f.states[v], rt_d.states[v])
+    assert rt_f.coverage_value(v) == {"p", "q"}
+
+
+def test_resize_degrades_frontier_conservatively():
+    """Fresh bottom rows must catch up from QUIESCENT peers — only the
+    all-dirty degrade on resize makes that reachable for the frontier
+    scheduler."""
+    n = 48
+    store = Store(n_actors=4)
+    v = store.declare(id="a", type="lasp_gset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), n, random_regular(n, 3, seed=8))
+    rt.update_batch(v, [(0, ("add", "x"), "c0")])
+    rt.run_to_convergence(mode="frontier")
+    assert rt.frontier_size(v) == 0
+    rt.resize(n + 16, random_regular(n + 16, 3, seed=9))
+    assert rt.frontier_size(v) == n + 16  # all-dirty
+    rt.run_to_convergence(mode="frontier")
+    assert rt.divergence(v) == 0
+    assert rt.replica_value(v, n + 15) == {"x"}  # the new row caught up
+
+
+def test_mark_dirty_after_direct_state_surgery():
+    n = 32
+    store = Store(n_actors=4)
+    v = store.declare(id="a", type="lasp_gset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), n, random_regular(n, 3, seed=2))
+    rt.run_to_convergence(mode="frontier")  # quiescent, empty frontiers
+    st = rt.states[v]
+    rt.states[v] = st._replace(mask=st.mask.at[7, 3].set(True))
+    rt.mark_dirty(v, [7])
+    rt.run_to_convergence(mode="frontier")
+    assert rt.divergence(v) == 0
+    assert rt.coverage_value(v) == rt.replica_value(v, 0)
+
+
+def test_fused_frontier_rounds_across_shard_boundaries():
+    """Shard-boundary arm of the equivalence property: the device-side
+    frontier block on a population sharded over the 8-device CPU mesh
+    lands the same states as the dense rounds on unsharded state."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n = 128
+    n_dev = len(jax.devices())
+    nbrs = jnp.asarray(random_regular(n, 3, seed=3))
+    _nm, codec, spec, states, rows = _seed_cases(n)[0]
+    ref, _prod = fused_gossip_rounds_count(codec, spec, states, nbrs, 32)
+
+    mesh = Mesh(np.array(jax.devices()), ("replicas",))
+    sh = NamedSharding(mesh, P("replicas"))
+    sharded = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sh), states
+    )
+    f0 = jnp.zeros(n, bool).at[jnp.asarray(rows)].set(True)
+    out, f_end, _prod2 = jax.jit(
+        lambda s, f: fused_frontier_rounds(codec, spec, s, nbrs, f, 32)
+    )(sharded, jax.device_put(f0, NamedSharding(mesh, P("replicas"))))
+    assert _tree_eq(out, ref)
+    assert not bool(jnp.any(f_end))
+
+
+def test_shard_frontier_counts():
+    from lasp_tpu.mesh.shard_gossip import shard_frontier_counts
+
+    f = np.zeros(64, bool)
+    f[[0, 1, 17, 63]] = True
+    counts = shard_frontier_counts(f, 4)
+    assert counts.tolist() == [2, 1, 0, 1]
+    assert shard_frontier_counts(f, 3).sum() == 4  # ragged tail folds in
+
+
+def test_mask_change_degrades_frontier():
+    """Quiescence under failure injection is only a fixed point of the
+    MASKED graph: lifting (or changing) the mask must degrade every
+    frontier to all-dirty, or a later frontier run falsely reports
+    convergence while mask-separated replicas still diverge (the
+    review-confirmed repro: dead-mask converge -> unmasked frontier run
+    returned 1 with divergence intact)."""
+    n, k = 8, 1
+    store = Store(n_actors=4)
+    v = store.declare(id="a", type="lasp_gset", n_elems=4)
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, k))
+    rt.update_at(0, v, ("add", "x"), "w0")
+    dead = jnp.zeros((n, k), dtype=bool)  # total partition
+    assert rt.run_to_convergence(edge_mask=dead) == 1  # masked fixed point
+    assert rt.divergence(v) == n - 1  # nothing delivered
+    # partition heals: the unmasked frontier run must deliver everywhere
+    rounds = rt.run_to_convergence(mode="frontier")
+    assert rt.divergence(v) == 0
+    assert rounds >= 2
+    assert rt.coverage_value(v) == {"x"} == rt.replica_value(v, n - 1)
+
+
+def test_probe_reports_frontier_cut_rows():
+    """A dense-scheduled partitioned runtime still maintains frontier
+    masks; the monitor probe reports dirty ∩ cut (the exchange-waste
+    signal)."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    from jax.sharding import Mesh
+
+    from lasp_tpu.telemetry import get_monitor, reset
+
+    n = 128
+    n_dev = len(jax.devices())
+    store = Store(n_actors=4)
+    v = store.declare(id="a", type="lasp_gset", n_elems=8)
+    rt = ReplicatedRuntime(
+        store, Graph(store), n, random_regular(n, 3, seed=4)
+    )
+    rt.update_at(0, v, ("add", "x"), "w0")
+    rt.shard(
+        Mesh(np.array(jax.devices()), ("replicas",)),
+        axis="replicas", partition=True,
+    )
+    try:
+        probe = get_monitor().probe(rt)
+        assert "frontier_cut_rows" in probe and "cut_rows" in probe
+        assert 0 <= probe["frontier_cut_rows"] <= probe["cut_rows"] + n_dev
+    finally:
+        # the probe stamped per-shard gauges for THIS test's 8-shard
+        # layout into the process-global registry; exact-series
+        # assertions elsewhere (test_convergence's probe test) must not
+        # see them
+        reset()
+
+
+def test_frontier_cut_rows():
+    from lasp_tpu.mesh.shard_gossip import (
+        frontier_cut_rows,
+        partitioned_gossip_plan,
+    )
+    from lasp_tpu.mesh.topology import locality_order, scale_free
+
+    n, s = 128, 4
+    _perm, nbrs = locality_order(scale_free(n, 3, seed=2))
+    plan = partitioned_gossip_plan(nbrs, s)
+    full = np.ones(n, bool)
+    # every cut row dirty (pad aliasing can only add shard-row-0 dups,
+    # bounded by the shard count)
+    hi = frontier_cut_rows(full, plan)
+    assert plan["stats"]["send_rows"] <= hi <= plan["stats"]["send_rows"] + s
+    assert frontier_cut_rows(np.zeros(n, bool), plan) == 0

@@ -1,0 +1,92 @@
+"""Regression tier for the ISSUE-3 map/population lock-step satellites:
+
+1. a LATE-DECLARED schemaless map's first update naming a fresh
+   ``{Name, Type}`` key must not KeyError in ``_grow_map_population``
+   (the spec used to grow while the population row was never created) —
+   both the ``update_at`` and ``update_batch`` paths;
+2. map fields admitted on the STORE variable behind the runtime's back
+   (the bridge's merge_batch/import path) must be resolved by
+   ``_population``'s spec/state field-axis re-layout, and an impossible
+   shrink must raise clearly."""
+
+import numpy as np
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import Store
+
+
+def _rt(n: int = 4):
+    store = Store(n_actors=4)
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, 2))
+    return store, rt
+
+
+KEY = ("S", "lasp_gset")
+KEY2 = ("C", "riak_dt_gcounter")
+
+
+def test_late_declared_map_first_update_at_admits_fresh_key():
+    store, rt = _rt()
+    # declared AFTER the runtime was built: no population row yet
+    m = store.declare(id="m", type="riak_dt_map", n_actors=4)
+    rt.update_at(1, m, ("update", [("update", KEY, ("add", "a"))]), "w")
+    # spec and population are in lock-step: the row holds the write and
+    # the population has exactly the admitted field axis
+    assert rt.replica_value(m, 1) == {KEY: {"a"}}
+    assert rt.states[m].dots.shape[-2] == store.variable(m).spec.n_fields
+    rt.run_to_convergence()
+    assert rt.coverage_value(m) == {KEY: {"a"}}
+
+
+def test_late_declared_map_first_update_batch_admits_fresh_key():
+    store, rt = _rt()
+    m = store.declare(id="m2", type="riak_dt_map", n_actors=4)
+    rt.update_batch(
+        m,
+        [
+            (0, ("update", [("update", KEY, ("add", "x"))]), "w0"),
+            (2, ("update", [("update", KEY2, ("increment", 2))]), "w2"),
+        ],
+    )
+    assert rt.states[m].dots.shape[-2] == store.variable(m).spec.n_fields
+    rt.run_to_convergence()
+    assert rt.coverage_value(m) == {KEY: {"x"}, KEY2: 2}
+
+
+def test_population_relayouts_fields_admitted_behind_runtimes_back():
+    store, rt = _rt()
+    m = store.declare(id="m3", type="riak_dt_map", n_actors=4)
+    rt.update_at(0, m, ("update", [("update", KEY, ("add", "a"))]), "w")
+    var = store.variable(m)
+    before = var.spec.n_fields
+    # the bridge's import path grows the STORE variable directly
+    # (server.py _validate_portable -> Store.grow_map_fields), with the
+    # runtime none the wiser
+    triple = Store.resolve_dynamic_field(var.spec, KEY2)
+    Store.grow_map_fields(var, [triple])
+    var.state = var.codec.grow(var.spec, var.state)
+    assert var.spec.n_fields == before + 1
+    assert rt.states[m].dots.shape[-2] == before  # skewed, not yet seen
+    # the next verb through _population re-lays-out the population
+    assert rt.replica_value(m, 0) == {KEY: {"a"}}
+    assert rt.states[m].dots.shape[-2] == before + 1
+    # and the admitted field is writable at mesh level right away
+    rt.update_at(1, m, ("update", [("update", KEY2, ("increment",))]), "w2")
+    rt.run_to_convergence()
+    assert rt.coverage_value(m) == {KEY: {"a"}, KEY2: 1}
+
+
+def test_population_with_more_fields_than_spec_raises():
+    store, rt = _rt()
+    m = store.declare(id="m4", type="riak_dt_map", n_actors=4)
+    rt.update_at(0, m, ("update", [("update", KEY, ("add", "a"))]), "w")
+    var = store.variable(m)
+    # simulate an impossible shrink (spec rolled back behind the
+    # runtime): must be a loud error, not a silent misaligned gather
+    import dataclasses
+
+    var.spec = dataclasses.replace(var.spec, fields=())
+    with pytest.raises(RuntimeError, match="field planes"):
+        rt.replica_value(m, 0)

@@ -25,6 +25,7 @@ def test_scenario_registry_complete():
         "packed_vs_dense",
         "bridge_throughput",
         "partitioned_gossip",
+        "frontier_sparse",
     }
 
 

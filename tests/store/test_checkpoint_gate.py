@@ -1,0 +1,135 @@
+"""The leaf-prefix backfill in checkpoint loading is a SCHEMA-MIGRATION
+shim, not a general pardon for truncated snapshots (ISSUE-3 satellite):
+only a reset-mode riak_dt_map may load a leaf prefix, and only when the
+missing suffix is exactly its tombs planes (the planes round 5 appended
+after every pre-existing leaf). Everything else must fail loudly."""
+
+import jax
+import numpy as np
+import pytest
+
+from lasp_tpu.store import Store
+from lasp_tpu.store.checkpoint import (
+    _get_state,
+    _state_leaf_meta,
+    load_store,
+    save_store,
+)
+
+
+class _FakeHS:
+    """Just enough of HostStore for _get_state: leaf records by key."""
+
+    def __init__(self, records):
+        self._r = dict(records)
+
+    def get(self, key):
+        return self._r.get(key)
+
+
+def _leaf_records(var_id, state, keep):
+    leaves = jax.tree_util.tree_leaves(state)
+    return {
+        f"leaf/{var_id}/{i}": np.asarray(leaf).tobytes()
+        for i, leaf in enumerate(leaves[:keep])
+    }
+
+
+def _reset_map_var():
+    store = Store(n_actors=4)
+    m = store.declare(
+        id="m", type="riak_dt_map", n_actors=4, reset_on_readd=True,
+        fields=[(("C", "riak_dt_gcounter"), "riak_dt_gcounter",
+                 {"n_actors": 4})],
+    )
+    store.update(m, ("update", [("update", ("C", "riak_dt_gcounter"),
+                                 ("increment", 3))]), "w")
+    return store.variable(m)
+
+
+def test_reset_map_backfills_exactly_the_tombs_planes():
+    var = _reset_map_var()
+    n_tombs = len(jax.tree_util.tree_leaves(var.state.tombs))
+    assert n_tombs >= 1
+    total = len(jax.tree_util.tree_leaves(var.state))
+    entry = {
+        "type_name": "riak_dt_map",
+        "leaves": _state_leaf_meta(var.state)[: total - n_tombs],
+    }
+    hs = _FakeHS(_leaf_records("m", var.state, total - n_tombs))
+    out = _get_state(hs, "m", var.state, entry)
+    # restored prefix round-trips; the tombs suffix took the template's
+    # planes verbatim
+    assert np.array_equal(np.asarray(out.clock), np.asarray(var.state.clock))
+    for got, tmpl in zip(
+        jax.tree_util.tree_leaves(out.tombs),
+        jax.tree_util.tree_leaves(var.state.tombs),
+    ):
+        assert np.array_equal(np.asarray(got), np.asarray(tmpl))
+
+
+def test_reset_map_truncated_past_tombs_raises():
+    var = _reset_map_var()
+    n_tombs = len(jax.tree_util.tree_leaves(var.state.tombs))
+    total = len(jax.tree_util.tree_leaves(var.state))
+    keep = total - n_tombs - 1  # one non-tombs leaf missing too
+    entry = {
+        "type_name": "riak_dt_map",
+        "leaves": _state_leaf_meta(var.state)[:keep],
+    }
+    hs = _FakeHS(_leaf_records("m", var.state, keep))
+    with pytest.raises(IOError, match="truncated"):
+        _get_state(hs, "m", var.state, entry)
+
+
+def test_non_map_truncation_raises():
+    store = Store(n_actors=4)
+    s = store.declare(id="s", type="lasp_orset", n_elems=4, n_actors=2)
+    store.update(s, ("add", "x"), "w")
+    var = store.variable(s)
+    total = len(jax.tree_util.tree_leaves(var.state))
+    assert total >= 2
+    entry = {
+        "type_name": "lasp_orset",
+        "leaves": _state_leaf_meta(var.state)[: total - 1],
+    }
+    hs = _FakeHS(_leaf_records("s", var.state, total - 1))
+    with pytest.raises(IOError, match="truncated"):
+        _get_state(hs, "s", var.state, entry)
+
+
+def test_default_mode_map_truncation_raises():
+    """A NON-reset map has no tombs planes — any short snapshot of it is
+    corruption, never migration."""
+    store = Store(n_actors=4)
+    m = store.declare(
+        id="m", type="riak_dt_map", n_actors=4,
+        fields=[(("G", "lasp_gset"), "lasp_gset", {"n_elems": 4})],
+    )
+    store.update(m, ("update", [("update", ("G", "lasp_gset"),
+                                 ("add", "a"))]), "w")
+    var = store.variable(m)
+    total = len(jax.tree_util.tree_leaves(var.state))
+    entry = {
+        "type_name": "riak_dt_map",
+        "leaves": _state_leaf_meta(var.state)[: total - 1],
+    }
+    hs = _FakeHS(_leaf_records("m", var.state, total - 1))
+    with pytest.raises(IOError, match="truncated"):
+        _get_state(hs, "m", var.state, entry)
+
+
+def test_full_round_trip_still_works(tmp_path):
+    """The gate must not disturb intact snapshots (reset map included)."""
+    store = Store(n_actors=4)
+    m = store.declare(
+        id="m", type="riak_dt_map", n_actors=4, reset_on_readd=True,
+        fields=[(("C", "riak_dt_gcounter"), "riak_dt_gcounter",
+                 {"n_actors": 4})],
+    )
+    store.update(m, ("update", [("update", ("C", "riak_dt_gcounter"),
+                                 ("increment", 2))]), "w")
+    path = str(tmp_path / "snap.log")
+    save_store(store, path)
+    loaded = load_store(path)
+    assert loaded.value(m) == store.value(m)

@@ -1,0 +1,79 @@
+#!/usr/bin/env python3
+"""Fast frontier-vs-dense equivalence smoke (Makefile ``verify``).
+
+One small population, two codecs (leafwise G-Set + vclock OR-SWOT via a
+G-Counter lane mix), stepped to the fixed point twice from identical
+seeds — dense ``step()`` vs ``frontier_step()`` — asserting identical
+states EVERY round and identical round counts. A sub-10s subset of
+tests/mesh/test_frontier.py for the lint-tier loop; exits 0 on
+agreement, 1 with a diff summary on drift."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable from anywhere (the Makefile invokes it from the repo root,
+# which may not be on sys.path for a bare `python tools/...` call)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.store import Store
+
+    n = 96
+    nbrs = random_regular(n, 3, seed=11)
+
+    def build():
+        store = Store(n_actors=4)
+        a = store.declare(id="a", type="lasp_gset", n_elems=16)
+        b = store.declare(id="b", type="riak_dt_orswot", n_elems=8,
+                          n_actors=4)
+        rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+        rng = np.random.RandomState(7)
+        rows = rng.choice(n, 5, replace=False)
+        rt.update_batch(
+            a, [(int(r), ("add", f"e{r % 4}"), f"c{r}") for r in rows]
+        )
+        rt.update_batch(b, [(int(rows[0]), ("add", "x"), "w0"),
+                            (int(rows[1]), ("add", "y"), "w1")])
+        return rt, (a, b)
+
+    rt_f, ids = build()
+    rt_d, _ = build()
+    for rnd in range(64):
+        rf, rd = rt_f.frontier_step(), rt_d.step()
+        if rf != rd:
+            print(f"frontier_smoke: residual drift at round {rnd}: "
+                  f"frontier={rf} dense={rd}", file=sys.stderr)
+            return 1
+        for v in ids:
+            same = jax.tree_util.tree_map(
+                lambda x, y: bool(jnp.array_equal(x, y)),
+                rt_f.states[v], rt_d.states[v],
+            )
+            if not all(jax.tree_util.tree_leaves(same)):
+                print(f"frontier_smoke: state drift at round {rnd}, "
+                      f"var {v!r}", file=sys.stderr)
+                return 1
+        if rd == 0:
+            skipped_ok = rt_f.frontier_size(ids[1]) == 0
+            print(f"frontier smoke OK: bit-identical over {rnd + 1} "
+                  f"rounds, frontiers empty={skipped_ok}")
+            return 0
+    print("frontier_smoke: no convergence within 64 rounds",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
