@@ -410,6 +410,17 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["frontier_sparse"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- chaos recovery arm (~seconds): composite nemesis (partition +
+    # rolling crash) over a seeded population; records rounds-to-heal,
+    # degraded-read repair traffic, and soak-vs-fault-free wall time,
+    # with post-heal bit-equality asserted inside the scenario ---------------
+    try:
+        from lasp_tpu.bench_scenarios import chaos_heal
+
+        detail["chaos_heal"] = chaos_heal()
+    except Exception as exc:
+        detail["chaos_heal"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- north-star: 10M-replica engine-path ad counter ---------------------
     ns0 = cfg.bench_northstar_replicas or (
         10 * (1 << 20) if on_tpu else (1 << 13)

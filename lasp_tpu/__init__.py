@@ -24,8 +24,8 @@ __version__ = "0.1.0"
 # server parent, bench.py's never-import-jax parent) need the namespace
 # without paying jax's import cost or risking any backend touch.
 _SUBMODULES = frozenset({
-    "api", "bridge", "config", "dataflow", "lattice", "mesh", "ops",
-    "programs", "store", "telemetry", "utils",
+    "api", "bridge", "chaos", "config", "dataflow", "lattice", "mesh",
+    "ops", "programs", "store", "telemetry", "utils",
 })
 _ATTRS = {
     "Session": ("api", "Session"),
@@ -53,6 +53,7 @@ __all__ = [
     "Session",
     "api",
     "bridge",
+    "chaos",
     "config",
     "dataflow",
     "get_config",

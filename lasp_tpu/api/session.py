@@ -244,6 +244,32 @@ class Session:
         rt.locality_perm = perm
         return rt
 
+    def nemesis(self, runtime, preset: str, *, seed: int = 0,
+                rounds: int = 12, checkpoint: "str | None" = None,
+                **kwargs):
+        """Wrap a replicated runtime (from :meth:`replicate`) in a
+        :class:`~lasp_tpu.chaos.ChaosRuntime` driving a preset fault
+        timeline — the session-level on-ramp to the chaos mesh
+        (docs/RESILIENCE.md):
+
+        >>> rt = session.replicate(64)
+        >>> chaos = session.nemesis(rt, "ring-cut", seed=3)
+        >>> report = chaos.soak()          # rounds_to_heal, repair bytes
+
+        ``preset`` is one of :data:`lasp_tpu.chaos.PRESETS` (ring-cut /
+        rolling-crash / flaky-links / slow-shard / delay-links); extra
+        kwargs reach the preset builder (drop rates, crash counts, …);
+        ``checkpoint`` backs ``Restore(source="checkpoint")`` rows. The
+        soak outcome lands in :meth:`health` under ``chaos``."""
+        from ..chaos import ChaosRuntime, nemesis as build_nemesis
+
+        _count_verb("nemesis")
+        schedule = build_nemesis(
+            preset, runtime.n_replicas, runtime._host_neighbors,
+            seed=seed, rounds=rounds, **kwargs,
+        )
+        return ChaosRuntime(runtime, schedule, checkpoint=checkpoint)
+
     # -- programs (L5, src/lasp_program.erl) ---------------------------------
     def register(self, name: str, program_cls, *args, **kwargs) -> str:
         """``lasp:register/4`` (``src/lasp.erl:84-86``): instantiate a

@@ -1055,25 +1055,111 @@ class BridgeServer:
 class BridgeClient:
     """Python reference client — emits byte-identical frames to the
     Erlang adapter (``lasp_tpu_backend.erl``). Used by the conformance
-    tests; also handy as an ops tool against a live server."""
+    tests; also handy as an ops tool against a live server.
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    Resilience: IDEMPOTENT verbs (``get`` / ``read`` / ``metrics`` /
+    ``health`` — pure reads whose double execution is harmless) retry
+    transparently across connection failures with exponential backoff +
+    jitter, reconnecting and replaying the session's ``{start, Name}``
+    binding first, so a bridge server killed and restarted mid-session
+    (a durable store picking its state back up) is invisible to read
+    traffic. NON-idempotent verbs (``update`` / ``bind`` /
+    ``merge_batch`` / ``declare`` / ``put`` / ``start``) fail FAST with
+    a clear error instead: a lost reply leaves the op's outcome unknown,
+    and blind replay could double-apply a non-idempotent op (a counter
+    increment) — exactly the reference's
+    at-most-once-unless-you-know-better FSM discipline. ``retries``
+    bounds the extra attempts, ``backoff`` seeds the exponential delay
+    (jittered ×[1, 2)), and ``timeout`` doubles as the per-call socket
+    deadline (override per call via ``call(..., timeout=...)``)."""
+
+    #: verbs whose replay is observationally harmless (pure reads)
+    IDEMPOTENT_VERBS = frozenset({"get", "read", "metrics", "health"})
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 retries: int = 2, backoff: float = 0.05):
+        self._host = host
+        self._port = port
+        self._timeout = timeout
+        self._retries = max(0, int(retries))
+        self._backoff = float(backoff)
+        #: the session's {start, Name} frame, replayed on reconnect so a
+        #: restarted durable server re-binds the same store
+        self._session_frame: "bytes | None" = None
         self._sock = socket.create_connection((host, port), timeout=timeout)
 
-    def call(self, term: Any) -> Any:
-        _send_frame(self._sock, etf.encode(term))
-        frame = _recv_frame(self._sock)
-        if frame is None:
-            raise ConnectionError("bridge server closed the connection")
-        return etf.decode(frame)
+    def _reconnect(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        if self._session_frame is not None:
+            # re-bind the session's store; the replayed start's reply is
+            # consumed here (an error reply surfaces on the retried verb)
+            _send_frame(self._sock, self._session_frame)
+            _recv_frame(self._sock)
+
+    def call(self, term: Any, *, idempotent: "bool | None" = None,
+             timeout: "float | None" = None) -> Any:
+        """One request/response exchange. ``idempotent=None`` (default)
+        classifies by verb name against :data:`IDEMPOTENT_VERBS`; pass
+        an explicit bool to override (e.g. a caller that KNOWS its
+        ``update`` is an idempotent CRDT op and accepts replay)."""
+        verb = str(term[0]) if isinstance(term, tuple) and term else "?"
+        if idempotent is None:
+            idempotent = verb in self.IDEMPOTENT_VERBS
+        attempts = 1 + (self._retries if idempotent else 0)
+        last_exc: "Exception | None" = None
+        for attempt in range(attempts):
+            try:
+                if attempt:
+                    self._reconnect()
+                self._sock.settimeout(
+                    self._timeout if timeout is None else timeout
+                )
+                _send_frame(self._sock, etf.encode(term))
+                frame = _recv_frame(self._sock)
+                if frame is None:
+                    raise ConnectionError(
+                        "bridge server closed the connection"
+                    )
+                return etf.decode(frame)
+            except (ConnectionError, OSError) as exc:
+                last_exc = exc
+                if not idempotent:
+                    raise ConnectionError(
+                        f"bridge call {verb!r} failed ({exc}); "
+                        "non-idempotent verbs are never retried — the "
+                        "op's outcome is unknown, check server state "
+                        "and re-issue explicitly"
+                    ) from exc
+                if attempt + 1 < attempts:
+                    import random
+                    import time
+
+                    delay = self._backoff * (2 ** attempt)
+                    time.sleep(delay * (1.0 + random.random()))
+        raise ConnectionError(
+            f"bridge call {verb!r} failed after {attempts} attempts "
+            f"({last_exc})"
+        ) from last_exc
 
     # convenience verbs mirroring lasp_tpu_backend.erl
     def start(self, name="store"):
         # bytes pass through as an ETF binary (BEAM nodes may name the
         # partition either way); strings ride as atoms
-        return self.call(
-            (Atom("start"), name if isinstance(name, bytes) else Atom(name))
+        term = (
+            Atom("start"), name if isinstance(name, bytes) else Atom(name)
         )
+        resp = self.call(term)
+        # remember the binding for reconnect replay (only a successful
+        # start: replaying a refused name would wedge every retry)
+        if isinstance(resp, tuple) and resp and resp[0] == Atom("ok"):
+            self._session_frame = etf.encode(term)
+        return resp
 
     def declare(self, var_id, type_name: str, **caps):
         return self.call(

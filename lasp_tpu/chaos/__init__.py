@@ -1,0 +1,68 @@
+"""Chaos mesh: deterministic fault injection, crash/recovery, and
+convergence-under-failure invariants.
+
+The robustness half of the replication story (N-replica preflists with
+R/W=2 quorums + read-repair, ``src/lasp_update_fsm.erl:174-216``),
+rebuilt as three pieces:
+
+- :mod:`.schedule` — declarative, seeded fault timelines
+  (:class:`ChaosSchedule`, the event vocabulary, the
+  :func:`nemesis` presets) that compile per round into the edge masks
+  the existing gossip kernels already accept;
+- :mod:`.engine` — :class:`ChaosRuntime`, wrapping a
+  ``ReplicatedRuntime`` with crash/restore row surgery, degraded
+  quorum reads + read-repair partial joins, and the measured
+  :meth:`~ChaosRuntime.soak` driver;
+- :mod:`.invariants` — the harness asserting monotone inflation,
+  post-heal bit-equality with a fault-free run, replay determinism,
+  and no tombstone resurrection.
+
+Surfaces: ``lasp_tpu chaos`` (CLI soak verb), ``Session.nemesis``,
+the ``chaos_heal`` bench scenario, and ``tools/chaos_smoke.py`` in
+``make verify``. See docs/RESILIENCE.md.
+"""
+
+from .engine import ChaosRuntime, ReplicaDownError
+from .invariants import (
+    InvariantViolation,
+    check_inflation,
+    check_no_resurrection,
+    fingerprint,
+    run_harness,
+    snapshot_states,
+    states_equal,
+)
+from .schedule import (
+    PRESETS,
+    ChaosSchedule,
+    Crash,
+    DelayLinks,
+    DuplicateLinks,
+    FlakyLinks,
+    Partition,
+    Restore,
+    SlowShard,
+    nemesis,
+)
+
+__all__ = [
+    "PRESETS",
+    "ChaosRuntime",
+    "ChaosSchedule",
+    "Crash",
+    "DelayLinks",
+    "DuplicateLinks",
+    "FlakyLinks",
+    "InvariantViolation",
+    "Partition",
+    "ReplicaDownError",
+    "Restore",
+    "SlowShard",
+    "check_inflation",
+    "check_no_resurrection",
+    "fingerprint",
+    "nemesis",
+    "run_harness",
+    "snapshot_states",
+    "states_equal",
+]

@@ -1,0 +1,529 @@
+"""ChaosRuntime: drive a replicated population through a fault timeline.
+
+Wraps a :class:`~lasp_tpu.mesh.runtime.ReplicatedRuntime` with a
+:class:`~lasp_tpu.chaos.schedule.ChaosSchedule`: each chaos round
+processes the round's crash/restore actions, compiles the round's fault
+state into the edge mask the existing gossip kernels accept, and
+dispatches the runtime's OWN step (dense or frontier — no chaos-specific
+collective path). On top ride the replication-facing verbs the reference
+gets from its quorum FSMs:
+
+- **crash** (fail-stop): every link touching the replica dies, its row
+  freezes (a crashed row with dataflow edges/triggers is snapshotted
+  around the step so local sweeps cannot move it), client writes to it
+  are refused, and its actor lanes are retired (the riak_dt
+  never-reuse-an-actor incarnation rule, as in ``resize`` crash);
+- **restore**: the row re-seeds from the lattice bottom or an attached
+  runtime checkpoint's saved row (``store.checkpoint.load_runtime_rows``)
+  and every frontier degrades to all-dirty — gossip then performs the
+  hinted-handoff-style catch-up;
+- **degraded reads**: :meth:`degraded_read` answers from K live
+  replicas of a variable (Lasp's R=2 first-replies quorum,
+  ``src/lasp_read_fsm.erl:125-146``) and triggers READ-REPAIR as a
+  masked partial join — the quorum's join is merged back into exactly
+  the rows read (``src/lasp_update_fsm.erl:189-216``), with the wire
+  cost accounted per repaired row (``chaos_repair_bytes_total``).
+
+Healing is measured, not assumed: :meth:`soak` runs the timeline to its
+horizon and then to quiescence, reporting rounds-to-heal — and the
+invariant harness (``chaos.invariants``) asserts the healed fixed point
+is bit-identical to a fault-free run's.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..mesh.gossip import quorum_read, rows_traffic_bytes
+from ..telemetry import counter, events as tel_events, gauge, span
+from ..telemetry.convergence import get_monitor
+
+
+class ReplicaDownError(RuntimeError):
+    """A client verb targeted a crashed replica. The reference's FSMs
+    route around a down vnode via the preflist; the simulation surfaces
+    the routing decision to the caller instead (use
+    :meth:`ChaosRuntime.degraded_read` / a live replica row)."""
+
+
+class ChaosRuntime:
+    """One population + one fault timeline; see the module doc.
+
+    Donation is turned OFF on the wrapped runtime: chaos soaks are
+    exactly the checkpoint-then-retry shape the donation trade-off note
+    on ``ReplicatedRuntime.donate_steps`` warns about (crash freezing
+    snapshots rows across a dispatch, and a failed dispatch mid-soak
+    must not poison the run)."""
+
+    def __init__(self, runtime, schedule, checkpoint: "str | None" = None):
+        if runtime.n_replicas != schedule.n_replicas:
+            raise ValueError(
+                f"schedule is for {schedule.n_replicas} replicas, runtime "
+                f"has {runtime.n_replicas}"
+            )
+        if not np.array_equal(
+            np.asarray(schedule.neighbors), runtime._host_neighbors
+        ):
+            raise ValueError(
+                "schedule was compiled for a different neighbor table — "
+                "build it from this runtime's topology"
+            )
+        if runtime._partition is not None:
+            raise ValueError(
+                "partitioned boundary-exchange gossip bakes a dense row "
+                "plan and cannot take per-round edge masks — shard with "
+                "partition=False for chaos runs"
+            )
+        self.rt = runtime
+        self.schedule = schedule
+        #: runtime checkpoint path backing Restore(source="checkpoint")
+        self.checkpoint = checkpoint
+        if runtime.donate_steps:
+            runtime.donate_steps = False
+            runtime._step = None
+            runtime._fused_steps_cache.clear()
+        self.round = 0
+        self.crashed = np.zeros(runtime.n_replicas, dtype=bool)
+        #: rows restored at the LAST step — the invariant harness's
+        #: monotonicity exemption (a reseed is deliberately non-monotone)
+        self.last_restored: list = []
+        self.degraded_reads = 0
+        self.repair_bytes = 0
+        self.repaired_rows = 0
+        self.duplicates_suppressed = 0
+        self.crashes = 0
+        self.restores = 0
+        self._fused_cache: dict = {}
+
+    # -- fault actions --------------------------------------------------------
+    def _crash(self, replica: int) -> None:
+        if self.crashed[replica]:
+            raise RuntimeError(f"replica {replica} is already down")
+        self.crashed[replica] = True
+        self.crashes += 1
+        # the riak_dt incarnation rule (the resize-crash discipline): the
+        # dead row's minted tokens may still circulate via gossip, so its
+        # actor lanes retire — a post-restore write under an old actor at
+        # ANY row collides loudly instead of silently reusing slots
+        for key, site in list(self.rt._actor_sites.items()):
+            if site == int(replica):
+                self.rt._actor_sites[key] = -1
+        counter(
+            "chaos_faults_injected_total",
+            help="chaos fault events activated, by kind",
+            kind="crash",
+        ).inc()
+        tel_events.emit(
+            "chaos", replica=int(replica), action="crash",
+            round=self.round,
+        )
+
+    def _restore(self, replica: int, source: str) -> None:
+        if not self.crashed[replica]:
+            raise RuntimeError(f"replica {replica} is not down")
+        rows = None
+        if source == "checkpoint":
+            if self.checkpoint is None:
+                raise RuntimeError(
+                    "Restore(source='checkpoint') needs a checkpoint "
+                    "path — pass ChaosRuntime(..., checkpoint=path)"
+                )
+            from ..store.checkpoint import load_runtime_rows
+
+            rows = load_runtime_rows(self.checkpoint, replica)
+        self.rt.reseed_row(replica, rows)
+        self.crashed[replica] = False
+        self.restores += 1
+        self.last_restored.append(int(replica))
+        counter(
+            "chaos_faults_injected_total",
+            help="chaos fault events activated, by kind",
+            kind="restore",
+        ).inc()
+        tel_events.emit(
+            "chaos", replica=int(replica), action="restore",
+            round=self.round, source=source,
+        )
+
+    def _apply_actions(self, rnd: int) -> None:
+        from .schedule import Crash
+
+        self.last_restored = []
+        for ev in self.schedule.actions_at(rnd):
+            if isinstance(ev, Crash):
+                self._crash(ev.replica)
+            else:
+                self._restore(ev.replica, ev.source)
+
+    def _needs_freeze(self) -> bool:
+        """Gossip alone cannot move a crashed row (its every edge is
+        masked); only local dataflow sweeps / triggers can — freeze is
+        needed exactly then."""
+        return bool(self.crashed.any()) and bool(
+            self.rt.graph.edges or self.rt._triggers
+        )
+
+    def _account_duplicates(self, rnd: int, alive=None) -> None:
+        """At-least-once accounting for one executed round: duplicated
+        deliveries are no-ops under the idempotent join, so they only
+        COUNT (the measured tolerance claim, docs/RESILIENCE.md)."""
+        dup = self.schedule.duplicate_links_at(rnd, alive=alive)
+        if dup:
+            self.duplicates_suppressed += dup
+            counter(
+                "chaos_duplicate_deliveries_total",
+                help="duplicated gossip deliveries absorbed by join "
+                     "idempotence (DuplicateLinks accounting)",
+            ).inc(dup)
+
+    def _emit_round_gauges(self, mask) -> None:
+        gauge(
+            "chaos_replicas_crashed",
+            help="replicas currently failed-stop under chaos",
+        ).set(int(self.crashed.sum()))
+        gauge(
+            "chaos_links_dead",
+            help="directed gossip edges dead under the current chaos "
+                 "mask",
+        ).set(0 if mask is None else int((~np.asarray(mask)).sum()))
+
+    # -- stepping -------------------------------------------------------------
+    def step(self, mode: str = "dense") -> int:
+        """ONE chaos round: apply this round's crash/restore actions,
+        compile the round's mask, dispatch the runtime's own step
+        (``mode`` = ``"dense"`` | ``"frontier"``), and freeze crashed
+        rows across it. Returns the step's residual (the engine
+        contract). Deterministic in ``(seed, schedule, state)``."""
+        rnd = self.round
+        self._apply_actions(rnd)
+        mask = self.schedule.mask_at(rnd)
+        self._account_duplicates(rnd, alive=mask)
+        import jax
+
+        frozen = None
+        if self._needs_freeze():
+            crash_rows = np.flatnonzero(self.crashed)
+            frozen = {
+                v: jax.tree_util.tree_map(
+                    lambda x: x[crash_rows], self.rt.states[v]
+                )
+                for v in self.rt.var_ids
+            }
+        jmask = None if mask is None else self._device_mask(mask)
+        if mode == "frontier":
+            residual = self.rt.frontier_step(jmask)
+        elif mode == "dense":
+            residual = self.rt.step(jmask)
+        else:
+            raise ValueError(f"unknown mode {mode!r} (dense | frontier)")
+        if frozen is not None:
+            # a down replica executes nothing: local sweeps that moved
+            # its row are rolled back (gossip cannot have — every edge
+            # touching it is masked)
+            idx = np.flatnonzero(self.crashed)
+            for v in self.rt.var_ids:
+                self.rt.states[v] = jax.tree_util.tree_map(
+                    lambda x, fr: x.at[idx].set(fr),
+                    self.rt.states[v], frozen[v],
+                )
+        self._emit_round_gauges(mask)
+        self.round += 1
+        return residual
+
+    def _device_mask(self, mask):
+        """One device transfer per DISTINCT host mask, keyed by OBJECT
+        IDENTITY (the schedule returns the same array across a stable
+        fault window — the identity the frontier mask-tagging keys on).
+        The cache entry holds the host array itself: ``id()`` alone is
+        unsound, because a freed mask's address (and so its id) is
+        reused by the next allocation, and a stale hit would dispatch
+        the WRONG mask — the entry's stored reference both pins the id
+        and lets the hit verify ``is`` before trusting it."""
+        key = ("mask", id(mask))
+        cached = self._fused_cache.get(key)
+        if cached is not None and cached[0] is mask:
+            return cached[1]
+        import jax.numpy as jnp
+
+        # bound the cache: masks churn per round under flaky links
+        for k in [k for k in self._fused_cache if k[0] == "mask"][:-8]:
+            del self._fused_cache[k]
+        dev = jnp.asarray(mask)
+        self._fused_cache[key] = (mask, dev)
+        return dev
+
+    def fused_steps(self, n_rounds: int) -> list:
+        """Run ``n_rounds`` chaos rounds in ONE device dispatch: the
+        window's per-round masks stack into a traced ``bool[T, R, K]``
+        operand and the runtime's full step (sweep + gossip + residual)
+        runs under ``lax.fori_loop`` — the chaos twin of
+        ``ReplicatedRuntime.fused_steps``, amortizing dispatch the same
+        way. The window must contain no crash/restore action (those
+        need host-side row surgery; :meth:`soak` splits windows at
+        action rounds) and no live crash freeze with dataflow edges.
+        Returns the per-round residual totals (host-synced once)."""
+        import jax
+        import jax.numpy as jnp
+
+        if n_rounds < 1:
+            raise ValueError("n_rounds must be >= 1")
+        nxt = self.schedule.next_action_round(self.round - 1)
+        if nxt is not None and nxt < self.round + n_rounds:
+            raise RuntimeError(
+                f"fused chaos window [{self.round}, "
+                f"{self.round + n_rounds}) crosses a crash/restore "
+                f"action at round {nxt} — split the window there"
+            )
+        if self._needs_freeze():
+            raise RuntimeError(
+                "fused chaos windows cannot freeze crashed rows around "
+                "dataflow sweeps — step per round while replicas are "
+                "down on a graph-carrying runtime"
+            )
+        rt = self.rt
+        tables = rt._ensure_step()
+        # per-round masks invalidate row knowledge wholesale (the
+        # conservative opaque-block rule); sync against a sentinel so
+        # the degrade happens ONCE here, not per cached mask identity
+        rt._frontier_sync_mask(self)
+        masks = self.schedule.masks(self.round, self.round + n_rounds)
+        key = ("fused", n_rounds)
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            step = rt._step_pure
+
+            def fused(states, neighbors, masks_, tables_):
+                def body(i, carry):
+                    s, res = carry
+                    out, res_vec = step(s, neighbors, masks_[i], tables_)
+                    return out, res.at[i].set(jnp.sum(res_vec))
+
+                return jax.lax.fori_loop(
+                    0, n_rounds, body,
+                    (states, jnp.zeros((n_rounds,), jnp.int32)),
+                )
+
+            fn = jax.jit(fused)
+            self._fused_cache[key] = fn
+        from ..utils.metrics import Timer
+
+        with span("chaos.fused_window", rounds=n_rounds):
+            with Timer() as t:
+                rt.states, res = rt._run_step_fn(
+                    fn, jnp.asarray(masks), tables
+                )
+        res = np.asarray(res)
+        # masks varied inside the block: even a zero tail only proves a
+        # MASKED fixed point — degrade (the opaque-block rule)
+        rt._frontier_after_opaque(False)
+        rt.trace.record_round(int(res[-1]), t.elapsed)
+        rt._record_rounds(n_rounds)
+        rt._observe_opaque_block(n_rounds, None, t.elapsed)
+        # per-round duplicate accounting from the masks ALREADY compiled
+        # for the dispatch (no second mask_at pass); gauges emit once for
+        # the window's final round — intermediate per-round values could
+        # never be observed before control returns anyway
+        for t in range(n_rounds):
+            self._account_duplicates(self.round, alive=masks[t])
+            self.round += 1
+        self._emit_round_gauges(masks[-1])
+        return res.tolist()
+
+    # -- degraded reads + read-repair -----------------------------------------
+    def live_replicas(self) -> np.ndarray:
+        return np.flatnonzero(~self.crashed)
+
+    def _reachable_live(self, coordinator: int) -> np.ndarray:
+        """``bool[R]``: live replicas the coordinator can actually REACH
+        over links alive under the CURRENT round's mask (chaos masks are
+        pair-symmetric, so this is undirected connectivity over the
+        neighbor table's live pairs). A quorum must come from here — a
+        host-side read spanning a partition would be a side channel that
+        'heals' through the very cut the nemesis installed."""
+        live = ~self.crashed
+        mask = self.schedule.mask_at(self.round)
+        nbrs = self.rt._host_neighbors
+        if mask is None:
+            return live
+        alive_edge = np.asarray(mask, bool) & live[nbrs] & live[:, None]
+        comp = np.zeros(self.rt.n_replicas, dtype=bool)
+        comp[coordinator] = True
+        for _ in range(self.rt.n_replicas):
+            # expand over live pairs in BOTH roles: rows pulling a
+            # component member, and rows a component member pulls
+            fwd = (alive_edge & comp[nbrs]).any(axis=1)
+            rev = np.zeros_like(comp)
+            rev[nbrs[alive_edge & comp[:, None]]] = True
+            new = comp | fwd | rev
+            if (new == comp).all():
+                break
+            comp = new
+        return comp & live
+
+    def degraded_read(self, var_id: str, k: int = 2, repair: bool = True,
+                      coordinator: "int | None" = None):
+        """Quorum read from K LIVE, REACHABLE replicas — the reference's
+        R=2 first-replies read (``src/lasp_read_fsm.erl:125-146``) under
+        failures: crashed rows are excluded, and the quorum is drawn
+        from the replicas the ``coordinator`` (default: the first live
+        replica) can reach over links alive under the current round's
+        mask — a partitioned coordinator answers from ITS side of the
+        cut only, never through a host-side channel the mesh does not
+        have. The first ``k`` such rows (deterministic preflist order)
+        answer; their join is the returned value (a monotone lower
+        bound of the coverage value).
+
+        With ``repair=True`` (default) the read triggers READ-REPAIR as
+        a masked partial join: the quorum's join merges back into
+        exactly the rows read (``src/lasp_update_fsm.erl:189-216``
+        finalize), those rows mark frontier-dirty, and the wire cost is
+        accounted per row actually changed. Returns the decoded value."""
+        import jax
+
+        live = self.live_replicas()
+        if live.size == 0:
+            raise ReplicaDownError(
+                f"degraded_read({var_id!r}): every replica is down"
+            )
+        if coordinator is None:
+            coordinator = int(live[0])
+        elif self.crashed[coordinator]:
+            raise ReplicaDownError(
+                f"degraded_read({var_id!r}): coordinator {coordinator} "
+                "is down"
+            )
+        reachable = np.flatnonzero(self._reachable_live(int(coordinator)))
+        k = min(int(k), int(reachable.size))
+        # coordinator-first preflist order (its own row always replies)
+        picks = np.concatenate(
+            [[int(coordinator)], reachable[reachable != int(coordinator)]]
+        ).astype(np.int64)[:k]
+        value = self.rt.quorum_value(var_id, picks)
+        self.degraded_reads += 1
+        counter(
+            "chaos_degraded_reads_total",
+            help="quorum reads answered from live replicas while the "
+                 "population was degraded",
+        ).inc()
+        repaired = 0
+        if repair:
+            pop = self.rt._population(var_id)
+            codec, spec = self.rt._mesh_meta(var_id)
+            top = quorum_read(codec, spec, pop, picks)
+            rows_st = jax.tree_util.tree_map(lambda x: x[picks], pop)
+            merged = jax.vmap(
+                lambda r: codec.merge(spec, r, top)
+            )(rows_st)
+            changed = np.asarray(
+                jax.vmap(lambda a, b: ~codec.equal(spec, a, b))(
+                    rows_st, merged
+                )
+            )
+            repaired = int(changed.sum())
+            if repaired:
+                idx = picks
+                self.rt.states[var_id] = jax.tree_util.tree_map(
+                    lambda x, m: x.at[idx].set(m), pop, merged
+                )
+                self.rt.mark_dirty(var_id, picks)
+                bytes_ = rows_traffic_bytes(pop, repaired)
+                self.repair_bytes += bytes_
+                self.repaired_rows += repaired
+                counter(
+                    "chaos_repair_bytes_total",
+                    help="estimated bytes moved by degraded-read "
+                         "read-repair partial joins",
+                ).inc(bytes_)
+        tel_events.emit(
+            "chaos", var=var_id, action="degraded_read",
+            quorum=[int(p) for p in picks], repaired_rows=repaired,
+        )
+        return value
+
+    def write_at(self, replica: int, var_id: str, op: tuple, actor) -> None:
+        """``update_at`` with availability semantics: a write routed to a
+        crashed replica is REFUSED (the preflist would have routed
+        around it; the simulation surfaces the decision)."""
+        if self.crashed[replica]:
+            raise ReplicaDownError(
+                f"replica {replica} is down; route the write to a live "
+                f"replica ({self.live_replicas()[:4].tolist()}...)"
+            )
+        self.rt.update_at(replica, var_id, op, actor)
+
+    # -- the soak driver ------------------------------------------------------
+    def soak(self, max_rounds: int = 4096, mode: str = "dense",
+             block: int = 1,
+             reads_per_round: int = 0, read_var: "str | None" = None,
+             read_quorum: int = 2) -> dict:
+        """Run the WHOLE timeline and measure recovery: rounds execute
+        (optionally issuing ``reads_per_round`` degraded reads against
+        ``read_var`` while faults are active) until every fault has
+        cleared AND the population quiesces. ``block > 1`` runs
+        action-free windows through :meth:`fused_steps` (one dispatch
+        per window) on runtimes without graphs/triggers.
+
+        Returns the soak report: ``rounds``, ``rounds_to_heal`` (rounds
+        past the schedule horizon to quiescence — the recovery metric),
+        ``degraded_reads`` / ``repair_bytes`` / ``repaired_rows``,
+        ``duplicates_suppressed``, ``crashes`` / ``restores``, and
+        ``healed`` (no replica left down). The report also lands in the
+        ConvergenceMonitor's ``chaos`` health section and the
+        ``chaos_rounds_to_heal`` gauge."""
+        horizon = self.schedule.horizon
+        residual = -1
+        with span("chaos.soak", mode=mode, horizon=horizon):
+            while self.round < max_rounds:
+                rnd = self.round
+                in_window = rnd < horizon
+                can_fuse = (
+                    block > 1
+                    and mode == "dense"
+                    and not (self.rt.graph.edges or self.rt._triggers)
+                    and not (reads_per_round and in_window)
+                )
+                nxt = self.schedule.next_action_round(rnd - 1)
+                if can_fuse and (nxt is None or nxt > rnd):
+                    width = block if nxt is None else min(block, nxt - rnd)
+                    # actions take effect at round start: a window may
+                    # not even BEGIN on an action round
+                    if not self.schedule.actions_at(rnd):
+                        res = self.fused_steps(width)
+                        residual = res[-1]
+                        if residual == 0 and self.round > horizon:
+                            break
+                        continue
+                residual = self.step(mode=mode)
+                if reads_per_round and in_window and read_var is not None:
+                    for _ in range(reads_per_round):
+                        self.degraded_read(read_var, k=read_quorum)
+                if residual == 0 and self.round > horizon:
+                    break
+            else:
+                raise RuntimeError(
+                    f"chaos soak did not quiesce within {max_rounds} "
+                    "rounds"
+                )
+        healed = not bool(self.crashed.any())
+        rounds_to_heal = max(0, self.round - horizon)
+        gauge(
+            "chaos_rounds_to_heal",
+            help="rounds from the last fault clearing to quiescence in "
+                 "the latest chaos soak",
+        ).set(rounds_to_heal)
+        report = {
+            "rounds": self.round,
+            "horizon": horizon,
+            "rounds_to_heal": rounds_to_heal,
+            "healed": healed,
+            "residual": int(residual),
+            "crashes": self.crashes,
+            "restores": self.restores,
+            "degraded_reads": self.degraded_reads,
+            "repaired_rows": self.repaired_rows,
+            "repair_bytes": self.repair_bytes,
+            "duplicates_suppressed": self.duplicates_suppressed,
+        }
+        get_monitor().observe_chaos(**report)
+        tel_events.emit("chaos", action="soak_done", **report)
+        return report

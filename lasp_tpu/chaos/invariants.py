@@ -1,0 +1,213 @@
+"""Convergence-under-failure invariants — the harness the acceptance
+criteria run.
+
+Three properties must hold under EVERY schedule (they are the CRDT
+correctness story restated as machine-checked invariants):
+
+1. **per-replica monotone inflation** — every live replica row only
+   moves UP the lattice, round over round (``merge(prev, new) == new``).
+   The single deliberate exception is a crash-restore reseed (the row
+   restarts at bottom / a checkpoint row), which the engine reports via
+   ``ChaosRuntime.last_restored`` and the check exempts for that round;
+2. **post-heal convergence to the fault-free fixed point** — after the
+   schedule's horizon, the population quiesces to a state BIT-IDENTICAL
+   to a twin run that never saw a fault: deterministic dataflow survives
+   chaos (faults may delay convergence, never change its destination);
+3. **replay determinism** — the same ``(seed, schedule)`` replays to
+   identical per-round state fingerprints: chaos is an experiment you
+   can re-run, bisect, and regress.
+
+Property 2 subsumes the no-resurrection rule for observed-remove types
+(a removed OR-Set/OR-SWOT dot resurrected across crash/restore would
+make the healed state differ from the fault-free one), and
+:func:`check_no_resurrection` additionally asserts it directly against a
+caller-supplied removed-terms set, so a workload can pin the claim by
+name instead of by bit-equality."""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from .engine import ChaosRuntime
+
+
+class InvariantViolation(AssertionError):
+    """A chaos invariant failed; the message names the property, the
+    variable, and the offending rows/round."""
+
+
+def snapshot_states(rt) -> dict:
+    """Host copies of every variable's population state."""
+    import jax
+
+    return {
+        v: jax.tree_util.tree_map(np.asarray, rt.states[v])
+        for v in rt.var_ids
+    }
+
+
+def states_equal(a: dict, b: dict) -> bool:
+    import jax
+
+    if set(a) != set(b):
+        return False
+    for v in a:
+        same = jax.tree_util.tree_map(
+            lambda x, y: bool(np.array_equal(x, y)), a[v], b[v]
+        )
+        if not all(jax.tree_util.tree_leaves(same)):
+            return False
+    return True
+
+
+def fingerprint(states: dict) -> str:
+    """Order-stable content hash of a population snapshot — the replay
+    determinism unit (two runs match iff every leaf matches bit-wise)."""
+    import jax
+
+    h = hashlib.sha256()
+    for v in sorted(states, key=str):
+        h.update(repr(v).encode())
+        for leaf in jax.tree_util.tree_leaves(states[v]):
+            arr = np.asarray(leaf)
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def check_inflation(rt, prev: dict, exempt_rows=()) -> None:
+    """Assert every replica row inflated (``new >= prev`` in lattice
+    order: ``merge(prev, new) == new``) since the ``prev`` snapshot,
+    for every variable — rows in ``exempt_rows`` (a restore's reseed)
+    excepted. Raises :class:`InvariantViolation`."""
+    import jax
+
+    exempt = np.zeros(rt.n_replicas, dtype=bool)
+    if len(exempt_rows):
+        exempt[np.asarray(list(exempt_rows), dtype=np.int64)] = True
+    for v in rt.var_ids:
+        codec, spec = rt._mesh_meta(v)
+        new = rt.states[v]
+        ok = np.asarray(
+            jax.vmap(
+                lambda p, n: codec.equal(spec, codec.merge(spec, p, n), n)
+            )(prev[v], new)
+        )
+        bad = np.flatnonzero(~ok & ~exempt)
+        if bad.size:
+            raise InvariantViolation(
+                f"monotone-inflation violated for {v!r} at replica rows "
+                f"{bad[:8].tolist()}: a round moved state DOWN the "
+                "lattice outside a crash-restore reseed"
+            )
+
+
+def check_no_resurrection(rt, var_id: str, removed_terms) -> None:
+    """Assert no removed element came back: the population's coverage
+    value must be disjoint from ``removed_terms`` — the observed-remove
+    guarantee across crash/restore (a reseeded row must not resurrect a
+    tombstoned dot it once carried)."""
+    value = rt.coverage_value(var_id)
+    back = set(removed_terms) & set(value)
+    if back:
+        raise InvariantViolation(
+            f"resurrection in {var_id!r}: removed element(s) "
+            f"{sorted(map(repr, back))[:4]} reappeared after "
+            "crash/restore"
+        )
+
+
+def run_harness(build, schedule, mode: str = "dense",
+                max_rounds: int = 512, replay: bool = True,
+                removed_terms: "dict | None" = None,
+                checkpoint: "str | None" = None) -> dict:
+    """Execute the full invariant suite for one workload × schedule ×
+    scheduler mode.
+
+    ``build()`` constructs a fresh, identically-seeded
+    ``ReplicatedRuntime`` (same store declarations, same client writes,
+    same topology — the schedule must have been compiled against that
+    topology). The harness then runs:
+
+    - a FAULT-FREE twin to its fixed point (the destination states);
+    - the CHAOS run, checking monotone inflation every round and the
+      healed fixed point's bit-equality with the twin;
+    - with ``replay=True``, a second chaos run, checking per-round
+      fingerprint equality (determinism);
+    - with ``removed_terms`` (``{var_id: terms}``), the direct
+      no-resurrection assertion per variable.
+
+    Returns a report dict (rounds, rounds_to_heal, fingerprints, soak
+    counters); raises :class:`InvariantViolation` on any failure."""
+    rt_free = build()
+    free_rounds = rt_free.run_to_convergence(
+        max_rounds=max_rounds, mode=mode if mode == "frontier" else "dense"
+    )
+    free_states = snapshot_states(rt_free)
+    del rt_free
+
+    def chaos_run():
+        rt = build()
+        ch = ChaosRuntime(rt, schedule, checkpoint=checkpoint)
+        prev = snapshot_states(rt)
+        fps = []
+        while ch.round < max_rounds:
+            residual = ch.step(mode=mode)
+            check_inflation(rt, prev, exempt_rows=ch.last_restored)
+            prev = snapshot_states(rt)
+            fps.append(fingerprint(prev))
+            if residual == 0 and ch.round > schedule.horizon:
+                break
+        else:
+            raise InvariantViolation(
+                f"chaos run did not quiesce within {max_rounds} rounds "
+                f"(mode={mode!r})"
+            )
+        return rt, ch, fps
+
+    rt1, ch1, fps1 = chaos_run()
+    if not states_equal(snapshot_states(rt1), free_states):
+        raise InvariantViolation(
+            "post-heal fixed point differs from the fault-free run's "
+            f"(mode={mode!r}): chaos changed the destination, not just "
+            "the journey"
+        )
+    if removed_terms:
+        for v, terms in removed_terms.items():
+            check_no_resurrection(rt1, v, terms)
+    from ..telemetry.convergence import get_monitor
+
+    report = {
+        "mode": mode,
+        "fault_free_rounds": free_rounds,
+        "chaos_rounds": ch1.round,
+        "rounds_to_heal": max(0, ch1.round - schedule.horizon),
+        "healed": not bool(ch1.crashed.any()),
+        "crashes": ch1.crashes,
+        "restores": ch1.restores,
+        "final_fingerprint": fps1[-1],
+        "bit_identical_to_fault_free": True,
+    }
+    if replay:
+        _rt2, _ch2, fps2 = chaos_run()
+        if fps1 != fps2:
+            first = next(
+                (i for i, (a, b) in enumerate(zip(fps1, fps2)) if a != b),
+                min(len(fps1), len(fps2)),
+            )
+            raise InvariantViolation(
+                f"replay diverged at round {first} (mode={mode!r}): the "
+                "same (seed, schedule) must replay to identical "
+                "per-round states"
+            )
+        report["replay_identical"] = True
+    # the observatory's resilience section: invariant runs feed the same
+    # health surface soaks do (the {health} verb's "chaos" key)
+    get_monitor().observe_chaos(
+        rounds_to_heal=report["rounds_to_heal"], healed=report["healed"],
+        crashes=report["crashes"], restores=report["restores"],
+        invariants_ok=True,
+    )
+    return report

@@ -85,6 +85,54 @@ def cmd_simulate(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Chaos soak (the nemesis verb): run a seeded gossip population
+    through a preset fault timeline, measure recovery, and verify the
+    convergence-under-failure invariants — healed fixed point
+    bit-identical to a fault-free twin's, monotone inflation, replay
+    determinism (docs/RESILIENCE.md)."""
+    from lasp_tpu.chaos import nemesis, run_harness
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import random_regular, ring, scale_free
+    from lasp_tpu.mesh.runtime import ReplicatedRuntime
+    from lasp_tpu.store import Store
+    from lasp_tpu.telemetry import get_monitor
+
+    topo = {"ring": ring, "random": random_regular,
+            "scale_free": scale_free}[args.topology]
+    nbrs = topo(args.replicas, args.fanout)
+
+    def build():
+        store = Store(n_actors=max(16, args.writers))
+        var = store.declare(type=args.type, n_elems=args.elems, id="soak")
+        rt = ReplicatedRuntime(store, Graph(store), args.replicas, nbrs)
+        rt.update_batch(
+            var,
+            [
+                ((w * args.replicas) // args.writers,
+                 ("add", f"item{w}"), f"writer{w}")
+                for w in range(args.writers)
+            ],
+        )
+        return rt
+
+    schedule = nemesis(
+        args.preset, args.replicas, nbrs, seed=args.seed,
+        rounds=args.rounds,
+    )
+    report = run_harness(
+        build, schedule, mode=args.mode, max_rounds=args.max_rounds,
+        replay=not args.no_replay,
+    )
+    report["preset"] = args.preset
+    report["topology"] = args.topology
+    report["replicas"] = args.replicas
+    report["schedule"] = schedule.describe()
+    report["chaos_health"] = get_monitor().health().get("chaos")
+    print(json.dumps(report))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import os
     import runpy
@@ -460,6 +508,36 @@ def main(argv=None) -> int:
     bench = sub.add_parser("bench", help="run the headline benchmark")
     bench.add_argument("--replicas", type=int, default=0)
 
+    ch = sub.add_parser(
+        "chaos",
+        help="fault-injection soak: run a population through a nemesis "
+             "preset and verify the convergence-under-failure "
+             "invariants (docs/RESILIENCE.md)",
+    )
+    # literal list (not chaos.PRESETS): importing the chaos package here
+    # would pull jax into every CLI start; tests/chaos/test_engine.py
+    # pins this against the registry
+    ch.add_argument("--preset", required=True,
+                    choices=["ring-cut", "rolling-crash", "flaky-links",
+                             "slow-shard", "delay-links"])
+    ch.add_argument("--replicas", type=int, default=64)
+    ch.add_argument("--topology", choices=["ring", "random", "scale_free"],
+                    default="ring")
+    ch.add_argument("--fanout", type=int, default=cfg.fanout)
+    ch.add_argument("--type", default="lasp_gset",
+                    choices=["lasp_gset", "lasp_orset", "riak_dt_orswot"])
+    ch.add_argument("--elems", type=int, default=64)
+    ch.add_argument("--writers", type=int, default=8)
+    ch.add_argument("--seed", type=int, default=0)
+    ch.add_argument("--rounds", type=int, default=12,
+                    help="fault-window length in gossip rounds")
+    ch.add_argument("--max-rounds", type=int, default=4096,
+                    help="soak budget (rounds) before giving up")
+    ch.add_argument("--mode", choices=["dense", "frontier"],
+                    default="dense")
+    ch.add_argument("--no-replay", action="store_true",
+                    help="skip the replay-determinism second run")
+
     scen = sub.add_parser("scenario", help="run a BASELINE eval config")
     # literal list (not the SCENARIOS registry): importing bench_scenarios
     # here would pull jax into every CLI invocation including --help;
@@ -468,7 +546,7 @@ def main(argv=None) -> int:
     scen.add_argument(
         "name",
         choices=["adcounter_10m", "adcounter_6", "bridge_throughput",
-                 "frontier_sparse", "gset_1k", "orset_100k",
+                 "chaos_heal", "frontier_sparse", "gset_1k", "orset_100k",
                  "packed_vs_dense", "partitioned_gossip", "pipeline_1m"],
     )
     scen.add_argument("--replicas", type=int, default=0,
@@ -543,6 +621,7 @@ def main(argv=None) -> int:
         "status": cmd_status,
         "simulate": cmd_simulate,
         "bench": cmd_bench,
+        "chaos": cmd_chaos,
         "scenario": cmd_scenario,
         "metrics": cmd_metrics,
         "top": cmd_top,

@@ -14,6 +14,7 @@ from .gossip import (
 )
 from .runtime import ActorCollisionError, ReplicatedRuntime
 from .topology import (
+    assert_symmetric_mask,
     edge_failure_mask,
     locality_order,
     partition_mask,
@@ -21,10 +22,12 @@ from .topology import (
     ring,
     scale_free,
     shard_cut_stats,
+    symmetrize_edge_mask,
 )
 
 __all__ = [
     "ActorCollisionError",
+    "assert_symmetric_mask",
     "ReplicatedRuntime",
     "converged",
     "divergence",
@@ -40,4 +43,5 @@ __all__ = [
     "ring",
     "scale_free",
     "shard_cut_stats",
+    "symmetrize_edge_mask",
 ]

@@ -234,6 +234,32 @@ def divergence(codec, spec, states) -> jax.Array:
     return jnp.sum(diverged_rows(codec, spec, states))
 
 
+def rows_traffic_bytes(states, n_rows: int, fanout: int = 1) -> int:
+    """Host-side wire estimate for a PARTIAL exchange: the bytes moved by
+    gathering/writing ``n_rows`` replica rows of this population's state,
+    ``fanout`` times each. The per-row figure is the whole-population
+    leaf footprint divided by the replica extent (metadata only — never
+    pulls device buffers). Feeds the chaos engine's read-repair
+    accounting (``chaos_repair_bytes_total``): a degraded read's repair
+    is a masked partial join over the quorum's rows, so its wire cost
+    scales with rows repaired, not the population."""
+    leaves = jax.tree_util.tree_leaves(states)
+    if not leaves or n_rows <= 0:
+        return 0
+    n_replicas = int(getattr(leaves[0], "shape", np.shape(leaves[0]))[0])
+    if n_replicas == 0:
+        return 0
+    total = 0
+    for leaf in leaves:
+        dt = getattr(leaf, "dtype", None)
+        size = getattr(leaf, "size", None)
+        if dt is None or size is None:
+            arr = np.asarray(leaf)
+            dt, size = arr.dtype, arr.size
+        total += int(size) * int(dt.itemsize)
+    return (total // n_replicas) * int(n_rows) * int(fanout)
+
+
 def round_traffic_bytes(states, fanout: int) -> int:
     """Host-side estimate of the bytes ONE pull-gossip round moves: every
     replica gathers ``fanout`` neighbor rows of every variable, so the

@@ -2132,7 +2132,11 @@ class ReplicatedRuntime:
         array when shapes allow (the dense step paths run this per
         dispatch — at 10M replicas a fresh alloc per var would churn)."""
         f = self._frontier.get(var_id)
-        if f is not None and f.shape[0] == self.n_replicas:
+        if (
+            f is not None
+            and f.shape[0] == self.n_replicas
+            and f.flags.writeable
+        ):
             f.fill(value)
         else:
             self._frontier[var_id] = np.full(self.n_replicas, value, bool)
@@ -2331,7 +2335,10 @@ class ReplicatedRuntime:
             var_id, fn, edge_mask, jnp.zeros((1,), jnp.int32)
         )
         self.states[var_id] = new_states
-        return np.asarray(changed)
+        # np.array, not asarray: a zero-copy view of a device buffer is
+        # READ-ONLY, and this array becomes the frontier mask that
+        # _frontier_fill later mutates in place (mask-change degrade)
+        return np.array(changed)
 
     def _frontier_donate(self) -> tuple:
         """The frontier kernels donate their states operand EVERYWHERE
@@ -3105,6 +3112,63 @@ class ReplicatedRuntime:
                 self.states[var_id]
             )
         return self.states[var_id]
+
+    # -- crash recovery -------------------------------------------------------
+    def reseed_row(self, replica: int, rows: "dict | None" = None) -> None:
+        """Re-seed ONE replica row of every variable — the crash-restore
+        reconstruction the reference stubs as handoff + read-repair
+        (``src/lasp_vnode.erl:454-472``): the restored row restarts at
+        the lattice BOTTOM (default) or at supplied per-variable row
+        states (``rows[var_id]`` — e.g. the row a runtime checkpoint
+        saved, ``store.checkpoint.load_runtime_rows``), and the rest of
+        its state is reconstructed by gossip from its peers.
+
+        Supplied rows must be in the MESH wire format of this runtime
+        (packed populations restore packed rows); leaf shapes are
+        validated against the live population, so a checkpoint from a
+        different spec fails loudly instead of scattering garbage. Every
+        frontier degrades to all-dirty afterwards (the membership-change
+        rule): the reseeded row must be caught up even from QUIESCENT
+        peers, the hinted-handoff-style recovery the frontier scheduler
+        then performs."""
+        if not 0 <= replica < self.n_replicas:
+            raise IndexError(
+                f"replica {replica} out of range for {self.n_replicas}"
+            )
+        for v in self.var_ids:
+            codec, spec = self._mesh_meta(v)
+            if rows is not None and v in rows:
+                row = rows[v]
+                st = self.states[v]
+                if isinstance(row, (list, tuple)) and not hasattr(
+                    row, "_fields"
+                ):
+                    # leaf-list form (load_runtime_rows): unflatten
+                    # against the live population's treedef
+                    row = jax.tree_util.tree_unflatten(
+                        jax.tree_util.tree_structure(st), list(row)
+                    )
+                for live, rl in zip(
+                    jax.tree_util.tree_leaves(st),
+                    jax.tree_util.tree_leaves(row),
+                ):
+                    if tuple(live.shape[1:]) != tuple(np.shape(rl)):
+                        raise ValueError(
+                            f"reseed_row({v!r}): restored row leaf shape "
+                            f"{np.shape(rl)} does not match the live row "
+                            f"layout {tuple(live.shape[1:])} — restore "
+                            "from a checkpoint of this runtime's spec"
+                        )
+            else:
+                row = codec.new(spec)
+            self.states[v] = jax.tree_util.tree_map(
+                lambda x, r: x.at[replica].set(jnp.asarray(r)),
+                self.states[v], row,
+            )
+        # row-level change provenance is gone population-wide (peers must
+        # re-deliver to the reseeded row even if quiescent): all-dirty,
+        # the same conservative degrade resize and checkpoint restore use
+        self.mark_dirty()
 
     # -- elastic membership ---------------------------------------------------
     def resize(self, new_n: int, new_neighbors, graceful: bool = True) -> None:

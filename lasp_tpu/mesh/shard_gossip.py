@@ -392,6 +392,28 @@ def shard_frontier_counts(frontier, n_shards: int):
     return counts
 
 
+def shard_rows(n_replicas: int, n_shards: int, shard: int):
+    """``int64[...]``: the replica-row indices of one contiguous shard
+    block, under EXACTLY the blocking :func:`shard_frontier_counts` and
+    every ``rt.shard`` layout use (trailing rows of a non-divisible
+    population fold into the last block). This is the slow-shard
+    fault-injection unit: ``chaos.schedule.SlowShard`` throttles the
+    gossip links touching one block's rows, modeling a lagging device or
+    an oversubscribed host — the row set must agree with the sharding or
+    the nemesis would straddle two devices."""
+    import numpy as np
+
+    n_shards = int(n_shards)
+    if n_shards < 1:
+        raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+    if not 0 <= int(shard) < n_shards:
+        raise ValueError(f"shard {shard} out of range for {n_shards} shards")
+    block = max(int(n_replicas) // n_shards, 1)
+    lo = int(shard) * block
+    hi = (int(shard) + 1) * block if shard < n_shards - 1 else int(n_replicas)
+    return np.arange(min(lo, n_replicas), min(hi, n_replicas), dtype=np.int64)
+
+
 def frontier_cut_rows(frontier, plan: dict) -> int:
     """How many of the boundary-exchange plan's cut rows are currently
     frontier-dirty — the rows whose next exchange actually carries new

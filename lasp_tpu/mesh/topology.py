@@ -176,21 +176,100 @@ def shard_cut_stats(neighbors: np.ndarray, n_shards: int) -> dict:
 
 
 def edge_failure_mask(
-    n_replicas: int, k: int, drop_rate: float, seed: int = 0
+    n_replicas: int, k: int, drop_rate: float, seed: int = 0,
+    neighbors: "np.ndarray | None" = None, symmetric: bool = True,
 ) -> np.ndarray:
     """Failure injection (SURVEY.md §5): ``bool[R, K]`` with True = edge
     alive. Masked edges contribute the replica's own state (a no-op join),
     simulating message loss / partition; recovery = unmask (the rejoining
     replica's state joins back in, exactly the reference's read-repair
-    reconstruction story, ``src/lasp_vnode.erl:454-472`` stub + repair)."""
+    reconstruction story, ``src/lasp_vnode.erl:454-472`` stub + repair).
+
+    With ``symmetric=True`` (the default whenever ``neighbors`` is given)
+    the raw per-edge Bernoulli draw is normalized to BIDIRECTIONAL link
+    removal via :func:`symmetrize_edge_mask` — a dead link kills both
+    directions of the replica pair. One-way drops violate the
+    reverse-neighbor reachability assumption of frontier scheduling
+    (``gossip.frontier_reach``) and model a half-open TCP session no real
+    fabric sustains; symmetrization only ever kills MORE edges, so the
+    effective drop rate rises slightly above ``drop_rate``. Without a
+    ``neighbors`` table the pair structure is unknown and the raw
+    (possibly asymmetric) draw is returned unchanged."""
     rng = np.random.RandomState(seed)
-    return rng.random_sample(size=(n_replicas, k)) >= drop_rate
+    mask = rng.random_sample(size=(n_replicas, k)) >= drop_rate
+    if symmetric and neighbors is not None:
+        mask = symmetrize_edge_mask(neighbors, mask)
+    return mask
 
 
 def partition_mask(
     n_replicas: int, neighbors: np.ndarray, n_groups: int
 ) -> np.ndarray:
     """Network partition: only edges within the same contiguous group stay
-    alive. Heal by swapping the mask out."""
+    alive. Heal by swapping the mask out. Symmetric by construction
+    (group co-membership is a symmetric relation, so both directions of
+    any pair's link die together — the bidirectional-removal contract
+    :func:`assert_symmetric_mask` checks)."""
     group = (np.arange(n_replicas) * n_groups) // n_replicas
     return group[:, None] == group[neighbors]
+
+
+def _pair_keys(neighbors: np.ndarray) -> np.ndarray:
+    """``int64[R, K]``: an order-free key per (replica, neighbor) pair —
+    the LINK identity both directions of an edge share."""
+    nbrs = np.asarray(neighbors, dtype=np.int64)
+    if nbrs.ndim != 2:
+        raise ValueError(f"neighbors must be [R, K], got {nbrs.shape}")
+    r = np.arange(nbrs.shape[0], dtype=np.int64)[:, None]
+    lo = np.minimum(r, nbrs)
+    hi = np.maximum(r, nbrs)
+    return lo * nbrs.shape[0] + hi
+
+
+def symmetrize_edge_mask(neighbors: np.ndarray, mask: np.ndarray) -> np.ndarray:
+    """Normalize an edge-alive mask to guarantee SYMMETRIC (bidirectional)
+    link removal: if any direction of a replica pair's link is dead, every
+    edge of that pair dies — both directions AND duplicate neighbor
+    columns naming the same pair. One-way links silently break the
+    reverse-neighbor reachability assumption frontier scheduling rests on
+    (``gossip.frontier_reach`` expands the dirty set along PULL fan-in;
+    an asymmetric mask would let state flow backward over a link the
+    frontier believes dead). Only ever clears mask bits (conservative:
+    more loss, never phantom delivery). Self-edges (``neighbors[r, k] ==
+    r``) are structural no-ops either way and pass through on their own
+    key."""
+    m = np.asarray(mask, dtype=bool)
+    keys = _pair_keys(neighbors)
+    if m.shape != keys.shape:
+        raise ValueError(
+            f"mask shape {m.shape} does not match neighbors {keys.shape}"
+        )
+    dead = np.unique(keys[~m])
+    if not dead.size:
+        return m
+    return m & ~np.isin(keys, dead)
+
+
+def assert_symmetric_mask(neighbors: np.ndarray, mask: np.ndarray) -> None:
+    """Loud check of the bidirectional-removal contract: raises
+    ``ValueError`` naming an offending replica pair if some link is dead
+    in one direction (or one duplicate column) but alive in another.
+    Self-edges are exempt (a dead ``r -> r`` edge is a no-op join)."""
+    m = np.asarray(mask, dtype=bool)
+    keys = _pair_keys(neighbors)
+    if m.shape != keys.shape:
+        raise ValueError(
+            f"mask shape {m.shape} does not match neighbors {keys.shape}"
+        )
+    n = np.asarray(neighbors).shape[0]
+    self_keys = np.arange(n, dtype=np.int64) * n + np.arange(n)
+    offenders = np.intersect1d(np.unique(keys[~m]), np.unique(keys[m]))
+    offenders = np.setdiff1d(offenders, self_keys)
+    if offenders.size:
+        lo, hi = int(offenders[0]) // n, int(offenders[0]) % n
+        raise ValueError(
+            f"asymmetric edge mask: link ({lo}, {hi}) is dead in one "
+            f"direction but alive in the other ({offenders.size} "
+            "offending pair(s)); one-way links break frontier "
+            "reachability — normalize with symmetrize_edge_mask"
+        )

@@ -54,6 +54,43 @@ def fused_gossip_rounds_count(
     return out, prod
 
 
+def fused_chaos_rounds(codec, spec, states, neighbors, masks):
+    """Run one WINDOW of a chaos schedule — ``masks: bool[T, R, K]``,
+    one edge-alive mask per round (the per-round compilation a
+    ``chaos.ChaosSchedule`` emits) — inside a single ``lax.fori_loop``
+    dispatch. This is the CODEC-LEVEL member of this module's family
+    (like :func:`fused_gossip_rounds` / :func:`fused_frontier_rounds`):
+    the entry point for populations managed outside a
+    ``ReplicatedRuntime``. The runtime-layer twin is
+    ``chaos.ChaosRuntime.fused_steps``, which runs the runtime's FULL
+    step (dataflow sweep + triggers + per-var residuals) under the same
+    stacked-mask shape — equivalence between the two is pinned by
+    tests/chaos/test_schedule.py. The schedule rides as a TRACED operand: the whole fault
+    timeline (partitions opening and healing, flaky links flickering,
+    slow shards throttling) compiles into the SAME masked
+    :func:`~lasp_tpu.mesh.gossip.gossip_round` kernel the dense engine
+    uses — no chaos-specific collective path, so the per-round states
+    are bit-identical to stepping the masks one host dispatch at a time
+    (asserted by tests/chaos/test_schedule.py).
+
+    Returns ``(new_states, residuals)`` with ``residuals: int32[T]`` =
+    replica rows each round changed — the same residual contract as the
+    engine step, so healing (a zero tail after the last fault clears)
+    is visible without per-round host syncs."""
+    masks = jnp.asarray(masks)
+    n_rounds = masks.shape[0]
+
+    def body(i, carry):
+        s, res = carry
+        new = gossip_round(codec, spec, s, neighbors, masks[i])
+        changed = jax.vmap(lambda a, b: ~codec.equal(spec, a, b))(s, new)
+        return new, res.at[i].set(jnp.sum(changed.astype(jnp.int32)))
+
+    return jax.lax.fori_loop(
+        0, n_rounds, body, (states, jnp.zeros((n_rounds,), jnp.int32))
+    )
+
+
 def fused_frontier_rounds(
     codec, spec, states, neighbors, frontier, n_rounds: int, edge_mask=None
 ):

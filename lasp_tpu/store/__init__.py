@@ -1,6 +1,12 @@
 """Store layer: the single-replica runtime core (reference L1 + L0 storage)."""
 
-from .checkpoint import load_runtime, load_store, save_runtime, save_store
+from .checkpoint import (
+    load_runtime,
+    load_runtime_rows,
+    load_store,
+    save_runtime,
+    save_store,
+)
 from .host_store import HostStore
 from .store import PreconditionError, Store, Variable, Watch
 
@@ -11,6 +17,7 @@ __all__ = [
     "Variable",
     "Watch",
     "load_runtime",
+    "load_runtime_rows",
     "load_store",
     "save_runtime",
     "save_store",

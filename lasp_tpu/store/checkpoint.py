@@ -293,6 +293,48 @@ def save_runtime(runtime, path: str) -> None:
         hs.put("manifest", pickle.dumps(manifest))
 
 
+def load_runtime_rows(path: str, replica: int) -> dict:
+    """ONE replica's row of every variable from a runtime checkpoint,
+    WITHOUT rebuilding the runtime: ``{var_id: [row leaf arrays, ...]}``
+    in the checkpoint's flatten order (unflatten against a live
+    population's treedef — ``ReplicatedRuntime.reseed_row`` does).
+
+    This is the crash-recovery restore source of the chaos engine
+    (``chaos.ChaosRuntime``): a crashed replica restored mid-soak
+    re-seeds its row from the snapshot (the reference's persisted-vnode
+    reload, ``src/lasp_vnode.erl:220-237``) instead of the lattice
+    bottom, then catches the delta up by gossip — hinted-handoff-shaped
+    recovery at O(row) I/O, not O(population)."""
+    with HostStore(path) as hs:
+        raw = hs.get("manifest")
+        if raw is None:
+            raise IOError(f"no checkpoint manifest in {path}")
+        manifest = loads_manifest(raw)
+        if manifest.get("kind") != "runtime":
+            raise IOError(
+                f"{path} is not a runtime checkpoint (kind="
+                f"{manifest.get('kind')!r}); row restore needs the "
+                "replicated [R, ...] states"
+            )
+        n_replicas = manifest["n_replicas"]
+        if not 0 <= replica < n_replicas:
+            raise IndexError(
+                f"replica {replica} out of range for the snapshot's "
+                f"{n_replicas} replicas"
+            )
+        out: dict = {}
+        for var_id, entry in manifest["vars"].items():
+            leaves = []
+            for i, (dtype, shape) in enumerate(entry["leaves"]):
+                raw_leaf = hs.get(_leaf_key(var_id, i))
+                if raw_leaf is None:
+                    raise IOError(f"checkpoint missing leaf {var_id}/{i}")
+                full = np.frombuffer(raw_leaf, dtype=dtype).reshape(shape)
+                leaves.append(np.array(full[replica]))
+            out[var_id] = leaves
+        return out
+
+
 def load_runtime(path: str, graph=None, n_replicas=None, neighbors=None):
     """Rebuild a ReplicatedRuntime (store + replica states + topology).
     Dataflow edges are code, not data — pass a freshly built ``graph``

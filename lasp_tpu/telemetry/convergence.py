@@ -89,6 +89,9 @@ class ConvergenceMonitor:
         #: var -> dirty-replica frontier size after the last frontier
         #: round (delta-gossip scheduling; empty when dense-only)
         self.frontier: dict = {}
+        #: latest chaos soak report (rounds_to_heal, degraded reads,
+        #: repair bytes — chaos.ChaosRuntime.soak); empty outside soaks
+        self.chaos: dict = {}
         self._tel: "dict | None" = None
 
     def _check_generation(self) -> None:
@@ -156,6 +159,17 @@ class ConvergenceMonitor:
             self._check_generation()
             for v, n in zip(var_ids, sizes):
                 self.frontier[v] = int(n)
+
+    def observe_chaos(self, **report) -> None:
+        """Fold a chaos soak's outcome into the health surface — the
+        resilience twin of the residual feed: ``rounds_to_heal``,
+        ``degraded_reads``, ``repair_bytes``, ``healed`` etc. from
+        ``chaos.ChaosRuntime.soak`` land under the snapshot's ``chaos``
+        key (the ``{health}`` verb and ``lasp_tpu top`` read it)."""
+        with self._lock:
+            self._check_generation()
+            self.chaos.update(report)
+            self.chaos["round"] = self.round
 
     def observe_membership(self, kind: str, old_n: int, new_n: int) -> None:
         with self._lock:
@@ -424,6 +438,7 @@ class ConvergenceMonitor:
                 )[: self.top_k],
                 "quiescence_eta": self._eta_locked(),
                 "frontier_by_var": dict(self.frontier),
+                "chaos": dict(self.chaos),
                 "residual_curve": curve[-64:],
                 "memberships": list(self.memberships),
                 "probe": self.last_probe,

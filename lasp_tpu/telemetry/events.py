@@ -59,6 +59,7 @@ EVENT_TYPES = frozenset({
     "propagate",       # one dataflow propagate-to-fixpoint run
     "edge_recompute",  # DEEP: one edge's recompute provenance
     "frontier_skip",   # dirty-set scheduling skipped vars/edges outright
+    "chaos",           # fault injected/healed, crash/restore, degraded read
 })
 
 _lock = threading.Lock()

@@ -1,0 +1,122 @@
+"""BridgeClient resilience: idempotent-verb retry across a server
+kill/restart mid-session (the chaos-mesh satellite), non-idempotent
+fail-fast, and per-call timeouts."""
+
+import socket
+import time
+
+import pytest
+
+from lasp_tpu.bridge import BridgeClient, BridgeServer
+from lasp_tpu.bridge.etf import Atom
+
+
+def _restart_on(port: int, **kwargs) -> BridgeServer:
+    """Bind a fresh server to a just-freed port (SO_REUSEADDR races on
+    loaded hosts: retry briefly instead of flaking)."""
+    for _ in range(50):
+        try:
+            server = BridgeServer(port=port, **kwargs)
+            server.start()
+            return server
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError(f"could not rebind port {port}")
+
+
+def test_idempotent_verbs_survive_server_restart(tmp_path):
+    """Kill and restart a DURABLE BridgeServer mid-session: the client's
+    reads retry through the outage, reconnect, replay {start, Name}, and
+    see the persisted state."""
+    data = str(tmp_path / "bridge_data")
+    server = BridgeServer(port=0, data_dir=data)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=4,
+                         backoff=0.05)
+        assert c.start("soak")[0] == Atom("ok")
+        c.declare(b"v", "lasp_gset", n_elems=8)
+        c.update(b"v", (Atom("add"), b"x"), b"w")
+        assert c.get(b"v")[0] == Atom("ok")
+
+        server.stop()
+        server = _restart_on(port, data_dir=data)
+
+        # idempotent read: retried + reconnected + session replayed; the
+        # durable store's state survived the restart
+        resp = c.get(b"v")
+        assert resp[0] == Atom("ok")
+        # metrics/health work across the same reconnect machinery
+        ok, payload = c.metrics()
+        assert ok == Atom("ok") and b"bridge_requests_total" in payload
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_non_idempotent_verbs_fail_fast():
+    server = BridgeServer(port=0)
+    port = server.start()
+    c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=3,
+                     backoff=0.01)
+    assert c.start("s")[0] == Atom("ok")
+    c.declare(b"v", "riak_dt_gcounter")
+    server.stop()
+    with pytest.raises(ConnectionError, match="never retried"):
+        # a lost increment's outcome is unknown; blind replay could
+        # double-count — the client must fail fast, not retry
+        c.update(b"v", (Atom("increment"),), b"w")
+    c.close()
+
+
+def test_idempotent_retry_exhaustion_raises():
+    server = BridgeServer(port=0)
+    port = server.start()
+    c = BridgeClient("127.0.0.1", port, timeout=0.5, retries=2,
+                     backoff=0.01)
+    assert c.start("s")[0] == Atom("ok")
+    server.stop()  # nothing ever comes back
+    with pytest.raises(ConnectionError, match="after 3 attempts"):
+        c.metrics()
+    c.close()
+
+
+def test_explicit_idempotent_override_retries_update(tmp_path):
+    """A caller that KNOWS its op is an idempotent CRDT write (a set
+    add) can opt into replay across a restart."""
+    data = str(tmp_path / "bridge_data")
+    server = BridgeServer(port=0, data_dir=data)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=4,
+                         backoff=0.05)
+        assert c.start("s2")[0] == Atom("ok")
+        c.declare(b"v", "lasp_gset", n_elems=8)
+        server.stop()
+        server = _restart_on(port, data_dir=data)
+        resp = c.call(
+            (Atom("update"), b"v", (Atom("add"), b"x"), b"w"),
+            idempotent=True,
+        )
+        assert resp[0] == Atom("ok")
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_per_call_timeout_applies():
+    """The per-call timeout reaches the socket: a server that accepts
+    but never answers trips the deadline instead of hanging."""
+    sink = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sink.bind(("127.0.0.1", 0))
+    sink.listen(1)
+    port = sink.getsockname()[1]
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=30.0, retries=0)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            c.call((Atom("metrics"),), timeout=0.3)
+        assert time.monotonic() - t0 < 5.0
+        c.close()
+    finally:
+        sink.close()

@@ -1,0 +1,140 @@
+"""ChaosSchedule compilation properties: determinism, symmetry, event
+semantics, and the fused-kernel equivalence (a schedule window run
+through ``ops.fused.fused_chaos_rounds`` is bit-identical to stepping
+its masks one round at a time)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lasp_tpu.chaos import (
+    ChaosSchedule,
+    Crash,
+    DelayLinks,
+    DuplicateLinks,
+    FlakyLinks,
+    Partition,
+    Restore,
+    SlowShard,
+    nemesis,
+)
+from lasp_tpu.chaos.schedule import PRESETS
+from lasp_tpu.lattice import GSet, GSetSpec
+from lasp_tpu.lattice.base import replicate
+from lasp_tpu.mesh import random_regular, ring
+from lasp_tpu.mesh.gossip import gossip_round
+from lasp_tpu.mesh.topology import assert_symmetric_mask
+from lasp_tpu.ops.fused import fused_chaos_rounds
+
+N = 48
+
+
+def _sched(events, seed=7, nbrs=None):
+    nbrs = random_regular(N, 3, seed=1) if nbrs is None else nbrs
+    return ChaosSchedule(N, nbrs, events, seed=seed)
+
+
+def test_masks_deterministic_and_symmetric():
+    nbrs = random_regular(N, 3, seed=1)
+    ev = [FlakyLinks(0, 10, 0.3), Partition(3, 7, 2),
+          SlowShard(2, 9, shard=1, n_shards=4, period=2),
+          DelayLinks(0, 10, frac=0.4, delay=2)]
+    a, b = _sched(ev, nbrs=nbrs), _sched(ev, nbrs=nbrs)
+    for rnd in range(12):
+        ma, mb = a.mask_at(rnd), b.mask_at(rnd)
+        if ma is None:
+            assert mb is None
+            continue
+        assert np.array_equal(ma, mb)  # (seed, schedule) -> same masks
+        assert_symmetric_mask(nbrs, ma)  # bidirectional link removal
+    # a different seed produces different flaky draws
+    c = _sched(ev, seed=8, nbrs=nbrs)
+    assert any(
+        not np.array_equal(a.mask_at(r), c.mask_at(r)) for r in range(10)
+    )
+
+
+def test_no_active_fault_returns_none_and_stable_identity():
+    s = _sched([Partition(2, 6, 2)])
+    assert s.mask_at(0) is None and s.mask_at(7) is None
+    # identical fault state across a stable window -> the SAME object
+    # (the frontier mask-identity contract)
+    assert s.mask_at(3) is s.mask_at(4)
+
+
+def test_crash_kills_all_links_and_restore_heals():
+    nbrs = ring(N, 2)
+    s = _sched([Crash(1, 5), Restore(4, 5)], nbrs=nbrs)
+    assert s.mask_at(0) is None
+    m = s.mask_at(2)
+    # every edge pulling FROM 5 and every edge OF 5 is dead
+    assert not m[5].any()
+    assert not m[np.asarray(nbrs) == 5].any()
+    assert s.crashed_at(2)[5] and not s.crashed_at(4)[5]
+    assert s.mask_at(4) is None
+    assert s.horizon == 4
+
+
+def test_schedule_validation():
+    nbrs = ring(N, 2)
+    with pytest.raises(ValueError, match="not crashed"):
+        _sched([Restore(2, 3)], nbrs=nbrs)
+    with pytest.raises(ValueError, match="already crashed"):
+        _sched([Crash(1, 3), Crash(2, 3)], nbrs=nbrs)
+    with pytest.raises(ValueError, match="empty fault window"):
+        _sched([Partition(5, 5, 2)], nbrs=nbrs)
+    with pytest.raises(TypeError, match="unknown chaos event"):
+        _sched([("boom", 1)], nbrs=nbrs)
+    with pytest.raises(ValueError, match="unknown nemesis preset"):
+        nemesis("split-brain", N, nbrs)
+    with pytest.raises(TypeError, match="unknown options"):
+        nemesis("ring-cut", N, nbrs, frobnicate=1)
+
+
+def test_duplicates_count_but_do_not_mask():
+    s = _sched([DuplicateLinks(0, 4, frac=0.5)])
+    assert s.mask_at(1) is None  # idempotence absorbs duplication
+    assert s.duplicate_links_at(1) > 0
+    assert s.duplicate_links_at(9) == 0
+
+
+def test_presets_heal_by_horizon():
+    nbrs = random_regular(N, 3, seed=2)
+    for preset in PRESETS:
+        s = nemesis(preset, N, nbrs, seed=4, rounds=6)
+        assert s.horizon > 0
+        assert s.mask_at(s.horizon) is None, preset  # healed at horizon
+        assert not s.crashed_at(s.horizon).any(), preset
+
+
+def test_fused_chaos_rounds_matches_per_round_masks():
+    """The whole timeline compiles into the existing masked kernel: one
+    fori_loop over stacked masks == per-round host dispatches."""
+    nbrs = jnp.asarray(random_regular(N, 3, seed=3))
+    s = _sched([FlakyLinks(0, 6, 0.4), Partition(2, 5, 2)],
+               nbrs=np.asarray(nbrs))
+    spec = GSetSpec(n_elems=16)
+    states = replicate(GSet.new(spec), N)
+    rows = np.asarray([0, 7, 23])
+    states = states._replace(
+        mask=states.mask.at[jnp.asarray(rows), jnp.asarray(rows % 16)].set(
+            True
+        )
+    )
+    masks = s.masks(0, 8)
+    fused, residuals = fused_chaos_rounds(
+        GSet, spec, states, nbrs, jnp.asarray(masks)
+    )
+    ref = states
+    ref_res = []
+    for t in range(8):
+        new = gossip_round(GSet, spec, ref, nbrs, jnp.asarray(masks[t]))
+        changed = jax.vmap(lambda a, b: ~GSet.equal(spec, a, b))(ref, new)
+        ref_res.append(int(jnp.sum(changed)))
+        ref = new
+    same = jax.tree_util.tree_map(
+        lambda x, y: bool(jnp.array_equal(x, y)), fused, ref
+    )
+    assert all(jax.tree_util.tree_leaves(same))
+    assert np.asarray(residuals).tolist() == ref_res

@@ -385,6 +385,61 @@ def test_mask_change_degrades_frontier():
     assert rt.coverage_value(v) == {"x"} == rt.replica_value(v, n - 1)
 
 
+def test_crash_checkpoint_restore_frontier_chaos_path(tmp_path):
+    """The chaos extension of the mask-tagging regression: a replica
+    crashed mid-soak and restored from a ``store/checkpoint.py``
+    runtime snapshot must degrade every frontier to all-dirty and still
+    drive the population to the DENSE fixed point — stale checkpoint
+    rows (including a token the survivors have since tombstoned) are
+    caught up / overruled by gossip, with no resurrection."""
+    from lasp_tpu.chaos import ChaosRuntime, ChaosSchedule, Crash, Restore
+    from lasp_tpu.store import save_runtime
+
+    n = 48
+    nbrs = random_regular(n, 3, seed=13)
+    store = Store(n_actors=8)
+    v = store.declare(id="s", type="lasp_orset", n_elems=8, n_actors=8,
+                      tokens_per_actor=2)
+    rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+    rt.update_at(5, v, ("add", "keep"), "w5")
+    rt.update_at(5, v, ("add", "gone"), "w5")
+    rt.run_to_convergence(mode="frontier")
+    path = str(tmp_path / "soak.ck")
+    save_runtime(rt, path)
+    # post-snapshot divergence: a remove the checkpoint row never saw,
+    # plus a fresh element the crashed replica must learn on return
+    rt.update_at(5, v, ("remove", "gone"), "w5")
+    rt.update_at(7, v, ("add", "new"), "w7")
+    sched = ChaosSchedule(
+        n, nbrs, [Crash(1, 5), Restore(4, 5, source="checkpoint")],
+        seed=2,
+    )
+    ch = ChaosRuntime(rt, sched, checkpoint=path)
+    rep = ch.soak(mode="frontier", max_rounds=200)
+    assert rep["healed"] and rep["restores"] == 1
+    # the restore degraded row knowledge: frontier runs reached the
+    # dense fixed point anyway
+    assert rt.divergence(v) == 0
+    assert rt.coverage_value(v) == {"keep", "new"}
+    assert rt.replica_value(v, 5) == {"keep", "new"}
+    # a dense twin driven through the same schedule lands the same state
+    store2 = Store(n_actors=8)
+    v2 = store2.declare(id="s", type="lasp_orset", n_elems=8, n_actors=8,
+                        tokens_per_actor=2)
+    rt2 = ReplicatedRuntime(store2, Graph(store2), n, nbrs)
+    rt2.update_at(5, v2, ("add", "keep"), "w5")
+    rt2.update_at(5, v2, ("add", "gone"), "w5")
+    rt2.run_to_convergence()
+    rt2.update_at(5, v2, ("remove", "gone"), "w5")
+    rt2.update_at(7, v2, ("add", "new"), "w7")
+    ch2 = ChaosRuntime(rt2, ChaosSchedule(
+        n, nbrs, [Crash(1, 5), Restore(4, 5, source="checkpoint")],
+        seed=2,
+    ), checkpoint=path)
+    ch2.soak(mode="dense")
+    assert _tree_eq(rt.states[v], rt2.states[v2])
+
+
 def test_probe_reports_frontier_cut_rows():
     """A dense-scheduled partitioned runtime still maintains frontier
     masks; the monitor probe reports dirty ∩ cut (the exchange-waste

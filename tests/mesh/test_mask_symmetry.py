@@ -1,0 +1,126 @@
+"""Bidirectional link removal (the chaos-mesh satellite): symmetrize /
+assert helpers, the edge_failure_mask symmetric mode, and the property
+that partition masks are symmetric by construction."""
+
+import numpy as np
+import pytest
+
+from lasp_tpu.mesh import (
+    assert_symmetric_mask,
+    edge_failure_mask,
+    partition_mask,
+    random_regular,
+    ring,
+    scale_free,
+    symmetrize_edge_mask,
+)
+from lasp_tpu.mesh.topology import _pair_keys
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_symmetrize_property(seed):
+    """For random topologies and random masks: the symmetrized mask
+    passes the loud assert, only ever KILLS edges, and kills exactly
+    the pairs that had any dead direction."""
+    rng = np.random.RandomState(seed)
+    n, k = 64, 3
+    nbrs = random_regular(n, k, seed=seed)
+    raw = rng.random_sample((n, k)) >= 0.3
+    sym = symmetrize_edge_mask(nbrs, raw)
+    assert_symmetric_mask(nbrs, sym)
+    assert not (sym & ~raw).any()  # never resurrects an edge
+    # pair-accurate: an edge survives iff NO direction of its pair died
+    keys = _pair_keys(nbrs)
+    dead = set(np.unique(keys[~raw]).tolist())
+    expect = raw & ~np.isin(keys, list(dead))
+    assert np.array_equal(sym, expect)
+    # idempotent
+    assert np.array_equal(symmetrize_edge_mask(nbrs, sym), sym)
+
+
+def test_assert_raises_on_one_way_link():
+    n = 16
+    nbrs = ring(n, 2)  # columns +1, -1: every link appears both ways
+    mask = np.ones((n, 2), dtype=bool)
+    mask[3, 0] = False  # 3 -/-> 4, but 4 -> 3 still alive
+    with pytest.raises(ValueError, match="asymmetric edge mask"):
+        assert_symmetric_mask(nbrs, mask)
+    fixed = symmetrize_edge_mask(nbrs, mask)
+    assert_symmetric_mask(nbrs, fixed)
+    assert not fixed[4, 1]  # the reverse direction died too
+
+
+def test_self_edges_exempt():
+    nbrs = np.zeros((4, 1), dtype=np.int32)
+    nbrs[:, 0] = np.arange(4)  # every edge is a self-loop
+    mask = np.array([[True], [False], [True], [True]])
+    assert_symmetric_mask(nbrs, mask)  # dead self-edges are no-ops
+
+
+def test_partition_mask_symmetric_by_construction():
+    for n, k, groups in ((48, 3, 2), (60, 4, 3)):
+        nbrs = random_regular(n, k, seed=1)
+        assert_symmetric_mask(nbrs, partition_mask(n, nbrs, groups))
+        nbrs = scale_free(n, k, seed=2)
+        assert_symmetric_mask(nbrs, partition_mask(n, nbrs, groups))
+
+
+def test_edge_failure_mask_symmetric_mode():
+    n, k = 64, 3
+    nbrs = random_regular(n, k, seed=5)
+    sym = edge_failure_mask(n, k, 0.3, seed=7, neighbors=nbrs)
+    assert_symmetric_mask(nbrs, sym)
+    raw = edge_failure_mask(n, k, 0.3, seed=7)
+    # the symmetric mode is the raw draw, normalized (kills only)
+    assert np.array_equal(sym, symmetrize_edge_mask(nbrs, raw))
+    assert not (sym & ~raw).any()
+
+
+def test_shape_mismatch_is_loud():
+    nbrs = ring(8, 2)
+    with pytest.raises(ValueError, match="does not match"):
+        symmetrize_edge_mask(nbrs, np.ones((8, 3), dtype=bool))
+    with pytest.raises(ValueError, match="does not match"):
+        assert_symmetric_mask(nbrs, np.ones((4, 2), dtype=bool))
+
+
+def test_frontier_matches_dense_under_symmetrized_mask():
+    """The reachability story the satellite protects: under a
+    symmetrized mask, frontier and dense scheduling stay bit-identical
+    to the fixed point (the frontier-reach superset invariant holds on
+    bidirectional-failure graphs)."""
+    import jax
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime
+    from lasp_tpu.store import Store
+
+    n, k = 64, 3
+    nbrs = random_regular(n, k, seed=9)
+    mask = edge_failure_mask(n, k, 0.35, seed=3, neighbors=nbrs)
+
+    def build():
+        store = Store(n_actors=4)
+        v = store.declare(id="a", type="lasp_gset", n_elems=8)
+        rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+        rt.update_batch(
+            v, [(0, ("add", "x"), "c0"), (40, ("add", "y"), "c40")]
+        )
+        return rt, v
+
+    import jax.numpy as jnp
+
+    jmask = jnp.asarray(mask)
+    rt_f, v = build()
+    rt_d, _ = build()
+    for _ in range(64):
+        rf, rd = rt_f.frontier_step(jmask), rt_d.step(jmask)
+        assert rf == rd
+        same = jax.tree_util.tree_map(
+            lambda x, y: bool(jnp.array_equal(x, y)),
+            rt_f.states[v], rt_d.states[v],
+        )
+        assert all(jax.tree_util.tree_leaves(same))
+        if rd == 0:
+            return
+    pytest.fail("no fixed point under the symmetrized mask")

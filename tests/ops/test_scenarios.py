@@ -26,6 +26,7 @@ def test_scenario_registry_complete():
         "bridge_throughput",
         "partitioned_gossip",
         "frontier_sparse",
+        "chaos_heal",
     }
 
 
@@ -108,3 +109,14 @@ def test_adcounter_small():
     assert out["live_ads"] == 6
     assert out["active_pairs"] == 6
     assert out["ad_totals"] == [1, 2, 3, 4, 5, 6, 7, 8, 1, 2]
+
+
+def test_chaos_heal_small():
+    from lasp_tpu.bench_scenarios import chaos_heal
+
+    out = chaos_heal(n_replicas=96, fault_rounds=6)
+    assert out["check"] == (
+        "post-heal state bit-identical to fault-free fixed point"
+    )
+    assert out["healed"] and out["restores"] == out["crashes"] == 2
+    assert out["rounds_to_heal"] >= 0 and out["degraded_reads"] > 0

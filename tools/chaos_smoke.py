@@ -1,0 +1,91 @@
+#!/usr/bin/env python3
+"""Chaos smoke for the lint tier (Makefile ``verify``): a ~30-second
+seeded soak — ring-cut partition THEN rolling crash/restore over one
+population — asserting the convergence-under-failure invariants the
+chaos mesh exists to uphold (docs/RESILIENCE.md):
+
+- post-heal state BIT-IDENTICAL to the fault-free run's fixed point
+  (faults delay convergence, never change its destination);
+- per-replica monotone inflation every round (restores exempt);
+- the same (seed, schedule) REPLAYS to identical per-round states.
+
+A sub-minute subset of tests/chaos/; exits 0 on agreement, 1 with the
+violated invariant on drift."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from lasp_tpu.chaos import (
+        ChaosSchedule,
+        Crash,
+        InvariantViolation,
+        Partition,
+        Restore,
+        run_harness,
+    )
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.store import Store
+
+    n = 64
+    nbrs = random_regular(n, 3, seed=21)
+
+    def build():
+        store = Store(n_actors=8)
+        v = store.declare(id="s", type="riak_dt_orswot", n_elems=16,
+                          n_actors=8)
+        g = store.declare(id="g", type="lasp_gset", n_elems=16)
+        rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+        rng = np.random.RandomState(5)
+        rows = rng.choice(n, 6, replace=False)
+        rt.update_batch(
+            g, [(int(r), ("add", f"e{int(r) % 8}"), f"c{r}") for r in rows]
+        )
+        rt.update_at(int(rows[0]), v, ("add", "kept"), "w0")
+        rt.update_at(int(rows[1]), v, ("add", "gone"), "w1")
+        rt.update_at(int(rows[1]), v, ("remove", "gone"), "w1")
+        return rt
+
+    rng = np.random.RandomState(9)
+    victims = [int(r) for r in rng.choice(n, 2, replace=False)]
+    schedule = ChaosSchedule(
+        n, nbrs,
+        [
+            Partition(2, 8, 2),                       # ring-cut, heals
+            Crash(8, victims[0]), Crash(10, victims[1]),  # then rolling
+            Restore(12, victims[0]), Restore(14, victims[1]),
+        ],
+        seed=13,
+    )
+    try:
+        for mode in ("dense", "frontier"):
+            report = run_harness(
+                build, schedule, mode=mode, replay=True,
+                removed_terms={"s": {"gone"}},
+            )
+            print(
+                f"chaos smoke [{mode}]: healed in "
+                f"{report['rounds_to_heal']} rounds post-horizon, "
+                f"bit-identical to fault-free, replay deterministic"
+            )
+    except InvariantViolation as exc:
+        print(f"chaos_smoke: INVARIANT VIOLATED: {exc}", file=sys.stderr)
+        return 1
+    print("chaos smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
