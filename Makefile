@@ -23,13 +23,16 @@ test-slow:
 # lint + fast suite: the telemetry-catalog check keeps the metric /
 # event / span key sets (docs/OBSERVABILITY.md) in lock-step with the
 # code, a fast frontier-vs-dense equivalence smoke guards the delta
-# gossip engine's bit-identical contract, a seeded chaos soak guards
-# the convergence-under-failure invariants (post-heal bit-equality +
-# replay determinism, docs/RESILIENCE.md), then the non-slow tests run
-# (the tier-1 shape)
+# gossip engine's bit-identical contract, a planned-vs-per-var smoke
+# guards the megabatch dispatch plan's bit-identical contract on a
+# mixed-codec store (docs/PERF.md "Batched dispatch"), a seeded chaos
+# soak guards the convergence-under-failure invariants (post-heal
+# bit-equality + replay determinism, docs/RESILIENCE.md), then the
+# non-slow tests run (the tier-1 shape)
 verify:
 	python tools/check_metrics_catalog.py
 	python tools/frontier_smoke.py
+	python tools/plan_smoke.py
 	python tools/chaos_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 

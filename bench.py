@@ -396,6 +396,10 @@ def _child(label: str) -> int:
         # diverged-at-seed population, per-block productive-round curve,
         # worst-replica lag — the scenario computes these untimed
         "convergence": out.get("convergence"),
+        # noise discipline: per-rep timings + the observed band, so
+        # vs_baseline is interpretable against this host's ±2x-class
+        # load-burst variance (the headline value is the median rep)
+        "timing": out.get("timing"),
     }
 
     # -- frontier-vs-dense sparse-update arm (~seconds): dirty-set
@@ -409,6 +413,17 @@ def _child(label: str) -> int:
         detail["frontier_sparse"] = frontier_sparse()
     except Exception as exc:
         detail["frontier_sparse"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    # -- cross-variable megabatch dispatch arm (~seconds): 128 small
+    # mixed-codec vars, per-var vs planned frontier rounds from identical
+    # seeds — bit-identical states/residual sequences asserted inside the
+    # scenario; both arm medians land in its impl_block_seconds ---------
+    try:
+        from lasp_tpu.bench_scenarios import many_vars
+
+        detail["many_vars"] = many_vars()
+    except Exception as exc:
+        detail["many_vars"] = {"error": f"{type(exc).__name__}: {exc}"}
 
     # -- chaos recovery arm (~seconds): composite nemesis (partition +
     # rolling crash) over a seeded population; records rounds-to-heal,

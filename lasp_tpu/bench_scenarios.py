@@ -31,6 +31,30 @@ def _timed(fn):
     return out, time.perf_counter() - t0
 
 
+def _snapshot_runtime(rt):
+    """States + frontier snapshot for warm best-of replays — shared by
+    the A/B scenarios (``frontier_sparse``, ``many_vars``): restore
+    from this and an identical schedule replays exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    return (
+        {k: jax.tree_util.tree_map(jnp.array, st)
+         for k, st in rt.states.items()},
+        {k: m.copy() for k, m in rt._frontier.items()},
+    )
+
+
+def _restore_runtime(rt, snap) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    states, frontier = snap
+    for k, st in states.items():
+        rt.states[k] = jax.tree_util.tree_map(jnp.array, st)
+    rt._frontier = {k: m.copy() for k, m in frontier.items()}
+
+
 def _engine_convergence_driver(rt):
     """Shared warm-up + timed-run driver for the engine-path scenarios.
 
@@ -162,6 +186,7 @@ def orset_anti_entropy(
     n_actors: int = 8,
     tokens_per_actor: int = 4,
     gossip_impl: str = "auto",
+    timing_reps: int = 3,
 ) -> dict:
     """OR-Set anti-entropy over random gossip on the packed codec — the ONE
     implementation shared by the ``orset_100k`` scenario and ``bench.py``'s
@@ -375,14 +400,22 @@ def orset_anti_entropy(
     xcell[0] = None
     if pcell is not None:
         pcell[0] = None
-    states = seed_states()
-    jax.block_until_ready(states)
 
-    def run():
-        runners[chosen](states)
-        return None, conv_rounds
-
-    (_, _), secs = _timed(run)
+    # noise discipline: repeated identical runs on this host sit inside a
+    # ±2.3x wall-clock band under load bursts (CHANGES.md PR 3), which
+    # made a single-shot headline — and therefore vs_baseline —
+    # uninterpretable. One warm-up replay (discarded), then
+    # ``timing_reps`` measured replays from fresh identical seeds
+    # (donated blocks consume their input); the headline is the MEDIAN
+    # and the artifact records every rep plus the observed band.
+    rep_secs: list[float] = []
+    for rep in range(timing_reps + 1):
+        states = seed_states()
+        jax.block_until_ready(states)
+        _, rep_s = _timed(lambda: runners[chosen](states))
+        if rep:  # rep 0 re-warms caches after the probe churn
+            rep_secs.append(rep_s)
+    secs = float(np.median(rep_secs))
 
     bytes_per_replica = 2 * spec.n_elems * spec.n_words * 4  # both planes
     bytes_moved = (fanout + 2) * n_replicas * bytes_per_replica * conv_rounds
@@ -400,6 +433,14 @@ def orset_anti_entropy(
         "impl_block_seconds": {
             k: (round(v, 6) if isinstance(v, float) else v)
             for k, v in block_seconds.items()
+        },
+        "timing": {
+            "policy": f"median of {timing_reps} warm replays "
+                      "(1 warm-up discarded)",
+            "seconds_each": [round(s, 4) for s in rep_secs],
+            "noise_band": round(
+                max(rep_secs) / max(min(rep_secs), 1e-9), 2
+            ),
         },
         "convergence": {
             "rounds_to_quiescence": conv_rounds,
@@ -716,18 +757,7 @@ def frontier_sparse(
             )
         return rt, ids
 
-    def snapshot(rt):
-        return (
-            {k: jax.tree_util.tree_map(jnp.array, st)
-             for k, st in rt.states.items()},
-            {k: m.copy() for k, m in rt._frontier.items()},
-        )
-
-    def restore(rt, snap):
-        states, frontier = snap
-        for k, st in states.items():
-            rt.states[k] = jax.tree_util.tree_map(jnp.array, st)
-        rt._frontier = {k: m.copy() for k, m in frontier.items()}
+    snapshot, restore = _snapshot_runtime, _restore_runtime
 
     def timed_rep(rt, ids, run):
         """One measured replay from the snapshot (states + frontier
@@ -823,6 +853,160 @@ def frontier_sparse(
         "autotuned_crossover": autotuned,
         "engine": "ReplicatedRuntime(frontier_step)",
         "check": "fixed points bit-identical across schedulers",
+    }
+
+
+def many_vars(
+    n_replicas: int = 256,
+    n_vars: int = 128,
+    hot_vars: int = 2,
+    fanout: int = 3,
+    seed: int = 23,
+    reps: int = 3,
+) -> dict:
+    """Cross-variable megabatch dispatch A/B — the regime the dispatch
+    plan (``mesh.plan``) exists for: a store of ``n_vars`` SMALL named
+    CRDTs over mixed codecs (G-Set / G-Counter / OR-SWOT, cycled), every
+    variable touched at least once (all dirty at entry, the
+    post-write-burst shape) and ``hot_vars`` written broadly. The
+    population re-converges from identical seeds under both dispatch
+    arms:
+
+    - **per_var** (``plan="off"``): the historical frontier round — one
+      device dispatch + host sync per active variable per round, O(vars)
+      fixed cost even though every variable is tiny;
+    - **planned** (``plan="auto"``): same-codec variables stack into
+      ``[G, R, ...]`` super-tensors and each round issues ONE kernel per
+      active GROUP (3 groups here), per-var frontiers riding as row
+      masks.
+
+    Both arms are timed WARM over ``reps`` best-of replays (states +
+    frontier restored from a snapshot, identical schedule replays; the
+    cold pass compiles everything outside the clock), and the scenario
+    ASSERTS the megabatch contract: bit-identical final states,
+    identical per-round residual sequences, identical round counts. The
+    artifact records both arms in ``impl_block_seconds`` plus the
+    medians' noise band (the bench noise discipline of the headline)."""
+    import jax
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.store import Store
+
+    kinds = ("lasp_gset", "riak_dt_gcounter", "riak_dt_orswot")
+    nbrs = random_regular(n_replicas, fanout, seed=seed)
+    n_hot_rows = max(2, n_replicas // 8)
+
+    def build(plan: str) -> "tuple[ReplicatedRuntime, list]":
+        store = Store(n_actors=4)
+        ids = []
+        for i in range(n_vars):
+            kind = kinds[i % len(kinds)]
+            if kind == "lasp_gset":
+                ids.append(store.declare(id=f"v{i}", type=kind, n_elems=16))
+            elif kind == "riak_dt_gcounter":
+                ids.append(store.declare(id=f"v{i}", type=kind, n_actors=4))
+            else:
+                ids.append(store.declare(id=f"v{i}", type=kind, n_elems=8,
+                                         n_actors=4))
+        rt = ReplicatedRuntime(store, Graph(store), n_replicas, nbrs,
+                               plan=plan)
+        rng = np.random.RandomState(seed)
+        for j, v in enumerate(ids):
+            rows = rng.choice(
+                n_replicas, n_hot_rows if j < hot_vars else 1, replace=False
+            )
+            kind = kinds[j % len(kinds)]
+            if kind == "lasp_gset":
+                ops = [(int(r), ("add", f"e{int(r) % 8}"), f"a{int(r)}")
+                       for r in rows]
+            elif kind == "riak_dt_gcounter":
+                ops = [(int(r), ("increment",), ("lane", int(r) % 4))
+                       for r in rows]
+            else:
+                ops = [(int(r), ("add", f"x{int(r) % 8}"), f"w{int(r) % 4}")
+                       for r in rows]
+            rt.update_batch(v, ops)
+        return rt, ids
+
+    snapshot, restore = _snapshot_runtime, _restore_runtime
+
+    def drive(rt) -> list:
+        """The round loop under measurement: frontier rounds to
+        quiescence, residual sequence out."""
+        residuals = []
+        for _ in range(4096):
+            r = rt.frontier_step()
+            residuals.append(r)
+            if r == 0:
+                return residuals
+        raise RuntimeError("no convergence within 4096 rounds")
+
+    results = {}
+    finals = {}
+    residual_seqs = {}
+    plan_shape = None
+    for arm, plan in (("per_var", "off"), ("planned", "auto")):
+        rt, ids = build(plan)
+        snap = snapshot(rt)
+        cold_residuals = drive(rt)  # compiles every kernel in the schedule
+        if plan == "auto":
+            plan_shape = rt._ensure_plan().describe()
+        rep_secs = []
+        for _ in range(reps):
+            restore(rt, snap)
+            residuals, secs = _timed(lambda: drive(rt))
+            jax.block_until_ready([rt.states[v] for v in ids])
+            assert residuals == cold_residuals  # identical replay
+            rep_secs.append(secs)
+        residual_seqs[arm] = cold_residuals
+        results[arm] = {
+            "seconds": float(np.median(rep_secs)),
+            "seconds_each": [round(s, 6) for s in rep_secs],
+            "noise_band": round(
+                max(rep_secs) / max(min(rep_secs), 1e-9), 2
+            ),
+            "rounds": len(cold_residuals),
+        }
+        assert all(rt.divergence(v) == 0 for v in ids)
+        finals[arm] = {
+            v: jax.tree_util.tree_map(np.asarray, rt.states[v]) for v in ids
+        }
+        del rt
+
+    # the megabatch contract, asserted at the bench shape: identical
+    # round counts, identical per-round residual sequences, and
+    # bit-identical final states across the two dispatch arms
+    assert residual_seqs["per_var"] == residual_seqs["planned"]
+    same = jax.tree_util.tree_map(
+        lambda a, b: bool(np.array_equal(a, b)),
+        finals["per_var"], finals["planned"],
+    )
+    assert all(jax.tree_util.tree_leaves(same)), "arm states diverged"
+
+    pv_s = results["per_var"]["seconds"]
+    pl_s = results["planned"]["seconds"]
+    return {
+        "scenario": f"many_vars_{n_vars}x{n_replicas}",
+        "n_replicas": n_replicas,
+        "n_vars": n_vars,
+        "hot_vars": hot_vars,
+        "fanout": fanout,
+        "rounds": results["planned"]["rounds"],
+        "plan": plan_shape,
+        "impl_block_seconds": {
+            "per_var": round(pv_s, 6),
+            "planned": round(pl_s, 6),
+        },
+        "timing": {
+            "policy": f"median of {reps} warm snapshot replays per arm",
+            "per_var": results["per_var"],
+            "planned": results["planned"],
+        },
+        "gossip_impl": "planned" if pl_s <= pv_s else "per_var",
+        "plan_speedup": round(pv_s / pl_s, 2),
+        "engine": "ReplicatedRuntime(frontier_step, dispatch plan)",
+        "check": "bit-identical states + residual sequences across arms",
     }
 
 
@@ -1201,5 +1385,6 @@ SCENARIOS = {
     "bridge_throughput": bridge_throughput,
     "partitioned_gossip": partitioned_gossip,
     "frontier_sparse": frontier_sparse,
+    "many_vars": many_vars,
     "chaos_heal": chaos_heal,
 }

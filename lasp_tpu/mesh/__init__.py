@@ -8,10 +8,13 @@ from .gossip import (
     divergence,
     frontier_reach,
     gossip_round,
+    gossip_round_grouped,
     gossip_round_rows,
+    gossip_round_rows_grouped,
     join_all,
     quorum_read,
 )
+from .plan import DispatchPlan, PlanGroup, compile_plan
 from .runtime import ActorCollisionError, ReplicatedRuntime
 from .topology import (
     assert_symmetric_mask,
@@ -28,13 +31,18 @@ from .topology import (
 __all__ = [
     "ActorCollisionError",
     "assert_symmetric_mask",
+    "DispatchPlan",
+    "PlanGroup",
     "ReplicatedRuntime",
+    "compile_plan",
     "converged",
     "divergence",
     "edge_failure_mask",
     "frontier_reach",
     "gossip_round",
+    "gossip_round_grouped",
     "gossip_round_rows",
+    "gossip_round_rows_grouped",
     "join_all",
     "locality_order",
     "partition_mask",

@@ -131,7 +131,8 @@ def frontier_reach(frontier, neighbors, include_self: bool = False):
     return reach
 
 
-def gossip_round_rows(codec, spec, states, neighbors, rows, edge_mask=None):
+def gossip_round_rows(codec, spec, states, neighbors, rows, edge_mask=None,
+                      valid=None):
     """Masked pull-gossip round: join neighbor states into ONLY the
     replica rows named by ``rows`` (the frontier-reachable set); all
     other rows ride through untouched. Returns ``(new_states,
@@ -145,7 +146,20 @@ def gossip_round_rows(codec, spec, states, neighbors, rows, edge_mask=None):
     superset of the rows that round could change (the frontier-reach
     invariant — asserted by tests/mesh/test_frontier.py across codecs
     and edge masks). ``rows`` may contain duplicates (bucket padding):
-    idempotent joins make the duplicate scatter writes identical."""
+    idempotent joins make the duplicate scatter writes identical.
+
+    ``valid: bool[F]`` (optional) marks pad slots explicitly for the
+    CHANGED accounting: an invalid slot always reports
+    ``changed=False``. Its state write still carries the joined value —
+    never a stale one, because a pad slot's row is either a duplicate
+    of a valid slot (identical write by idempotence; a select-the-old
+    write here would instead RACE the valid duplicate in the scatter)
+    or a row outside the frontier reach, whose join is its own state by
+    the frontier invariant (reach ⊇ could-change). This is how a plan
+    group's stacked dispatch carries members with fewer dirty rows than
+    the group bucket — and how a fully QUIESCENT member rides a group
+    round as an empty row-mask (all slots invalid, every write an exact
+    no-op) instead of forcing a dense fallback."""
     rows = jnp.asarray(rows)
     nbr_idx = neighbors[rows]  # [F, K]
     old = jax.tree_util.tree_map(lambda x: x[rows], states)
@@ -171,10 +185,55 @@ def gossip_round_rows(codec, spec, states, neighbors, rows, edge_mask=None):
             acc = vmerge(acc, nbr)
         new_rows = acc
     changed = ~jax.vmap(lambda a, b: codec.equal(spec, a, b))(old, new_rows)
+    if valid is not None:
+        changed = changed & jnp.asarray(valid)
     new_states = jax.tree_util.tree_map(
         lambda x, nr: x.at[rows].set(nr), states, new_rows
     )
     return new_states, changed
+
+
+# -- grouped (megabatch) rounds: one kernel per same-codec variable group --
+#
+# The plan compiler (``mesh.plan``) stacks same-signature variables'
+# populations into ``[G, R, ...]`` super-tensors; these wrappers run the
+# corresponding round vmapped over the group axis. vmap of a
+# deterministic gather + join is the same computation batched, so every
+# member's result is bit-identical to its own per-var round (asserted
+# across codecs/topologies/masks by tests/mesh/test_plan.py).
+
+def gossip_round_grouped(codec, spec, states, neighbors, edge_mask=None):
+    """:func:`gossip_round` vmapped over a leading group axis: ``states``
+    leaves are ``[G, R, ...]``; neighbors/edge_mask are shared (one
+    topology, one mask per stepping call — runtime-wide)."""
+    return jax.vmap(
+        lambda s: gossip_round(codec, spec, s, neighbors, edge_mask)
+    )(states)
+
+
+def gossip_round_shift_grouped(codec, spec, states, offsets, edge_mask=None):
+    """:func:`gossip_round_shift` vmapped over a leading group axis
+    (shift-structured topologies keep their roll/collective-permute
+    lowering; the group axis batches the rolls)."""
+    return jax.vmap(
+        lambda s: gossip_round_shift(codec, spec, s, offsets, edge_mask)
+    )(states)
+
+
+def gossip_round_rows_grouped(codec, spec, states, neighbors, rows, valid,
+                              edge_mask=None):
+    """:func:`gossip_round_rows` vmapped over a leading group axis:
+    ``states`` leaves ``[G, R, ...]``, ``rows: int[G, F]`` (each
+    member's frontier-reachable rows, padded to the group bucket),
+    ``valid: bool[G, F]`` (which slots are real). Returns
+    ``(new_states, changed: bool[G, F])``. A member with zero valid
+    slots rides through bit-unchanged — the empty-row-mask contract for
+    quiescent variables inside an active group."""
+    return jax.vmap(
+        lambda s, r, v: gossip_round_rows(
+            codec, spec, s, neighbors, r, edge_mask, valid=v
+        )
+    )(states, jnp.asarray(rows), jnp.asarray(valid))
 
 
 def join_all(codec, spec, states):

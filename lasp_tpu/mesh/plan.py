@@ -1,0 +1,163 @@
+"""Cross-variable megabatch dispatch: the gossip plan compiler.
+
+``ReplicatedRuntime.step`` / ``frontier_step`` historically paid O(vars)
+fixed cost per round — one gossip kernel (and, on the frontier path, one
+whole device dispatch plus its host sync) per variable — even when every
+variable is tiny. A store with hundreds of named CRDTs is the common
+shape of a real deployment (the reference's global naming surface,
+``src/lasp.erl:345-366``, encourages exactly that), so the per-var
+dispatch floor dominates long before per-var compute does. DrJAX's
+mapped MapReduce primitives and the fusion-aware-mapping literature
+(PAPERS.md) both make the same observation: homogeneous per-population
+work wants to be STACKED into one traced program, not iterated.
+
+This module is the host-side half of that move: a **dispatch plan**
+groups the runtime's variables by codec signature —
+
+    (mesh codec class, mesh spec, replica count)
+
+— where "mesh codec/spec" is what the MESH sees (flat-packed OR-Sets in
+packed mode group by their ``FlatORSetSpec``, not the dense spec).
+Topology and edge-mask are runtime-wide (one neighbor table, one mask
+per stepping call), so they key the plan CACHE, not the grouping.
+Variables in one group have identical state-leaf shapes/dtypes, so
+their ``[R, ...]`` populations stack into ``[G, R, ...]`` super-tensors
+and one vmapped join+residual kernel (``gossip.gossip_round_grouped`` /
+``gossip_round_rows_grouped``) serves the whole group per round —
+bit-identical to per-var stepping, because vmap of a deterministic
+gather+join is the same computation batched.
+
+The plan itself is pure bookkeeping (no device state): the runtime owns
+compilation triggers and invalidation (resize, shard moves, late map
+fields, checkpoint restore, chaos mask changes — every event that could
+change a signature or the mask the cached executables were keyed
+under). Frontier knowledge stays PER-VAR: a quiescent variable inside a
+group contributes an empty row-mask to the group's stacked dispatch
+(its rows ride through bit-unchanged), never a dense fallback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..telemetry import counter, gauge
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanGroup:
+    """One same-signature variable group of a :class:`DispatchPlan`.
+
+    ``var_ids`` preserves the runtime's ``var_ids`` order (stable stack
+    axis); ``codec``/``spec`` are the MESH-side pair every member shares
+    (``ReplicatedRuntime._mesh_meta``)."""
+
+    var_ids: tuple
+    codec: type
+    spec: object
+
+    def __len__(self) -> int:
+        return len(self.var_ids)
+
+
+@dataclasses.dataclass(frozen=True)
+class DispatchPlan:
+    """An immutable grouping of a runtime's variables for stacked
+    dispatch. Recompiled (cheap, host-only) whenever the runtime
+    invalidates it; compiled executables live in the runtime's kernel
+    cache keyed by ``(group.var_ids, bucket, mask-noneness)``, so a
+    recompile that reproduces the same grouping reuses them."""
+
+    groups: tuple
+    n_replicas: int
+
+    @property
+    def n_vars(self) -> int:
+        return sum(len(g) for g in self.groups)
+
+    def describe(self) -> dict:
+        """Host-readable summary (tests, ``plan_smoke``, bench detail)."""
+        return {
+            "groups": len(self.groups),
+            "vars": self.n_vars,
+            "vars_per_group": [len(g) for g in self.groups],
+            "signatures": [
+                (g.codec.__name__, repr(g.spec)) for g in self.groups
+            ],
+        }
+
+
+def signature_of(runtime, var_id: str):
+    """The grouping signature of one variable as the mesh sees it, or
+    None when the spec is not hashable (defensive: such a variable
+    degrades to a singleton group rather than failing the plan)."""
+    codec, spec = runtime._mesh_meta(var_id)
+    try:
+        hash(spec)
+    except TypeError:
+        return None
+    return (codec, spec)
+
+
+def compile_plan(runtime) -> DispatchPlan:
+    """Group ``runtime.var_ids`` by signature into a :class:`DispatchPlan`.
+
+    Group order is first-appearance order of each signature and member
+    order is ``var_ids`` order — both deterministic, so a recompile over
+    an unchanged store reproduces the plan exactly (and the runtime's
+    kernel cache keeps every compiled group executable warm)."""
+    by_sig: dict = {}
+    order: list = []
+    singletons: list = []
+    for v in runtime.var_ids:
+        sig = signature_of(runtime, v)
+        if sig is None:
+            singletons.append(v)
+            continue
+        if sig not in by_sig:
+            by_sig[sig] = []
+            order.append(sig)
+        by_sig[sig].append(v)
+    groups = [
+        PlanGroup(var_ids=tuple(by_sig[sig]), codec=sig[0], spec=sig[1])
+        for sig in order
+    ]
+    for v in singletons:
+        codec, spec = runtime._mesh_meta(v)
+        groups.append(PlanGroup(var_ids=(v,), codec=codec, spec=spec))
+    plan = DispatchPlan(groups=tuple(groups), n_replicas=runtime.n_replicas)
+    counter(
+        "plan_compile_total",
+        help="dispatch-plan compilations (grouping walks, host-side)",
+    ).inc()
+    gauge(
+        "gossip_plan_groups",
+        help="variable groups in the current dispatch plan (same-codec "
+             "variables stack into one kernel per group)",
+    ).set(len(plan.groups))
+    if plan.groups:
+        gauge(
+            "gossip_plan_vars_per_dispatch",
+            help="mean variables served per stacked dispatch under the "
+                 "current plan (refreshed per planned frontier round)",
+        ).set(round(plan.n_vars / len(plan.groups), 3))
+    return plan
+
+
+def stack_group(states_seq) -> object:
+    """Stack a sequence of per-var ``[R, ...]`` populations into the
+    group's ``[G, R, ...]`` super-tensor (leafwise ``jnp.stack`` —
+    under jit this is a free layout op for G=1 and one concat
+    otherwise)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states_seq)
+
+
+def unstack_group(stacked, n: int) -> tuple:
+    """Per-member views of a ``[G, R, ...]`` super-tensor, in member
+    order — the scatter-back half of :func:`stack_group`."""
+    return tuple(
+        jax.tree_util.tree_map(lambda x, _i=i: x[_i], stacked)
+        for i in range(n)
+    )

@@ -40,12 +40,16 @@ from .gossip import (
     divergence,
     frontier_reach,
     gossip_round,
+    gossip_round_grouped,
     gossip_round_rows,
+    gossip_round_rows_grouped,
     gossip_round_shift,
+    gossip_round_shift_grouped,
     join_all,
     quorum_read,
     round_traffic_bytes,
 )
+from .plan import signature_of, stack_group, unstack_group
 from .topology import shift_offsets
 
 #: store types held flat-bit-packed on the mesh when ``packed=True``
@@ -140,7 +144,10 @@ class ReplicatedRuntime:
         packed: bool = False,
         donate_steps: bool = True,
         debug_actors: bool = False,
+        plan: str = "auto",
     ):
+        if plan not in ("auto", "off"):
+            raise ValueError(f"unknown plan mode {plan!r} ('auto' or 'off')")
         self.store = store
         self.graph = graph
         self.n_replicas = n_replicas
@@ -216,6 +223,17 @@ class ReplicatedRuntime:
         #: cached hot-path instruments: (registry generation, var_ids,
         #: edge-kind tuple, dict) — see _instruments()
         self._tel_cache: "tuple | None" = None
+        #: dispatch-plan mode: "auto" groups same-codec variables into
+        #: stacked megabatch kernels (``mesh.plan``), "off" keeps the
+        #: historical one-kernel-per-variable stepping (the bench's
+        #: per-var arm; also the escape hatch if a codec's vmapped
+        #: kernel misbehaves on an exotic backend)
+        self.plan_mode = plan
+        #: compiled DispatchPlan or None; invalidated (set None, counted)
+        #: by every event that can change a signature or the mask the
+        #: cached group executables were keyed under — see
+        #: :meth:`_invalidate_plan`
+        self._plan = None
         self._sync_graph()
 
     def _sync_graph(self) -> None:
@@ -249,6 +267,44 @@ class ReplicatedRuntime:
         self._n_edges = len(graph.edges)
         self._step = None
         self._fused_steps_cache.clear()
+        # a late-declared variable changes the var census (and possibly
+        # introduces a new signature): the grouping must be rebuilt
+        self._invalidate_plan("var_set")
+
+    # -- dispatch-plan lifecycle ---------------------------------------------
+    def _invalidate_plan(self, reason: str) -> None:
+        """Drop the compiled dispatch plan (``mesh.plan``) so the next
+        stepping entry regroups. Reasons (= the events that can change a
+        grouping signature or the assumptions the cached group
+        executables were built under): ``var_set`` (late declare /
+        graph growth), ``resize`` (population extent), ``shard`` (state
+        placement moved), ``map_growth`` (late map-field sync re-laid a
+        member's planes), ``restore`` (checkpoint row restore), and
+        ``mask_change`` (chaos/failure mask identity flipped — group
+        kernels are cached per mask-noneness, and the conservative rule
+        matches the frontier's own mask degrade). Recompiling is a
+        host-only grouping walk; executables for unchanged groups stay
+        warm in the kernel cache."""
+        if getattr(self, "_plan", None) is None:
+            return
+        self._plan = None
+        counter(
+            "plan_invalidation_total",
+            help="dispatch-plan invalidations by trigger",
+            reason=reason,
+        ).inc()
+
+    def _ensure_plan(self):
+        """The current :class:`~lasp_tpu.mesh.plan.DispatchPlan`, or
+        None when planning is off. Compiled lazily so invalidation is
+        free for runtimes that never step."""
+        if self.plan_mode == "off":
+            return None
+        if self._plan is None:
+            from .plan import compile_plan
+
+            self._plan = compile_plan(self)
+        return self._plan
 
     # -- mesh-side codec selection -------------------------------------------
     def _mesh_meta(self, var_id: str):
@@ -1101,6 +1157,9 @@ class ReplicatedRuntime:
         self.states[var.id] = CrdtMap.grow(var.spec, self.states[var.id])
         self._step = None
         self._fused_steps_cache.clear()
+        # the member's state-leaf shapes changed: its old signature (and
+        # any group built on it) is stale
+        self._invalidate_plan("map_growth")
 
     def _map_batch(self, var, states, ops):
         """Vectorized riak_dt_map batch with SEQUENTIAL, PER-OP-ATOMIC
@@ -1538,23 +1597,60 @@ class ReplicatedRuntime:
             return FlatORSet.pack(packed_specs[v], x) if v in packed_specs else x
 
         baked_neighbors = self.neighbors  # the table the offsets derive from
+        # dispatch plan: same-signature variables stack into [G, R, ...]
+        # super-tensors and ride ONE vmapped kernel per group per round
+        # (mesh.plan) — the traced program scales with GROUPS, not vars.
+        # Only multi-member groups stack; singletons keep the exact
+        # historical per-var path (no layout churn for the one-big-var
+        # populations the donation work optimized).
+        dispatch_plan = self._ensure_plan()
+        plan_groups = tuple(
+            g for g in (dispatch_plan.groups if dispatch_plan else ())
+            if len(g.var_ids) > 1
+        )
+        grouped_vars = frozenset(
+            v for g in plan_groups for v in g.var_ids
+        )
         part = self._partition
         part_rounds = None
+        part_group_rounds = None
         if part is not None:
-            from .shard_gossip import partitioned_gossip_round_fn
+            from .shard_gossip import (
+                partitioned_gossip_round_fn,
+                partitioned_gossip_round_grouped,
+            )
+
+            # one round builder per SIGNATURE, not per var: ungrouped
+            # members of one codec family share the closure
+            _by_sig: dict = {}
+
+            def _part_fn(v):
+                codec, spec = meta[v]
+                # unhashable spec: per-var closure (degrade)
+                key = signature_of(self, v) or v
+                if key not in _by_sig:
+                    _by_sig[key] = partitioned_gossip_round_fn(
+                        codec, spec, part["mesh"], part["plan"],
+                        axis=part["axis"], mode=part.get("mode", "gather"),
+                    )
+                return _by_sig[key]
 
             part_rounds = {
-                v: partitioned_gossip_round_fn(
-                    meta[v][0], meta[v][1], part["mesh"], part["plan"],
+                v: _part_fn(v) for v in self.var_ids if v not in grouped_vars
+            }
+            part_group_rounds = {
+                g.var_ids: partitioned_gossip_round_grouped(
+                    g.codec, g.spec, part["mesh"], part["plan"],
                     axis=part["axis"], mode=part.get("mode", "gather"),
                 )
-                for v in self.var_ids
+                for g in plan_groups
             }
 
         # tables is REQUIRED (no default): an old-signature 3-arg call must
         # fail loudly rather than zip-truncate every edge away silently
         def step(states, neighbors, edge_mask, tables):
-            if part_rounds is not None:
+            part_tables = None
+            if part is not None:
                 if edge_mask is not None:
                     # static (trace-time) check: the boundary exchange
                     # bakes its row plan; masked edges need the gather
@@ -1567,7 +1663,7 @@ class ReplicatedRuntime:
                 # entry; the prefix is the dataflow edges' tables
                 part_tables = tables[-1]
                 tables = tables[:-1]
-            if (offsets is not None or part_rounds is not None) and not isinstance(
+            if (offsets is not None or part is not None) and not isinstance(
                 neighbors, jax.core.Tracer
             ):
                 # shift offsets / the boundary-exchange plan are BAKED at
@@ -1624,10 +1720,46 @@ class ReplicatedRuntime:
                 swept = jax.vmap(local_round)(dict(states))
                 states = swept
             out = {}
-            residual_per_var = []
+            res_of = {}
+            # grouped dispatch: each multi-member plan group stacks its
+            # members' [R, ...] states into one [G, R, ...] super-tensor
+            # and runs ONE vmapped join+residual kernel — bit-identical
+            # per member to the per-var path below (vmap of a
+            # deterministic gather+join is the same computation batched;
+            # tests/mesh/test_plan.py pins it per codec/topology/mask)
+            for g in plan_groups:
+                stacked = stack_group([states[v] for v in g.var_ids])
+                if part is not None:
+                    new_g = part_group_rounds[g.var_ids](
+                        stacked, *part_tables
+                    )
+                elif offsets is not None:
+                    new_g = gossip_round_shift_grouped(
+                        g.codec, g.spec, stacked, offsets, edge_mask
+                    )
+                else:
+                    new_g = gossip_round_grouped(
+                        g.codec, g.spec, stacked, neighbors, edge_mask
+                    )
+                prev_g = stack_group([prev[v] for v in g.var_ids])
+                changed_g = jax.vmap(
+                    jax.vmap(
+                        lambda a, b, _c=g.codec, _s=g.spec: ~_c.equal(
+                            _s, a, b
+                        )
+                    )
+                )(prev_g, new_g)
+                res_g = jnp.sum(changed_g.astype(jnp.int32), axis=1)
+                for i, (v, member) in enumerate(
+                    zip(g.var_ids, unstack_group(new_g, len(g.var_ids)))
+                ):
+                    out[v] = member
+                    res_of[v] = res_g[i]
             for v in self.var_ids:
+                if v in grouped_vars:
+                    continue
                 codec, spec = meta[v]
-                if part_rounds is not None:
+                if part is not None:
                     # boundary exchange (shard(partition=True)): the only
                     # collective is an all-gather of the cut's rows;
                     # `neighbors` stays a traced arg but is unused here
@@ -1656,8 +1788,9 @@ class ReplicatedRuntime:
                         _spec, a, b
                     )
                 )(prev[v], new)
-                residual_per_var.append(jnp.sum(changed.astype(jnp.int32)))
+                res_of[v] = jnp.sum(changed.astype(jnp.int32))
                 out[v] = new
+            residual_per_var = [res_of[v] for v in self.var_ids]
             # PER-VAR residual vector (order = self.var_ids): the host
             # step() syncs it anyway (one transfer either way) and the
             # telemetry layer turns it into gossip_residual{var=...}
@@ -1818,6 +1951,32 @@ class ReplicatedRuntime:
                 )
                 for v in self.var_ids
             ],
+            # frontier-path gauges resolved ONCE (the per-round registry
+            # lookup per var was the dominant emission cost at hundreds
+            # of vars); "last" caches amortize per-var sets to the vars
+            # whose value actually moved — a gauge re-set to its own
+            # value is observably a no-op, so skipping it is safe
+            "frontier_rows": [
+                reg.gauge(
+                    "gossip_frontier_rows",
+                    help="dirty-replica frontier size after the last "
+                         "frontier round, per var",
+                    var=v,
+                )
+                for v in self.var_ids
+            ],
+            "frontier_last": [None] * len(self.var_ids),
+            "residual_last": [None] * len(self.var_ids),
+            "frontier_rounds": reg.counter(
+                "gossip_frontier_rounds_total",
+                help="frontier-scheduled gossip rounds executed",
+            ),
+            "plan_vars_per_dispatch": reg.gauge(
+                "gossip_plan_vars_per_dispatch",
+                help="mean variables served per stacked dispatch under "
+                     "the current plan (refreshed per planned frontier "
+                     "round)",
+            ),
             # the engine sweep inside each step re-evaluates every
             # edge's contribution once per round (same Jacobi accounting
             # as Graph.propagate's host loop): (counter, edges-of-kind)
@@ -1879,8 +2038,15 @@ class ReplicatedRuntime:
         tel = self._instruments()
         if tel is not None:
             res_list = res_vec.tolist()
-            for g, r in zip(tel["residual"], res_list):
-                g.set(int(r))
+            res_last = tel["residual_last"]
+            for i, (g, r) in enumerate(zip(tel["residual"], res_list)):
+                r = int(r)
+                g.set(r)
+                # keep the frontier path's skip-if-unchanged cache
+                # coherent: without this, a dense round's write followed
+                # by a frontier round reproducing the PRE-dense value
+                # would be skipped, exporting the stale dense residual
+                res_last[i] = r
             tel["round_seconds"].observe(elapsed)
             # the convergence observatory's hot feed: per-var residuals
             # into the global monitor, one coarse delivery event with
@@ -2126,6 +2292,10 @@ class ReplicatedRuntime:
             for v in list(self._frontier):
                 self._frontier_fill(v, True)
             self._frontier_mask_ref = edge_mask
+            # chaos/failure mask flipped: regroup conservatively (the
+            # plan's compiled group kernels key on mask-noneness, and a
+            # masked fixed point proves nothing about the new mask)
+            self._invalidate_plan("mask_change")
 
     def _frontier_fill(self, var_id: str, value: bool) -> None:
         """Set one frontier mask to all-``value``, reusing the existing
@@ -2184,8 +2354,13 @@ class ReplicatedRuntime:
         dispatch); a variable whose reachable set exceeds
         ``frontier_crossover * n_replicas`` falls back to the dense
         round for that variable (the sparse bookkeeping stops paying).
-        Returns the total number of (replica, variable) states changed —
-        the same residual contract as :meth:`step`, with bit-identical
+        Under the dispatch plan (``plan="auto"``, the default)
+        same-codec variables ride ONE stacked kernel per group per
+        round instead of one dispatch each — O(groups) host round
+        trips at hundreds of variables, bit-identical results
+        (``mesh.plan``, tests/mesh/test_plan.py). Returns the total
+        number of (replica, variable) states changed — the same
+        residual contract as :meth:`step`, with bit-identical
         per-round states (tests/mesh/test_frontier.py)."""
         reason = self._frontier_unsupported()
         if reason is not None:
@@ -2203,53 +2378,23 @@ class ReplicatedRuntime:
                 else 0
             )
             self._round_traffic = round_traffic_bytes(self._states, fan)
-        per_var_changed: list[int] = []
-        rows_touched = 0
-        skipped = 0
-        dense_falls = 0
+        plan = self._ensure_plan()
         with span("gossip.frontier_round", annotate=True):
             with Timer() as t:
-                for v in self.var_ids:
-                    f = self._frontier.get(v)
-                    if f is None or f.shape[0] != self.n_replicas:
-                        f = self._frontier[v] = np.ones(
-                            self.n_replicas, bool
+                if plan is None:
+                    stats = self._frontier_round_pervar(edge_mask)
+                else:
+                    with span(
+                        "gossip.plan_round", annotate=True,
+                        groups=len(plan.groups),
+                    ):
+                        stats = self._frontier_round_planned(
+                            plan, edge_mask
                         )
-                    if not f.any():
-                        skipped += 1
-                        per_var_changed.append(0)
-                        continue
-                    reach = frontier_reach(f, self._host_neighbors)
-                    if edge_mask is not None:
-                        # a dead edge delivers nothing: reachability
-                        # counts live fan-in only (matches the dense
-                        # round's own-state substitution)
-                        live = (
-                            np.asarray(f)[self._host_neighbors]
-                            & np.asarray(edge_mask, bool)
-                        )
-                        reach = live.any(axis=1)
-                    rows = np.flatnonzero(reach)
-                    if rows.size == 0:
-                        # dirty rows whose every out-edge is dead: they
-                        # can deliver nothing — retire them
-                        self._frontier[v] = np.zeros(self.n_replicas, bool)
-                        skipped += 1
-                        per_var_changed.append(0)
-                        continue
-                    if rows.size > self.frontier_crossover * self.n_replicas:
-                        changed_mask = self._frontier_dense_round(
-                            v, edge_mask
-                        )
-                        dense_falls += 1
-                        rows_touched += self.n_replicas
-                    else:
-                        changed_mask = self._frontier_sparse_round(
-                            v, rows, edge_mask
-                        )
-                        rows_touched += int(rows.size)
-                    self._frontier[v] = changed_mask
-                    per_var_changed.append(int(changed_mask.sum()))
+        per_var_changed = stats["per_var_changed"]
+        rows_touched = stats["rows_touched"]
+        skipped = stats["skipped"]
+        dense_falls = stats["dense_falls"]
         total = sum(per_var_changed)
         #: host-visible work accounting (the frontier_sparse bench derives
         #: its crossover autotune from this)
@@ -2259,9 +2404,270 @@ class ReplicatedRuntime:
         )
         self._emit_frontier_telemetry(
             per_var_changed, total, rows_touched, skipped, dense_falls,
-            t.elapsed,
+            t.elapsed, dispatches=stats.get("dispatches"),
         )
         return total
+
+    def _frontier_mask_of(self, var_id: str) -> np.ndarray:
+        """This var's frontier mask, (re)initialized all-dirty when
+        absent or stale-shaped — the conservative default."""
+        f = self._frontier.get(var_id)
+        if f is None or f.shape[0] != self.n_replicas:
+            f = self._frontier[var_id] = np.ones(self.n_replicas, bool)
+        return f
+
+    def _frontier_reach_rows(self, f: np.ndarray, edge_mask) -> np.ndarray:
+        """Row indices reachable from a frontier mask this round (live
+        fan-in only under ``edge_mask`` — a dead edge delivers nothing,
+        matching the dense round's own-state substitution)."""
+        if edge_mask is not None:
+            live = (
+                np.asarray(f)[self._host_neighbors]
+                & np.asarray(edge_mask, bool)
+            )
+            return np.flatnonzero(live.any(axis=1))
+        return np.flatnonzero(frontier_reach(f, self._host_neighbors))
+
+    def _frontier_round_onevar(self, v: str, edge_mask) -> tuple:
+        """ONE variable's frontier round — the shared body of the
+        per-var scheduler and the planned scheduler's singleton groups
+        (one implementation, so a crossover/retire rule change cannot
+        silently diverge the two). Returns ``(changed_count,
+        rows_touched, skipped, dense_falls, dispatches)``."""
+        f = self._frontier_mask_of(v)
+        if not f.any():
+            return 0, 0, 1, 0, 0
+        rows = self._frontier_reach_rows(f, edge_mask)
+        if rows.size == 0:
+            # dirty rows whose every out-edge is dead: they can deliver
+            # nothing — retire them
+            self._frontier[v] = np.zeros(self.n_replicas, bool)
+            return 0, 0, 1, 0, 0
+        if rows.size > self.frontier_crossover * self.n_replicas:
+            changed_mask = self._frontier_dense_round(v, edge_mask)
+            touched = self.n_replicas
+            dense = 1
+        else:
+            changed_mask = self._frontier_sparse_round(v, rows, edge_mask)
+            touched = int(rows.size)
+            dense = 0
+        self._frontier[v] = changed_mask
+        return int(changed_mask.sum()), touched, 0, dense, 1
+
+    def _frontier_round_pervar(self, edge_mask) -> dict:
+        """The historical one-dispatch-per-variable frontier round (the
+        bench's per-var arm; also the path when ``plan='off'``)."""
+        per_var_changed: list[int] = []
+        rows_touched = 0
+        skipped = 0
+        dense_falls = 0
+        dispatches = 0
+        for v in self.var_ids:
+            c, touched, sk, df, dp = self._frontier_round_onevar(
+                v, edge_mask
+            )
+            per_var_changed.append(c)
+            rows_touched += touched
+            skipped += sk
+            dense_falls += df
+            dispatches += dp
+        return {
+            "per_var_changed": per_var_changed,
+            "rows_touched": rows_touched,
+            "skipped": skipped,
+            "dense_falls": dense_falls,
+            "dispatches": dispatches,
+        }
+
+    def _frontier_round_planned(self, plan, edge_mask) -> dict:
+        """One frontier round under the dispatch plan: per GROUP, every
+        member's reachable rows ride ONE stacked kernel (members pad to
+        the group bucket with invalid slots; a quiescent member
+        contributes an empty row-mask and rides through bit-unchanged),
+        so host dispatches scale with active GROUPS, not active vars.
+        Per-member states/residuals are bit-identical to the per-var
+        round (tests/mesh/test_plan.py, tools/plan_smoke.py)."""
+        changed_of: dict = {}
+        rows_touched = 0
+        skipped = 0
+        dense_falls = 0
+        dispatches = 0
+        for group in plan.groups:
+            if len(group.var_ids) == 1:
+                # singletons keep the exact per-var round (one shared
+                # implementation — and its warm compiled-kernel cache)
+                v = group.var_ids[0]
+                c, touched, sk, df, dp = self._frontier_round_onevar(
+                    v, edge_mask
+                )
+                changed_of[v] = c
+                rows_touched += touched
+                skipped += sk
+                dense_falls += df
+                dispatches += dp
+                continue
+            # host half: each member's reachable row set
+            members: list = []  # (var_id, rows | None)
+            for v in group.var_ids:
+                f = self._frontier_mask_of(v)
+                if not f.any():
+                    skipped += 1
+                    changed_of[v] = 0
+                    members.append((v, None))
+                    continue
+                rows = self._frontier_reach_rows(f, edge_mask)
+                if rows.size == 0:
+                    self._frontier[v] = np.zeros(self.n_replicas, bool)
+                    skipped += 1
+                    changed_of[v] = 0
+                    members.append((v, None))
+                    continue
+                members.append((v, rows))
+            # only the ACTIVE members ride the stacked dispatches —
+            # quiescent/retired members are skipped outright (zero row
+            # work, exactly the per-var skip), not carried as dead
+            # weight; and the dense crossover is decided PER MEMBER
+            # (the per-var rule), so one hot all-dirty member promotes
+            # only itself to the dense arm instead of dragging every
+            # peer through an O(G x R) full-population round. Compiled
+            # kernels are keyed by SHAPE (codec, spec, subset size,
+            # bucket), not member identity, so shifting subsets reuse
+            # executables.
+            active = [(v, r) for v, r in members if r is not None]
+            if not active:
+                continue  # whole group quiescent: zero dispatches
+            thresh = self.frontier_crossover * self.n_replicas
+            dense_subset = [(v, r) for v, r in active if r.size > thresh]
+            sparse_subset = [(v, r) for v, r in active if r.size <= thresh]
+            if dense_subset:
+                changed = self._plan_dense_round(
+                    group, dense_subset, edge_mask
+                )
+                dense_falls += len(dense_subset)
+                dispatches += 1
+                rows_touched += self.n_replicas * len(dense_subset)
+                for i, (v, _rows) in enumerate(dense_subset):
+                    mask = np.array(changed[i])
+                    self._frontier[v] = mask
+                    changed_of[v] = int(mask.sum())
+            if sparse_subset:
+                max_rows = max(r.size for _v, r in sparse_subset)
+                bucket = max(self._frontier_bucket(max_rows), max_rows)
+                n_g = len(sparse_subset)
+                rows_mat = np.zeros((n_g, bucket), dtype=np.int64)
+                valid = np.zeros((n_g, bucket), dtype=bool)
+                for i, (_v, rows) in enumerate(sparse_subset):
+                    rows_mat[i, : rows.size] = rows
+                    rows_mat[i, rows.size:] = rows[0]
+                    valid[i, : rows.size] = True
+                    rows_touched += int(rows.size)
+                changed = self._plan_sparse_round(
+                    group, sparse_subset, rows_mat, valid, edge_mask
+                )
+                dispatches += 1
+                for i, (v, rows) in enumerate(sparse_subset):
+                    mask = np.zeros(self.n_replicas, dtype=bool)
+                    ch = np.asarray(changed[i])[: rows.size]
+                    mask[rows[ch]] = True
+                    self._frontier[v] = mask
+                    changed_of[v] = int(mask.sum())
+        return {
+            "per_var_changed": [changed_of.get(v, 0) for v in self.var_ids],
+            "rows_touched": rows_touched,
+            "skipped": skipped,
+            "dense_falls": dense_falls,
+            "dispatches": dispatches,
+        }
+
+    def _plan_sparse_round(self, group, active, rows_mat: np.ndarray,
+                           valid: np.ndarray, edge_mask) -> np.ndarray:
+        """Dispatch one group's stacked row-sparse round over its ACTIVE
+        members; returns ``changed: bool[G_active, F]`` (valid slots
+        that inflated). The executable is keyed by shape (signature,
+        member count, bucket), so it serves any same-sized active
+        subset of any group with this signature."""
+        var_ids = tuple(v for v, _r in active)
+        bucket = rows_mat.shape[1]
+        key = ("plan_sparse", group.codec, group.spec, len(active),
+               int(bucket), edge_mask is None)
+        fn = self._fused_steps_cache.get(key)
+        if fn is None:
+            codec, spec = group.codec, group.spec
+            n_g = len(active)
+
+            def sparse(states_tuple, neighbors, mask, row_idx, valid_):
+                stacked = stack_group(states_tuple)
+                new_g, changed = gossip_round_rows_grouped(
+                    codec, spec, stacked, neighbors, row_idx, valid_, mask
+                )
+                return unstack_group(new_g, n_g), changed
+
+            fn = jax.jit(sparse, donate_argnums=self._frontier_donate())
+            self._fused_steps_cache[key] = fn
+        outs, changed = self._run_plan_fn(
+            var_ids, fn, edge_mask,
+            jnp.asarray(rows_mat), jnp.asarray(valid),
+        )
+        for i, v in enumerate(var_ids):
+            self.states[v] = outs[i]
+        return np.asarray(changed)
+
+    def _plan_dense_round(self, group, active, edge_mask) -> np.ndarray:
+        """Dense crossover arm for one GROUP's active members: the
+        full-population round vmapped over the stacked members, plus
+        per-member per-row change vectors (what the frontiers need to
+        stay row-accurate)."""
+        var_ids = tuple(v for v, _r in active)
+        key = ("plan_dense", group.codec, group.spec, len(active),
+               edge_mask is None)
+        fn = self._fused_steps_cache.get(key)
+        if fn is None:
+            codec, spec = group.codec, group.spec
+            n_g = len(active)
+            offsets = self._shift_offsets
+
+            def dense(states_tuple, neighbors, mask):
+                stacked = stack_group(states_tuple)
+                if offsets is not None:
+                    new_g = gossip_round_shift_grouped(
+                        codec, spec, stacked, offsets, mask
+                    )
+                else:
+                    new_g = gossip_round_grouped(
+                        codec, spec, stacked, neighbors, mask
+                    )
+                changed = jax.vmap(
+                    jax.vmap(lambda a, b: ~codec.equal(spec, a, b))
+                )(stacked, new_g)
+                return unstack_group(new_g, n_g), changed
+
+            fn = jax.jit(dense, donate_argnums=self._frontier_donate())
+            self._fused_steps_cache[key] = fn
+        outs, changed = self._run_plan_fn(var_ids, fn, edge_mask)
+        for i, v in enumerate(var_ids):
+            self.states[v] = outs[i]
+        # np.array (copy): the per-member rows become frontier masks that
+        # _frontier_fill later mutates in place (the PR4 read-only-view
+        # lesson)
+        return np.array(changed)
+
+    def _run_plan_fn(self, var_ids, fn, edge_mask, *extra):
+        """Group twin of :meth:`_run_frontier_fn`: dispatch + sync inside
+        the poison guard over ALL member populations (donated buffers
+        die together on a failed dispatch)."""
+        states_in = tuple(self.states[v] for v in var_ids)
+        try:
+            outs, changed = fn(states_in, self.neighbors, edge_mask, *extra)
+            jax.block_until_ready(changed)  # device sync: errors land here
+            return outs, changed
+        except Exception as exc:
+            if self._frontier_donate() and any(
+                getattr(leaf, "is_deleted", lambda: False)()
+                for state in states_in
+                for leaf in jax.tree_util.tree_leaves(state)
+            ):
+                self._poisoned = f"{type(exc).__name__}: {str(exc)[:200]}"
+            raise
 
     #: sparse-round row buckets are padded to powers of two (floor 16) so
     #: one compiled kernel serves a band of frontier sizes instead of one
@@ -2285,10 +2691,12 @@ class ReplicatedRuntime:
         else:
             padded = np.full(bucket, rows[0], dtype=np.int64)
             padded[: rows.size] = rows
-        key = ("frontier", var_id, int(bucket), edge_mask is None)
+        codec, spec = self._mesh_meta(var_id)
+        # same-codec vars share the executable (per-var on unhashable)
+        sig = signature_of(self, var_id) or var_id
+        key = ("frontier", sig, int(bucket), edge_mask is None)
         fn = self._fused_steps_cache.get(key)
         if fn is None:
-            codec, spec = self._mesh_meta(var_id)
 
             def sparse(states_v, neighbors, mask, row_idx):
                 return gossip_round_rows(
@@ -2311,10 +2719,12 @@ class ReplicatedRuntime:
         the full-population round plus a per-row change vector (exactly
         what the frontier needs to stay row-accurate through the dense
         fallback)."""
-        key = ("frontier_dense", var_id, edge_mask is None)
+        codec, spec = self._mesh_meta(var_id)
+        # same-codec vars share the executable (per-var on unhashable)
+        sig = signature_of(self, var_id) or var_id
+        key = ("frontier_dense", sig, edge_mask is None)
         fn = self._fused_steps_cache.get(key)
         if fn is None:
-            codec, spec = self._mesh_meta(var_id)
             offsets = self._shift_offsets
 
             def dense(states_v, neighbors, mask, _rows):
@@ -2380,11 +2790,16 @@ class ReplicatedRuntime:
 
     def _emit_frontier_telemetry(self, per_var_changed, total: int,
                                  rows_touched: int, skipped: int,
-                                 dense_falls: int, elapsed: float) -> None:
+                                 dense_falls: int, elapsed: float,
+                                 dispatches: "int | None" = None) -> None:
         """The frontier round's host-side emission — the frontier twin of
         :meth:`_emit_step_telemetry`: the trace row and monitor feed are
         identical (same residual contract), bytes scale with the rows
-        actually gathered, and the frontier gauges/events ride on top."""
+        actually gathered, and the frontier gauges/events ride on top.
+        Per-var gauge sets are amortized (instruments pre-resolved, a
+        value equal to the last set is skipped) so emission stays under
+        the 5% budget even at hundreds of variables per grouped
+        dispatch (telemetry.overhead measures exactly this path)."""
         self.trace.record_round(total, elapsed)
         tel = self._instruments()
         if tel is not None:
@@ -2393,29 +2808,40 @@ class ReplicatedRuntime:
             tel["bytes"].inc(int(self._round_traffic * frac))
             for c, edges_of_kind in tel["edge_recomputes"]:
                 c.inc(edges_of_kind)
-            counter(
-                "gossip_frontier_rounds_total",
-                help="frontier-scheduled gossip rounds executed",
-            ).inc()
+            tel["frontier_rounds"].inc()
             if dense_falls:
                 counter(
                     "gossip_frontier_dense_fallbacks_total",
                     help="per-var dense rounds taken because the frontier "
                          "density crossed frontier_crossover",
                 ).inc(dense_falls)
-            from ..telemetry import gauge
-
             mon = get_monitor()
-            for v, g, c in zip(self.var_ids, tel["residual"],
-                               per_var_changed):
-                g.set(int(c))
-                gauge(
-                    "gossip_frontier_rows",
-                    help="dirty-replica frontier size after the last "
-                         "frontier round, per var",
-                    var=v,
-                ).set(int(self._frontier[v].sum()))
+            res_last = tel["residual_last"]
+            f_last = tel["frontier_last"]
+            for i, c in enumerate(per_var_changed):
+                c = int(c)
+                if res_last[i] != c:
+                    tel["residual"][i].set(c)
+                    res_last[i] = c
+                # the post-round frontier mask IS the round's changed
+                # mask (both schedulers assign it from `changed`), so
+                # its size equals the residual — re-summing 2x per var
+                # per round was the dominant emission cost at hundreds
+                # of vars
+                if f_last[i] != c:
+                    tel["frontier_rows"][i].set(c)
+                    f_last[i] = c
+            if dispatches and self._plan is not None:
+                # a PLAN metric: per-var (plan="off") rounds also count
+                # dispatches but must not export a ~1.0 series that
+                # reads as "degenerate plan active"
+                tel["plan_vars_per_dispatch"].set(
+                    round(
+                        (len(self.var_ids) - skipped) / dispatches, 3
+                    )
+                )
             if self._frontier_shards and self.var_ids:
+                from ..telemetry import gauge
                 from .shard_gossip import shard_frontier_counts
 
                 union = np.zeros(self.n_replicas, bool)
@@ -2434,10 +2860,9 @@ class ReplicatedRuntime:
             mon.observe_round(
                 self.var_ids, per_var_changed, elapsed, self.n_replicas
             )
-            mon.observe_frontier(
-                self.var_ids,
-                [int(self._frontier[v].sum()) for v in self.var_ids],
-            )
+            # frontier sizes == this round's changed counts (see the
+            # gauge loop above): no per-var re-sum
+            mon.observe_frontier(self.var_ids, per_var_changed)
             tel_events.set_round(mon.round)
             tel_events.emit(
                 "delivery",
@@ -3169,6 +3594,10 @@ class ReplicatedRuntime:
         # re-deliver to the reseeded row even if quiescent): all-dirty,
         # the same conservative degrade resize and checkpoint restore use
         self.mark_dirty()
+        # checkpoint-row restore invalidates the plan too (the grouping
+        # is unchanged in practice, but the recompile-or-degrade rule is
+        # uniform across every state-surgery event — the walk is cheap)
+        self._invalidate_plan("restore")
 
     # -- elastic membership ---------------------------------------------------
     def resize(self, new_n: int, new_neighbors, graceful: bool = True) -> None:
@@ -3271,6 +3700,8 @@ class ReplicatedRuntime:
                     self._actor_sites[key] = 0 if graceful else -1
         self._step = None
         self._fused_steps_cache.clear()
+        # the replica extent is part of every grouping signature
+        self._invalidate_plan("resize")
 
     # -- sharding -------------------------------------------------------------
     def shard(
@@ -3380,6 +3811,9 @@ class ReplicatedRuntime:
         self._frontier_shards = axis_extent(mesh, part_axis)
         self._step = None
         self._fused_steps_cache.clear()
+        # states moved placement (and partition mode may have flipped the
+        # gossip path the groups bake): regroup
+        self._invalidate_plan("shard")
 
     def _plan_partition(self, mesh, axis):
         """Validate + build the boundary-exchange plan (pure: no runtime

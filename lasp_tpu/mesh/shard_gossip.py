@@ -371,6 +371,90 @@ def partitioned_gossip_round_fn(codec, spec, mesh: Mesh, plan: dict,
     )
 
 
+def partitioned_gossip_round_grouped(codec, spec, mesh: Mesh, plan: dict,
+                                     axis="replicas",
+                                     mode: str = "gather"):
+    """Grouped (megabatch) twin of :func:`partitioned_gossip_round_fn`:
+    ``(states, send_tbl, idx_tbl) -> states`` where state leaves carry a
+    LEADING GROUP AXIS ``[G, R, ...]`` — a dispatch-plan group's stacked
+    same-codec variables (``mesh.plan``). The boundary exchange then
+    moves all G members' cut rows in ONE collective per leaf (the
+    ``all_gather``/``all_to_all`` payload gains a group axis instead of
+    being issued once per variable) — the megabatch wire win on top of
+    the cut-not-population win. Per-member results are bit-identical to
+    the ungrouped round (tests/mesh/test_plan.py).
+
+    Sharding: states ride ``P(None, axis)`` (group axis replicated, the
+    replica axis block-sharded exactly as the ungrouped path)."""
+    if plan["n_shards"] != axis_extent(mesh, axis):
+        raise ValueError(
+            f"plan was built for {plan['n_shards']} shards but mesh axis "
+            f"{axis!r} has {axis_extent(mesh, axis)} devices — rebuild "
+            "the plan"
+        )
+    if mode not in ("gather", "alltoall"):
+        raise ValueError(f"unknown partitioned gossip mode {mode!r}")
+    from .gossip import _leafwise_op
+
+    # double-vmapped merge: [G, B] leading axes
+    vmerge = jax.vmap(jax.vmap(lambda a, b: codec.merge(spec, a, b)))
+    leaf_op = _leafwise_op(codec)
+    k_cols = plan["idx"].shape[1]
+    alltoall = mode == "alltoall"
+
+    def local(block, send_tbl, idx):
+        # block leaves: [G, B, ...] (B = per-device replica block)
+        if alltoall:
+            send = send_tbl[0]  # [1, S, M2] shard slice -> [S, M2]
+            flat = send.reshape(-1)
+            contrib = jax.tree_util.tree_map(
+                lambda x: x[:, flat].reshape(
+                    (x.shape[0],) + send.shape + x.shape[2:]
+                ),
+                block,
+            )  # [G, S, M2, ...]
+            recv = jax.tree_util.tree_map(
+                lambda c: jax.lax.all_to_all(
+                    c, axis, split_axis=1, concat_axis=1, tiled=False
+                ),
+                contrib,
+            )  # [G, S, M2, ...]: slice s = what owner s sent to ME
+        else:
+            send = send_tbl[0]  # [1, M] shard slice -> [M]
+            contrib = jax.tree_util.tree_map(lambda x: x[:, send], block)
+            recv = jax.tree_util.tree_map(
+                lambda x: jnp.moveaxis(jax.lax.all_gather(x, axis), 0, 1),
+                contrib,
+            )  # [G, S, M, ...] per leaf
+        full = jax.tree_util.tree_map(
+            lambda b, g: jnp.concatenate(
+                [b, g.reshape((g.shape[0], -1) + g.shape[3:])], axis=1
+            ),
+            block, recv,
+        )
+        if leaf_op is not None:
+
+            def leaf(b, f):
+                acc = b
+                for k in range(k_cols):
+                    acc = leaf_op(acc, f[:, idx[:, k]])
+                return acc
+
+            return jax.tree_util.tree_map(leaf, block, full)
+        acc = block
+        for k in range(k_cols):
+            nbr = jax.tree_util.tree_map(lambda f: f[:, idx[:, k]], full)
+            acc = vmerge(acc, nbr)
+        return acc
+
+    tbl_spec = P(axis, None, None) if alltoall else P(axis, None)
+    return _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, axis), tbl_spec, P(axis, None)),
+        out_specs=P(None, axis), **_SM_NOCHECK,
+    )
+
+
 def shard_frontier_counts(frontier, n_shards: int):
     """``int64[S]``: dirty-replica frontier rows per contiguous shard
     block (the block sharding every ``rt.shard`` layout uses). Feeds the

@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..mesh.gossip import _tree_where, gossip_round
+from ..mesh.gossip import _tree_where, gossip_round, gossip_round_grouped
 
 
 def fused_gossip_rounds(codec, spec, states, neighbors, n_rounds: int, edge_mask=None):
@@ -88,6 +88,61 @@ def fused_chaos_rounds(codec, spec, states, neighbors, masks):
 
     return jax.lax.fori_loop(
         0, n_rounds, body, (states, jnp.zeros((n_rounds,), jnp.int32))
+    )
+
+
+def fused_gossip_rounds_grouped(
+    codec, spec, states, neighbors, n_rounds: int, edge_mask=None
+):
+    """Grouped (megabatch) member of the fused family: ``states`` leaves
+    are ``[G, R, ...]`` — a dispatch-plan group's stacked same-codec
+    variables (``mesh.plan``) — and ``n_rounds`` rounds run vmapped over
+    the group axis inside ONE ``lax.fori_loop`` dispatch. Returns
+    ``(new_states, changed: bool[G])``, the per-member block residual
+    (which members the block changed at all) — the grouped twin of
+    :func:`fused_gossip_rounds`'s scalar. Bit-identical per member to
+    running :func:`fused_gossip_rounds` on each variable alone
+    (tests/mesh/test_plan.py)."""
+
+    def body(_, s):
+        return gossip_round_grouped(codec, spec, s, neighbors, edge_mask)
+
+    out = jax.lax.fori_loop(0, n_rounds, body, states)
+    eq = jax.vmap(
+        jax.vmap(lambda a, b: codec.equal(spec, a, b))
+    )(states, out)
+    return out, ~jnp.all(eq, axis=1)
+
+
+def fused_chaos_rounds_grouped(codec, spec, states, neighbors, masks):
+    """Grouped twin of :func:`fused_chaos_rounds`: one chaos WINDOW
+    (``masks: bool[T, R, K]``, one edge-alive mask per round) over one
+    dispatch-plan GROUP (``states`` leaves ``[G, R, ...]``) in a single
+    ``lax.fori_loop`` dispatch — the stacked-mask × stacked-variable
+    composition. The mask stack rides as a traced operand exactly as in
+    the per-var kernel; the group axis batches the masked joins, so
+    per-round per-member states are bit-identical to per-var stepping
+    (tests/mesh/test_plan.py pins it against
+    :func:`fused_chaos_rounds`).
+
+    Returns ``(new_states, residuals: int32[T, G])`` — replica rows each
+    round changed, per member: the same residual contract as the engine
+    step, scattered back per variable by the caller."""
+    masks = jnp.asarray(masks)
+    n_rounds = masks.shape[0]
+    n_group = jax.tree_util.tree_leaves(states)[0].shape[0]
+
+    def body(i, carry):
+        s, res = carry
+        new = gossip_round_grouped(codec, spec, s, neighbors, masks[i])
+        changed = jax.vmap(
+            jax.vmap(lambda a, b: ~codec.equal(spec, a, b))
+        )(s, new)
+        return new, res.at[i].set(jnp.sum(changed.astype(jnp.int32), axis=1))
+
+    return jax.lax.fori_loop(
+        0, n_rounds, body,
+        (states, jnp.zeros((n_rounds, n_group), jnp.int32)),
     )
 
 

@@ -112,7 +112,23 @@ class RiakIndexProgram(Program):
                 self._create_views(session, specs)
         elif reason == "delete":
             self._remove_entries_for_key(session, obj.key, actor)
-        # handoff: deliberate no-op (:105-107)
+        elif reason == "handoff":
+            # deliberate no-op, matching the reference (:105-107 is a
+            # TODO there too): handoff notifications re-describe objects
+            # whose index entries the put path already owns — replaying
+            # them here would mint duplicate tokens under the receiving
+            # vnode's actor. Explicit branch so the notification is
+            # ACKNOWLEDGED rather than silently falling through with
+            # every other unknown reason.
+            pass
+        else:
+            # an unrecognized reason is a caller bug (a misspelled verb
+            # would otherwise drop the notification silently — an index
+            # that quietly misses writes is worse than a crash)
+            raise NotImplementedError(
+                f"{self.name}: unsupported object-event reason {reason!r} "
+                "(expected 'put', 'delete', or 'handoff')"
+            )
 
     # -- results -------------------------------------------------------------
     def execute(self, session):

@@ -114,22 +114,43 @@ class ConvergenceMonitor:
             if n_replicas:
                 self.n_replicas = int(n_replicas)
             total = 0
+            rnd = self.round
+            vars_ = self.vars
             for v, r in zip(var_ids, residuals):
                 r = int(r)
                 total += r
-                ent = self.vars.get(v)
+                ent = vars_.get(v)
                 if ent is None:
-                    ent = self.vars[v] = {
+                    ent = vars_[v] = {
                         "residual": 0, "last_change_round": 0,
                         "total_changes": 0,
                     }
-                ent["residual"] = r
                 if r:
-                    ent["last_change_round"] = self.round
+                    ent["residual"] = r
+                    ent["last_change_round"] = rnd
                     ent["total_changes"] += r
+                elif ent["residual"]:
+                    # steady state (most vars quiescent most rounds): a
+                    # 0 -> 0 transition writes nothing — the hot-feed
+                    # cost then scales with CHANGED vars, not vars
+                    ent["residual"] = 0
             self.residual_curve.append((self.round, total))
             del self.residual_curve[: -self.history]
-            self._set_gauges()
+            # amortized gauge sweep: per-var staleness is a SAMPLED
+            # surface (scrapes are seconds apart, rounds are ms apart),
+            # so sweeping every var's gauge every round pays O(vars)
+            # for values no scrape will ever see. Sweep when the var
+            # census changes (fresh series must exist), at quiescence
+            # (the moment exact staleness matters), and every 8th round
+            # otherwise — gauges are then at most 8 rounds stale, the
+            # monitor's own dict state (snapshot/health) stays exact.
+            if (
+                total == 0
+                or self.round % 8 == 0
+                or self._tel is None
+                or self._tel["vars"] != tuple(self.vars)
+            ):
+                self._set_gauges()
 
     def observe_opaque_rounds(self, n: int,
                               quiescent: "bool | None" = None) -> None:
@@ -157,8 +178,11 @@ class ConvergenceMonitor:
         rows CHANGED, the frontier says how many can still change."""
         with self._lock:
             self._check_generation()
+            frontier = self.frontier
             for v, n in zip(var_ids, sizes):
-                self.frontier[v] = int(n)
+                n = int(n)
+                if frontier.get(v) != n:  # skip the quiescent majority
+                    frontier[v] = n
 
     def observe_chaos(self, **report) -> None:
         """Fold a chaos soak's outcome into the health surface — the

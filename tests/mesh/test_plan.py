@@ -1,0 +1,508 @@
+"""Dispatch-plan (megabatch gossip) property tier: grouping same-codec
+variables into stacked ``[G, R, ...]`` kernels must be BIT-IDENTICAL to
+per-var stepping — same per-round states, residual sequences, and
+frontier masks — across codecs (leafwise / vclock / packed), dense and
+frontier schedulers, ring/random topologies, and chaos edge masks
+(ISSUE-5 acceptance). Plus the plan-cache lifecycle: resize, checkpoint
+restore, chaos mask flips, and late-declared map fields must each force
+a recompile (plan invalidation) rather than stepping a stale grouping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.lattice import GSet, GSetSpec
+from lasp_tpu.lattice.base import replicate
+from lasp_tpu.mesh import ReplicatedRuntime, random_regular, ring
+from lasp_tpu.mesh.gossip import (
+    gossip_round,
+    gossip_round_grouped,
+    gossip_round_rows,
+    gossip_round_rows_grouped,
+)
+from lasp_tpu.mesh.plan import compile_plan
+from lasp_tpu.mesh.topology import edge_failure_mask
+from lasp_tpu.ops.fused import (
+    fused_chaos_rounds,
+    fused_chaos_rounds_grouped,
+    fused_gossip_rounds,
+    fused_gossip_rounds_grouped,
+)
+from lasp_tpu.store import Store
+from lasp_tpu.telemetry import registry as tel_registry
+
+
+def _tree_eq(a, b) -> bool:
+    flags = jax.tree_util.tree_map(
+        lambda x, y: bool(jnp.array_equal(x, y)), a, b
+    )
+    return all(jax.tree_util.tree_leaves(flags))
+
+
+def _seed_mixed(rt, ids, n, seed=7, writes=4):
+    rng = np.random.RandomState(seed)
+    for v in ids:
+        rows = rng.choice(n, writes, replace=False)
+        tn = rt.store.variable(v).type_name
+        if tn == "lasp_gset":
+            rt.update_batch(
+                v, [(int(r), ("add", f"e{r % 4}"), f"a{r}") for r in rows]
+            )
+        elif tn == "riak_dt_gcounter":
+            rt.update_batch(
+                v,
+                [(int(r), ("increment",), ("lane", int(r) % 4))
+                 for r in rows],
+            )
+        elif tn in ("lasp_orset", "lasp_orset_gbtree"):
+            rt.update_batch(
+                v, [(int(r), ("add", f"t{r % 6}"), f"w{r % 4}")
+                    for r in rows]
+            )
+        else:  # riak_dt_orswot
+            rt.update_batch(
+                v, [(int(r), ("add", f"x{r % 8}"), f"w{r % 4}")
+                    for r in rows]
+            )
+
+
+def _build_mixed(plan, n, nbrs, packed=False):
+    store = Store(n_actors=4)
+    ids = [store.declare(id=f"g{i}", type="lasp_gset", n_elems=16)
+           for i in range(3)]
+    ids += [store.declare(id=f"c{i}", type="riak_dt_gcounter", n_actors=4)
+            for i in range(2)]
+    ids += [store.declare(id=f"o{i}", type="riak_dt_orswot", n_elems=8,
+                          n_actors=4)
+            for i in range(2)]
+    ids += [store.declare(id=f"s{i}", type="lasp_orset", n_elems=8,
+                          n_actors=4, tokens_per_actor=2)
+            for i in range(2)]
+    rt = ReplicatedRuntime(store, Graph(store), n, nbrs, packed=packed,
+                           plan=plan)
+    _seed_mixed(rt, ids, n)
+    return rt, ids
+
+
+# -- grouping ---------------------------------------------------------------
+
+def test_plan_groups_by_signature():
+    n = 32
+    rt, _ids = _build_mixed("auto", n, random_regular(n, 3, seed=5))
+    plan = rt._ensure_plan()
+    sizes = sorted(len(g) for g in plan.groups)
+    # 4 signatures: gset x3, gcounter x2, orswot x2, orset x2
+    assert sizes == [2, 2, 2, 3]
+    assert plan.n_vars == 9
+    for g in plan.groups:
+        metas = {rt._mesh_meta(v) for v in g.var_ids}
+        assert len(metas) == 1  # every member shares (codec, spec)
+
+
+def test_plan_groups_split_on_spec_mismatch():
+    n = 16
+    store = Store(n_actors=4)
+    store.declare(id="a", type="lasp_gset", n_elems=16)
+    store.declare(id="b", type="lasp_gset", n_elems=16)
+    store.declare(id="w", type="lasp_gset", n_elems=32)  # different shape
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, 2))
+    plan = rt._ensure_plan()
+    assert sorted(len(g) for g in plan.groups) == [1, 2]
+
+
+def test_plan_groups_packed_mode_by_wire_spec():
+    # packed OR-Sets group by their FlatORSetSpec (the wire format the
+    # mesh actually steps), not the dense spec
+    n = 16
+    store = Store(n_actors=4)
+    for i in range(3):
+        store.declare(id=f"p{i}", type="lasp_orset", n_elems=8,
+                      n_actors=4, tokens_per_actor=2)
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, 2), packed=True)
+    plan = rt._ensure_plan()
+    assert [len(g) for g in plan.groups] == [3]
+    from lasp_tpu.ops.flatpack import FlatORSet
+
+    assert plan.groups[0].codec is FlatORSet
+
+
+# -- bit-identity: planned vs per-var ---------------------------------------
+
+@pytest.mark.parametrize("topo", ["random", "ring"])
+@pytest.mark.parametrize("scheduler", ["frontier", "dense"])
+def test_planned_bitidentical_to_pervar(topo, scheduler):
+    n = 64
+    nbrs = (random_regular(n, 3, seed=11) if topo == "random"
+            else ring(n, 2))
+    rt_p, ids = _build_mixed("auto", n, nbrs)
+    rt_o, _ = _build_mixed("off", n, nbrs)
+    verb = "frontier_step" if scheduler == "frontier" else "step"
+    for rnd in range(64):
+        rp, ro = getattr(rt_p, verb)(), getattr(rt_o, verb)()
+        assert rp == ro, (rnd, rp, ro)
+        for v in ids:
+            assert _tree_eq(rt_p.states[v], rt_o.states[v]), (rnd, v)
+            if scheduler == "frontier":
+                assert (rt_p._frontier[v] == rt_o._frontier[v]).all(), (
+                    rnd, v,
+                )
+        if ro == 0:
+            break
+    assert ro == 0, "no convergence within 64 rounds"
+
+
+def test_planned_bitidentical_under_edge_mask():
+    n = 48
+    nbrs = random_regular(n, 3, seed=13)
+    mask = edge_failure_mask(n, 3, 0.3, seed=3, neighbors=nbrs)
+    rt_p, ids = _build_mixed("auto", n, nbrs)
+    rt_o, _ = _build_mixed("off", n, nbrs)
+    for rnd in range(64):
+        rp, ro = rt_p.frontier_step(mask), rt_o.frontier_step(mask)
+        assert rp == ro, (rnd, rp, ro)
+        for v in ids:
+            assert _tree_eq(rt_p.states[v], rt_o.states[v]), (rnd, v)
+        if ro == 0:
+            break
+    assert ro == 0  # the MASKED fixed point
+
+
+def test_planned_bitidentical_packed():
+    n = 48
+    nbrs = random_regular(n, 3, seed=17)
+    store_kw = dict(type="lasp_orset", n_elems=8, n_actors=4,
+                    tokens_per_actor=2)
+
+    def build(plan):
+        store = Store(n_actors=4)
+        ids = [store.declare(id=f"p{i}", **store_kw) for i in range(4)]
+        rt = ReplicatedRuntime(store, Graph(store), n, nbrs, packed=True,
+                               plan=plan)
+        _seed_mixed(rt, ids, n)
+        return rt, ids
+
+    rt_p, ids = build("auto")
+    rt_o, _ = build("off")
+    for rnd in range(64):
+        rp, ro = rt_p.frontier_step(), rt_o.frontier_step()
+        assert rp == ro
+        for v in ids:
+            assert _tree_eq(rt_p.states[v], rt_o.states[v]), (rnd, v)
+        if ro == 0:
+            break
+    assert ro == 0
+
+
+def test_quiescent_member_rides_group_as_empty_rowmask():
+    # one member of a group is quiescent while its peers are dirty: the
+    # group dispatch must leave it bit-untouched with an EMPTY frontier
+    # (not degrade it dense, not re-dirty it)
+    n = 32
+    nbrs = random_regular(n, 3, seed=23)
+    store = Store(n_actors=4)
+    hot = store.declare(id="hot", type="lasp_gset", n_elems=16)
+    cold = store.declare(id="cold", type="lasp_gset", n_elems=16)
+    rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+    rt.update_batch(hot, [(0, ("add", "h"), "a0")])
+    assert rt.frontier_size(cold) == 0
+    before = jax.tree_util.tree_map(np.asarray, rt.states[cold])
+    assert rt.frontier_step() > 0  # the hot member spread
+    assert _tree_eq(rt.states[cold], before)
+    assert rt.frontier_size(cold) == 0
+
+
+# -- plan-cache invalidation -------------------------------------------------
+
+def _invalidations(reason: str) -> int:
+    snap = tel_registry.get_registry().snapshot().get(
+        "plan_invalidation_total", {"series": []}
+    )
+    return sum(
+        s["value"] for s in snap["series"]
+        if s["labels"].get("reason") == reason
+    )
+
+
+def test_plan_invalidated_on_resize():
+    n = 24
+    rt, ids = _build_mixed("auto", n, random_regular(n, 3, seed=5))
+    rt.run_to_convergence(mode="frontier", max_rounds=64)
+    plan0 = rt._plan
+    assert plan0 is not None
+    before = _invalidations("resize")
+    rt.resize(n + 8, random_regular(n + 8, 3, seed=6))
+    assert rt._plan is None  # stale grouping dropped
+    assert _invalidations("resize") == before + 1
+    plan1 = rt._ensure_plan()
+    assert plan1 is not plan0
+    assert plan1.n_replicas == n + 8
+    assert rt.run_to_convergence(mode="frontier", max_rounds=64) >= 1
+
+
+def test_plan_invalidated_on_checkpoint_row_restore(tmp_path):
+    from lasp_tpu.store import checkpoint
+
+    n = 16
+    rt, ids = _build_mixed("auto", n, ring(n, 2))
+    rt.run_to_convergence(mode="frontier", max_rounds=64)
+    path = str(tmp_path / "rt.ckpt")
+    checkpoint.save_runtime(rt, path)
+    rows = checkpoint.load_runtime_rows(path, 3)
+    assert rt._plan is not None
+    before = _invalidations("restore")
+    rt.reseed_row(3, rows)
+    assert rt._plan is None
+    assert _invalidations("restore") == before + 1
+    # recompile-or-degrade: stepping after the restore regroups and the
+    # restored row re-converges with its peers
+    assert rt.run_to_convergence(mode="frontier", max_rounds=64) >= 1
+    assert all(rt.divergence(v) == 0 for v in ids)
+
+
+def test_plan_invalidated_on_chaos_mask_flip():
+    n = 24
+    nbrs = random_regular(n, 3, seed=5)
+    rt, ids = _build_mixed("auto", n, nbrs)
+    rt.frontier_step()  # compiles the unmasked plan kernels
+    assert rt._plan is not None
+    mask = edge_failure_mask(n, 3, 0.25, seed=1, neighbors=nbrs)
+    before = _invalidations("mask_change")
+    rt.frontier_step(mask)
+    assert _invalidations("mask_change") == before + 1
+    # the flip also degraded every frontier (the PR3 mask rule) and the
+    # next call recompiled a plan for the masked regime
+    assert rt._plan is not None
+
+
+def test_plan_invalidated_on_late_map_field():
+    n = 16
+    store = Store(n_actors=4)
+    store.declare(id="m1", type="riak_dt_map", n_actors=4)
+    store.declare(id="m2", type="riak_dt_map", n_actors=4)
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, 2))
+    # seed both maps with the SAME first field so their specs (and plan
+    # signatures) agree
+    key = ("S", "lasp_gset")
+    rt.update_at(0, "m1", ("update", [("update", key, ("add", "a"))]), "w")
+    rt.update_at(0, "m2", ("update", [("update", key, ("add", "a"))]), "w")
+    plan0 = rt._ensure_plan()
+    assert [len(g) for g in plan0.groups] == [2]  # identical map specs
+    before = _invalidations("map_growth")
+    # admit a NEW field on m1 only: its spec (and state planes) grow, so
+    # the old two-member group is stale — the plan must recompile and
+    # split them
+    key2 = ("C", "riak_dt_gcounter")
+    rt.update_at(
+        0, "m1", ("update", [("update", key2, ("increment",))]), "w2"
+    )
+    assert _invalidations("map_growth") >= before + 1
+    plan1 = rt._ensure_plan()
+    assert plan1 is not plan0
+    assert sorted(len(g) for g in plan1.groups) == [1, 1]
+    assert rt.run_to_convergence(max_rounds=64) >= 1
+    assert rt.divergence("m1") == 0 and rt.divergence("m2") == 0
+
+
+# -- grouped kernels (codec level) ------------------------------------------
+
+def _stacked_gset(n, g=3, seed=3):
+    spec = GSetSpec(n_elems=16)
+    rng = np.random.RandomState(seed)
+    states = []
+    for _ in range(g):
+        st = replicate(GSet.new(spec), n)
+        rows = rng.choice(n, 4, replace=False)
+        st = st._replace(
+            mask=st.mask.at[jnp.asarray(rows),
+                            jnp.asarray(rows % 16)].set(True)
+        )
+        states.append(st)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+    return spec, states, stacked
+
+
+def test_gossip_round_grouped_matches_pervar():
+    n = 32
+    nbrs = jnp.asarray(random_regular(n, 3, seed=9))
+    spec, states, stacked = _stacked_gset(n)
+    out = gossip_round_grouped(GSet, spec, stacked, nbrs)
+    for i, st in enumerate(states):
+        ref = gossip_round(GSet, spec, st, nbrs)
+        assert _tree_eq(jax.tree_util.tree_map(lambda x: x[i], out), ref)
+
+
+def test_gossip_round_rows_grouped_valid_mask():
+    n = 32
+    nbrs = jnp.asarray(random_regular(n, 3, seed=9))
+    spec, states, stacked = _stacked_gset(n, g=2)
+    # member 1 is genuinely QUIESCENT (bottom everywhere — the only
+    # shape the empty-row-mask contract covers: pad-slot writes carry
+    # the joined value, which is a no-op only at a fixed point; a
+    # diverged all-invalid member never reaches the kernel because the
+    # runtime stacks only ACTIVE members)
+    states[1] = replicate(GSet.new(spec), n)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+    rows = np.array([[1, 5, 9, 1], [0, 0, 0, 0]], dtype=np.int64)
+    valid = np.array([[True, True, True, False],
+                      [False, False, False, False]])
+    out, changed = gossip_round_rows_grouped(
+        GSet, spec, stacked, nbrs, rows, valid
+    )
+    # member 1 (all-invalid, quiescent): bit-untouched, changed all False
+    assert _tree_eq(
+        jax.tree_util.tree_map(lambda x: x[1], out), states[1]
+    )
+    assert not np.asarray(changed)[1].any()
+    # member 0: identical to the per-var rows kernel on the valid rows
+    ref, ref_changed = gossip_round_rows(
+        GSet, spec, states[0], nbrs, rows[0][:3]
+    )
+    assert _tree_eq(jax.tree_util.tree_map(lambda x: x[0], out), ref)
+    assert (np.asarray(changed)[0][:3] == np.asarray(ref_changed)).all()
+
+
+def test_fused_grouped_rounds_match_pervar():
+    n = 32
+    nbrs = jnp.asarray(random_regular(n, 3, seed=29))
+    spec, states, stacked = _stacked_gset(n)
+    out, changed = fused_gossip_rounds_grouped(GSet, spec, stacked, nbrs, 3)
+    for i, st in enumerate(states):
+        ref, ref_changed = fused_gossip_rounds(GSet, spec, st, nbrs, 3)
+        assert _tree_eq(jax.tree_util.tree_map(lambda x: x[i], out), ref)
+        assert bool(changed[i]) == bool(ref_changed)
+
+
+def test_fused_chaos_grouped_composes_stacked_masks():
+    # stacked-mask chaos windows x stacked-variable groups: the [T, R, K]
+    # schedule and the [G, R, ...] group compose in one dispatch,
+    # bit-identical per member to the per-var chaos kernel
+    n = 32
+    nbrs_np = random_regular(n, 3, seed=31)
+    nbrs = jnp.asarray(nbrs_np)
+    spec, states, stacked = _stacked_gset(n)
+    rng = np.random.RandomState(4)
+    masks = np.stack([
+        edge_failure_mask(n, 3, f, seed=int(rng.randint(99)),
+                          neighbors=nbrs_np)
+        for f in (0.4, 0.2, 0.0)
+    ])
+    out, res = fused_chaos_rounds_grouped(GSet, spec, stacked, nbrs, masks)
+    assert res.shape == (3, 3)  # [T, G]
+    for i, st in enumerate(states):
+        ref, ref_res = fused_chaos_rounds(GSet, spec, st, nbrs, masks)
+        assert _tree_eq(jax.tree_util.tree_map(lambda x: x[i], out), ref)
+        assert (np.asarray(res)[:, i] == np.asarray(ref_res)).all()
+
+
+@pytest.mark.parametrize("mode", ["gather", "alltoall"])
+def test_partitioned_grouped_round_matches_pervar(mode):
+    # the boundary exchange with a leading group axis: one collective
+    # moves all G members' cut rows; per-member results identical to the
+    # ungrouped round on the 8-virtual-device mesh
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from lasp_tpu.mesh.shard_gossip import (
+        partition_tables,
+        partitioned_gossip_plan,
+        partitioned_gossip_round_fn,
+        partitioned_gossip_round_grouped,
+    )
+
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest must provision 8 virtual CPU devices"
+    mesh = Mesh(np.array(devs[:8]), ("replicas",))
+    n = 64
+    nbrs = random_regular(n, 3, seed=37)
+    plan = partitioned_gossip_plan(nbrs, 8)
+    spec, states, stacked = _stacked_gset(n, g=3, seed=21)
+    shard = NamedSharding(mesh, P("replicas"))
+    g_shard = NamedSharding(mesh, P(None, "replicas"))
+    send, idx = partition_tables(plan, mesh, mode=mode)
+    grouped_fn = partitioned_gossip_round_grouped(
+        GSet, spec, mesh, plan, mode=mode
+    )
+    pervar_fn = partitioned_gossip_round_fn(GSet, spec, mesh, plan,
+                                            mode=mode)
+    stacked_dev = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, g_shard), stacked
+    )
+    out = jax.jit(grouped_fn)(stacked_dev, send, idx)
+    for i, st in enumerate(states):
+        st_dev = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, shard), st
+        )
+        ref = jax.jit(pervar_fn)(st_dev, send, idx)
+        assert _tree_eq(jax.tree_util.tree_map(lambda x: x[i], out), ref)
+        # and both agree with the dense unsharded reference round
+        dense = gossip_round(GSet, spec, states[i], jnp.asarray(nbrs))
+        assert _tree_eq(ref, dense)
+
+
+def test_hot_member_promotes_only_itself_to_dense():
+    # one all-dirty member must not drag its small-frontier peers
+    # through the full-population dense round: the crossover is decided
+    # PER MEMBER, so the round's row work is R + |peer reach|, not 2R
+    n = 64
+    nbrs = random_regular(n, 3, seed=41)
+    store = Store(n_actors=4)
+    hot = store.declare(id="hot", type="lasp_gset", n_elems=16)
+    cold = store.declare(id="cold", type="lasp_gset", n_elems=16)
+    rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+    rt.update_batch(cold, [(0, ("add", "c"), "a0")])
+    rt.update_batch(hot, [(int(r), ("add", f"h{r % 4}"), f"w{r}")
+                          for r in range(n)])
+    assert rt.frontier_size(hot) == n  # all-dirty: past any crossover
+    rt.frontier_step()
+    # hot went dense (n rows); cold stayed sparse (its tiny reach set)
+    assert n < rt.frontier_rows_last < 2 * n, rt.frontier_rows_last
+
+
+def test_residual_gauge_coherent_across_schedulers():
+    # the frontier path's skip-if-unchanged gauge cache must observe
+    # dense-step writes too: dense writes X, then a frontier round
+    # reproducing the PRE-dense value must still set the gauge
+    n = 16
+    store = Store(n_actors=4)
+    v = store.declare(id="v", type="lasp_gset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, 2))
+    rt.update_batch(v, [(0, ("add", "x"), "a0")])
+    rt.frontier_step()  # warms instruments + seeds the caches
+
+    def gauge_value():
+        snap = tel_registry.get_registry().snapshot()["gossip_residual"]
+        return next(
+            s["value"] for s in snap["series"]
+            if s["labels"] == {"var": "v"}
+        )
+
+    rt._emit_frontier_telemetry([3], 3, 3, 0, 0, 1e-6)
+    assert gauge_value() == 3
+    rt._emit_step_telemetry(np.array([7], dtype=np.int32), 7, 1e-6)
+    assert gauge_value() == 7
+    # same residual as the earlier frontier round: a stale cache would
+    # skip this set and leave the dense value exported
+    rt._emit_frontier_telemetry([3], 3, 3, 0, 0, 1e-6)
+    assert gauge_value() == 3
+
+
+def test_compile_plan_counts_and_gauges():
+    n = 16
+    rt, _ids = _build_mixed("auto", n, ring(n, 2))
+    reg = tel_registry.get_registry()
+    before = reg.counter("plan_compile_total").value
+    plan = compile_plan(rt)
+    assert reg.counter("plan_compile_total").value == before + 1
+    snap = reg.snapshot()
+    assert snap["gossip_plan_groups"]["series"][0]["value"] == len(
+        plan.groups
+    )
+
+
+def test_plan_off_never_groups():
+    n = 16
+    rt, _ids = _build_mixed("off", n, ring(n, 2))
+    assert rt._ensure_plan() is None
+    rt.frontier_step()
+    assert rt._plan is None

@@ -121,6 +121,24 @@ def test_riak_index_program_mesh_views_and_delete():
     assert rt.execute(BASE_NAME) == {"alpha", "gamma"}
 
 
+def test_riak_index_handoff_noop_and_unknown_reason_loud():
+    rt = _rt(n=8, k=2)
+    rt.register(BASE_NAME, RiakIndexProgram, n_elems=8, token_space=8,
+                auto_views=False)
+    obj = RiakObject(key="k", vclock=("vc", 1), metadata="m")
+    rt.process(obj, "put", "a0", replica=0)
+    assert rt.execute(BASE_NAME) == {"k"}
+    # handoff is an ACKNOWLEDGED no-op (the reference stubs it too,
+    # src/lasp_vnode.erl:105-107): replaying the object must not mint a
+    # duplicate entry or remove the live one
+    rt.process(obj, "handoff", "a1", replica=3)
+    assert rt.execute(BASE_NAME) == {"k"}
+    # an unknown reason must be LOUD, not a silently dropped notification
+    with pytest.raises(NotImplementedError, match="unsupported object-event"):
+        rt.process(obj, "putt", "a0", replica=0)
+    assert rt.execute(BASE_NAME) == {"k"}
+
+
 def test_index_capacity_recovery_converges_then_compacts():
     # delete/re-put churn fills the view's element universe with dead
     # entries; the program's CapacityError recovery must work under mesh

@@ -26,6 +26,7 @@ def test_scenario_registry_complete():
         "bridge_throughput",
         "partitioned_gossip",
         "frontier_sparse",
+        "many_vars",
         "chaos_heal",
     }
 
@@ -109,6 +110,21 @@ def test_adcounter_small():
     assert out["live_ads"] == 6
     assert out["active_pairs"] == 6
     assert out["ad_totals"] == [1, 2, 3, 4, 5, 6, 7, 8, 1, 2]
+
+
+def test_many_vars_small():
+    from lasp_tpu.bench_scenarios import many_vars
+
+    out = many_vars(n_replicas=48, n_vars=12, reps=1)
+    # the megabatch contract is asserted INSIDE the scenario
+    # (bit-identical states + residual sequences across arms); here we
+    # pin the artifact shape the driver embeds
+    assert out["check"] == (
+        "bit-identical states + residual sequences across arms"
+    )
+    assert set(out["impl_block_seconds"]) == {"per_var", "planned"}
+    assert out["plan"]["groups"] == 3 and out["plan"]["vars"] == 12
+    assert out["rounds"] >= 1 and out["plan_speedup"] > 0
 
 
 def test_chaos_heal_small():
