@@ -27,13 +27,16 @@ test-slow:
 # guards the megabatch dispatch plan's bit-identical contract on a
 # mixed-codec store (docs/PERF.md "Batched dispatch"), a seeded chaos
 # soak guards the convergence-under-failure invariants (post-heal
-# bit-equality + replay determinism, docs/RESILIENCE.md), then the
+# bit-equality + replay determinism, docs/RESILIENCE.md), a roofline
+# smoke guards the cost ledger's non-null fractions + the probe-report
+# schema (docs/OBSERVABILITY.md "Roofline & cost ledger"), then the
 # non-slow tests run (the tier-1 shape)
 verify:
 	python tools/check_metrics_catalog.py
 	python tools/frontier_smoke.py
 	python tools/plan_smoke.py
 	python tools/chaos_smoke.py
+	python tools/roofline_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

@@ -50,21 +50,24 @@ _TOTAL_BUDGET_S = int(os.environ.get("LASP_BENCH_TOTAL_BUDGET", "2100"))
 #: slice of the deadline reserved for the CPU fallback + JSON emission
 _CPU_RESERVE_S = 420
 
-#: single-chip HBM roofline, GB/s, by device-kind substring
-_ROOFLINE_GBPS = (
-    ("v6", 1638.0),
-    ("v5p", 2765.0),
-    ("v5e", 819.0),
-    ("v5 lite", 819.0),
-    ("v4", 1228.0),
-    ("v3", 900.0),
-    ("v2", 700.0),
-)
+# the peak-bandwidth table lives in the capability registry now
+# (lasp_tpu/telemetry/capability.py — importable WITHOUT jax, so the
+# parent's no-backend contract holds); the probe-report schema and
+# stderr classification come from the same module
+
+
+#: timeout sentinel of ``_run`` — MUST equal
+#: lasp_tpu.telemetry.capability.PROBE_TIMEOUT_RC (the classifier's
+#: default; -1 would collide with a SIGHUP'd child's returncode).
+#: Kept literal here so the parent stays stdlib-only at module scope;
+#: tests/telemetry/test_roofline.py pins the two together.
+_TIMEOUT_RC = -257
 
 
 def _run(cmd, timeout, env=None):
     """Run a child with graceful termination on timeout. Returns
-    (rc, stdout, stderr); rc == -1 marks a timeout."""
+    (rc, stdout, stderr); rc == _TIMEOUT_RC (-257) marks a timeout —
+    a value no signal-killed child can produce."""
     proc = subprocess.Popen(
         cmd,
         env=env,
@@ -83,32 +86,70 @@ def _run(cmd, timeout, env=None):
         except subprocess.TimeoutExpired:
             proc.kill()
             out, err = proc.communicate()
-        return -1, out or "", err or ""
+        return _TIMEOUT_RC, out or "", err or ""
 
 
-def _probe_tpu(deadline: float) -> bool:
-    """Bounded-subprocess TPU availability probe with backoff retries."""
-    code = "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform)"
+def _probe_tpu(deadline: float) -> "tuple[bool, dict]":
+    """Bounded-subprocess TPU availability probe with backoff retries.
+
+    Returns ``(tpu_ok, probe_report)`` — the report is the structured
+    record (per-attempt rc / classification / fatal line with the
+    warning noise separated / platforms seen) that lands in the
+    artifact. r03–r05's swallowed-stderr failure mode: the ONLY line
+    surfaced was the experimental-platform WARNING while the actual
+    fatal error was discarded; the classifier
+    (lasp_tpu.telemetry.capability) separates the tiers so the fatal
+    line is what prints and persists."""
+    from lasp_tpu.telemetry.capability import (
+        build_probe_report,
+        classify_probe_attempt,
+    )
+
+    code = (
+        "import jax; d = jax.devices(); "
+        "print('PLATFORMS=' + ','.join(sorted({x.platform for x in d})))"
+    )
     backoffs = [15, 30, 60, 60, 60]
     attempt = 0
+    attempts: list = []
+    platforms_seen: set = set()
+    t_start = time.monotonic()
+
+    def report(ok: bool, reason: "str | None") -> dict:
+        return build_probe_report(
+            attempts, platforms_seen, ok, reason,
+            time.monotonic() - t_start,
+        )
+
     while True:
         budget = min(_PROBE_TIMEOUT_S, max(5, deadline - time.monotonic()))
+        t0 = time.monotonic()
         rc, out, err = _run([sys.executable, "-c", code], timeout=budget)
-        if rc == 0 and "PLATFORM=" in out:
-            platform = out.rsplit("PLATFORM=", 1)[1].strip()
-            if platform not in ("cpu",):
-                return True
-            print(f"bench: probe found only platform={platform}", file=sys.stderr)
-            return False
+        rec, platforms = classify_probe_attempt(rc, out, err)
+        rec["attempt"] = attempt + 1
+        rec["seconds"] = round(time.monotonic() - t0, 1)
+        attempts.append(rec)
+        platforms_seen.update(platforms)
+        if rec["classification"] == "ok":
+            return True, report(True, None)
+        if rec["classification"] == "cpu_only":
+            print(
+                f"bench: probe found only platforms={platforms}",
+                file=sys.stderr,
+            )
+            return False, report(False, "cpu_only")
+        # surface the FATAL line, not the warning tier that used to
+        # masquerade as the failure cause
         print(
-            f"bench: TPU probe attempt {attempt + 1} failed "
-            f"(rc={rc}): {err.strip()[-200:]}",
+            f"bench: TPU probe attempt {attempt + 1} "
+            f"{rec['classification']} (rc={rc}): "
+            f"{rec['fatal'] or '(stderr carried only warnings)'}",
             file=sys.stderr,
         )
         if attempt >= len(backoffs) or time.monotonic() + backoffs[
             min(attempt, len(backoffs) - 1)
         ] > deadline:
-            return False
+            return False, report(False, rec["classification"])
         time.sleep(backoffs[min(attempt, len(backoffs) - 1)])
         attempt += 1
 
@@ -217,7 +258,7 @@ def main() -> int:
     errors: list[str] = []
 
     probe_deadline = min(start + _PROBE_WINDOW_S, deadline - _CPU_RESERVE_S)
-    tpu_ok = _probe_tpu(probe_deadline)
+    tpu_ok, probe_report = _probe_tpu(probe_deadline)
     attempts: list[tuple[str, dict, int]] = []
     if tpu_ok:
         attempts.append(("tpu", dict(os.environ), _TPU_CHILD_TIMEOUT_S))
@@ -247,6 +288,9 @@ def main() -> int:
         )
         record = _extract_json(out)
         if rc == 0 and record is not None:
+            # the structured probe report rides EVERY artifact (success
+            # included): the capture path's health is itself a metric
+            record["probe_report"] = probe_report
             if errors:
                 record.setdefault("detail", {})["earlier_attempts"] = errors
             if label == "cpu-fallback":
@@ -276,7 +320,9 @@ def main() -> int:
         )
         print(f"bench: attempt {label} failed (rc={rc})", file=sys.stderr)
 
-    _emit(_fail_record("; ".join(errors) or "no attempt ran"))
+    rec = _fail_record("; ".join(errors) or "no attempt ran")
+    rec["probe_report"] = probe_report
+    _emit(rec)
     return 0  # the artifact must parse; failure is in the record
 
 
@@ -364,14 +410,16 @@ def _child(label: str) -> int:
     np_secs = time.perf_counter() - t0
     cpu_rate = nb_r * nbrs.shape[1] * np_rounds / np_secs
 
-    roofline = None
-    if on_tpu:
-        for sub, gbps in _ROOFLINE_GBPS:
-            if sub in str(kind).lower():
-                roofline = gbps
-                break
+    # capability registry: pinned HBM peak on TPU, measured host-memory
+    # bandwidth on CPU — the roofline denominator is non-null on EVERY
+    # backend (a CPU-fallback artifact used to report null here)
+    from lasp_tpu.telemetry.capability import device_capability
+
+    cap = device_capability()
+    roofline = cap["peak_GBps"]
 
     detail = {
+        "capability": cap,
         "n_replicas": n_replicas,
         "requested_replicas": n0,
         "oom_downscales": headline_downscales,
@@ -383,9 +431,12 @@ def _child(label: str) -> int:
         "achieved_GBps": out["achieved_GBps"],
         "gossip_impl": out["gossip_impl"],
         "impl_block_seconds": out["impl_block_seconds"],
+        # per-arm achieved GB/s + roofline fraction (computed inside the
+        # scenario against the same capability registry)
+        "impl_roofline": out.get("impl_roofline"),
         "roofline_GBps": roofline,
         "roofline_frac": (
-            round(out["achieved_GBps"] / roofline, 3) if roofline else None
+            round(out["achieved_GBps"] / roofline, 4) if roofline else None
         ),
         "numpy_baseline_merges_per_sec": round(cpu_rate, 1),
         "numpy_baseline_replicas": nb_r,
@@ -502,6 +553,16 @@ def _child(label: str) -> int:
         }
     except Exception as exc:
         detail["bridge_codec"] = {"error": f"{type(exc).__name__}: {exc}"}
+
+    # -- kernel cost ledger: the per-signature roofline table the
+    # scenarios above fed (captured BEFORE the overhead guard below —
+    # its scratch registry detaches the ledger generation) -----------------
+    try:
+        from lasp_tpu.telemetry import get_ledger
+
+        detail["roofline_ledger"] = get_ledger().summary(top=12)
+    except Exception as exc:
+        detail["roofline_ledger"] = {"error": f"{type(exc).__name__}: {exc}"}
 
     # -- telemetry overhead guard: the always-on registry/span layer must
     # stay under 5% of the gossip step path (the "cheap enough to always

@@ -31,6 +31,30 @@ def _timed(fn):
     return out, time.perf_counter() - t0
 
 
+def _arm_roofline(arms: dict) -> dict:
+    """Per-arm achieved-GB/s + roofline-fraction figures for an A/B
+    scenario: ``arms`` maps arm name -> (ledger-attributed analytic
+    bytes, measured seconds). The denominator is the capability
+    registry's roofline (pinned HBM peak on TPU, measured host
+    bandwidth on CPU), so ``roofline_frac`` is non-null on every
+    backend; an arm whose byte delta is zero (telemetry disabled)
+    reports nulls rather than a fake 0 GB/s."""
+    from lasp_tpu.telemetry.capability import device_capability
+
+    peak = device_capability()["peak_GBps"]
+    out = {}
+    for arm, (bytes_moved, secs) in arms.items():
+        if bytes_moved and secs > 0:
+            g = bytes_moved / secs / 1e9
+            out[arm] = {
+                "achieved_GBps": round(g, 3),
+                "roofline_frac": round(g / peak, 4) if peak else None,
+            }
+        else:
+            out[arm] = {"achieved_GBps": None, "roofline_frac": None}
+    return out
+
+
 def _snapshot_runtime(rt):
     """States + frontier snapshot for warm best-of replays — shared by
     the A/B scenarios (``frontier_sparse``, ``many_vars``): restore
@@ -53,6 +77,57 @@ def _restore_runtime(rt, snap) -> None:
     for k, st in states.items():
         rt.states[k] = jax.tree_util.tree_map(jnp.array, st)
     rt._frontier = {k: m.copy() for k, m in frontier.items()}
+
+
+def roofline_workload(n_replicas: int = 128, n_vars: int = 12,
+                      rounds: int = 3):
+    """Drive every kernel-cost-ledger family on a mixed-codec store —
+    the ONE workload behind ``lasp_tpu roofline`` and
+    ``tools/roofline_smoke.py`` (a shared builder, so the smoke's
+    family assertions and the CLI's table can never silently diverge):
+    ``rounds`` re-dirty/convergence cycles of frontier stepping (cycle 0
+    compiles — the ledger banks it as compile time) over G-Set /
+    G-Counter / OR-SWOT variables, then dense steps and fused blocks.
+    Returns the runtime."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.store import Store
+
+    kinds = ("lasp_gset", "riak_dt_gcounter", "riak_dt_orswot")
+    store = Store(n_actors=4)
+    ids = []
+    for i in range(n_vars):
+        kind = kinds[i % len(kinds)]
+        if kind == "lasp_gset":
+            ids.append(store.declare(id=f"v{i}", type=kind, n_elems=16))
+        elif kind == "riak_dt_gcounter":
+            ids.append(store.declare(id=f"v{i}", type=kind, n_actors=4))
+        else:
+            ids.append(store.declare(id=f"v{i}", type=kind, n_elems=8,
+                                     n_actors=4))
+    rt = ReplicatedRuntime(
+        store, Graph(store), n_replicas,
+        random_regular(n_replicas, 3, seed=7),
+    )
+    for rep in range(rounds + 1):
+        for i, v in enumerate(ids):
+            if i % 3 == 1:
+                rt.update_batch(
+                    v, [((i + rep) % n_replicas, ("increment",),
+                         ("lane", i % 4))]
+                )
+            else:
+                rt.update_batch(
+                    v, [((i + rep) % n_replicas, ("add", f"x{rep}"),
+                         f"a{i}")]
+                )
+        while rt.frontier_step():
+            pass
+    rt.step()
+    rt.step()
+    rt.fused_steps(4)
+    rt.fused_steps(4)
+    return rt
 
 
 def _engine_convergence_driver(rt):
@@ -419,6 +494,19 @@ def orset_anti_entropy(
 
     bytes_per_replica = 2 * spec.n_elems * spec.n_words * 4  # both planes
     bytes_moved = (fanout + 2) * n_replicas * bytes_per_replica * conv_rounds
+    # per-arm roofline accounting: every impl's probed block timing gets
+    # an achieved-GB/s + roofline-fraction figure against the capability
+    # registry (pinned HBM peak on TPU, measured host bandwidth on the
+    # CPU fallback — never null)
+    from lasp_tpu.telemetry.capability import device_capability
+
+    peak = device_capability()["peak_GBps"]
+    bytes_per_block = (fanout + 2) * n_replicas * bytes_per_replica * block
+    impl_roofline = _arm_roofline({
+        arm: (bytes_per_block, v)
+        for arm, v in block_seconds.items()
+        if isinstance(v, float)  # "<impl>_error" entries carry strings
+    })
     return {
         "scenario": f"orset_{n_replicas}",
         "rounds": conv_rounds,
@@ -434,6 +522,8 @@ def orset_anti_entropy(
             k: (round(v, 6) if isinstance(v, float) else v)
             for k, v in block_seconds.items()
         },
+        "impl_roofline": impl_roofline,
+        "roofline_GBps": peak,
         "timing": {
             "policy": f"median of {timing_reps} warm replays "
                       "(1 warm-up discarded)",
@@ -761,13 +851,18 @@ def frontier_sparse(
 
     def timed_rep(rt, ids, run):
         """One measured replay from the snapshot (states + frontier
-        restored first by the caller)."""
+        restored first by the caller). The 4th element is the kernel
+        cost ledger's analytic byte delta over the replay — the arm's
+        roofline numerator."""
+        from lasp_tpu.telemetry import get_ledger
+
         rows_before = getattr(rt, "frontier_rows_total", 0)
+        bytes_before = get_ledger().totals()["bytes"]
         rounds, secs = _timed(run)
         jax.block_until_ready([rt.states[v] for v in ids])
         return secs, rounds, (
             getattr(rt, "frontier_rows_total", 0) - rows_before
-        )
+        ), get_ledger().totals()["bytes"] - bytes_before
 
     results = {}
     finals = {}
@@ -784,9 +879,9 @@ def frontier_sparse(
         reps = []
         for _ in range(2):  # best-of-2 warm replays (loaded-host noise)
             restore(rt, snap)
-            secs, rounds, rows = timed_rep(rt, ids, run)
+            secs, rounds, rows, rep_bytes = timed_rep(rt, ids, run)
             assert rounds == cold_rounds  # identical replayed schedule
-            reps.append((secs, rounds, rows))
+            reps.append((secs, rounds, rows, rep_bytes))
         if arm == "frontier":
             # AUTOTUNE: measured break-even frontier density — dense
             # per-round per-var cost over frontier per-row cost (the
@@ -794,7 +889,7 @@ def frontier_sparse(
             # setting). One untimed replay compiles any fresh bucket the
             # re-scheduled run needs, then a timed replay competes with
             # the default-crossover reps.
-            secs, _r, rows = min(reps)
+            secs, _r, rows, _b = min(reps)
             d_row = results["dense"]["seconds"] / max(
                 cold_rounds * n_replicas * n_vars, 1
             )
@@ -805,9 +900,10 @@ def frontier_sparse(
                 run()  # untimed: compile the re-scheduled kernels
                 restore(rt, snap)
                 reps.append(timed_rep(rt, ids, run))
-        secs, rounds, rows = min(reps)
+        secs, rounds, rows, arm_bytes = min(reps)
         results[arm] = {
             "seconds": secs, "rounds": rounds, "rows_touched": rows,
+            "bytes_moved": arm_bytes,
         }
         assert all(rt.divergence(v) == 0 for v in ids)
         finals[arm] = (
@@ -832,6 +928,10 @@ def frontier_sparse(
     )
     rows = results["frontier"]["rows_touched"]
     chosen = "frontier" if frontier_s <= dense_s else "dense"
+    impl_roofline = _arm_roofline(
+        {a: (results[a]["bytes_moved"], results[a]["seconds"])
+         for a in results}
+    )
     return {
         "scenario": f"frontier_sparse_{n_replicas}",
         "n_replicas": n_replicas,
@@ -848,6 +948,7 @@ def frontier_sparse(
             "dense": round(dense_s, 6),
             "frontier": round(frontier_s, 6),
         },
+        "impl_roofline": impl_roofline,
         "gossip_impl": chosen,
         "frontier_speedup": round(dense_s / frontier_s, 2),
         "autotuned_crossover": autotuned,
@@ -952,13 +1053,17 @@ def many_vars(
         cold_residuals = drive(rt)  # compiles every kernel in the schedule
         if plan == "auto":
             plan_shape = rt._ensure_plan().describe()
+        from lasp_tpu.telemetry import get_ledger
+
         rep_secs = []
+        arm_bytes0 = get_ledger().totals()["bytes"]
         for _ in range(reps):
             restore(rt, snap)
             residuals, secs = _timed(lambda: drive(rt))
             jax.block_until_ready([rt.states[v] for v in ids])
             assert residuals == cold_residuals  # identical replay
             rep_secs.append(secs)
+        arm_bytes = get_ledger().totals()["bytes"] - arm_bytes0
         residual_seqs[arm] = cold_residuals
         results[arm] = {
             "seconds": float(np.median(rep_secs)),
@@ -967,6 +1072,10 @@ def many_vars(
                 max(rep_secs) / max(min(rep_secs), 1e-9), 2
             ),
             "rounds": len(cold_residuals),
+            # ledger-attributed analytic bytes over ALL reps (the arm's
+            # roofline numerator; divided by the summed rep seconds)
+            "bytes_moved": arm_bytes,
+            "reps_seconds_total": round(sum(rep_secs), 6),
         }
         assert all(rt.divergence(v) == 0 for v in ids)
         finals[arm] = {
@@ -986,6 +1095,10 @@ def many_vars(
 
     pv_s = results["per_var"]["seconds"]
     pl_s = results["planned"]["seconds"]
+    impl_roofline = _arm_roofline(
+        {a: (results[a]["bytes_moved"], results[a]["reps_seconds_total"])
+         for a in results}
+    )
     return {
         "scenario": f"many_vars_{n_vars}x{n_replicas}",
         "n_replicas": n_replicas,
@@ -998,6 +1111,7 @@ def many_vars(
             "per_var": round(pv_s, 6),
             "planned": round(pl_s, 6),
         },
+        "impl_roofline": impl_roofline,
         "timing": {
             "policy": f"median of {reps} warm snapshot replays per arm",
             "per_var": results["per_var"],

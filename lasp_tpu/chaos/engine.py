@@ -318,6 +318,11 @@ class ChaosRuntime:
         rt._frontier_after_opaque(False)
         rt.trace.record_round(int(res[-1]), t.elapsed)
         rt._record_rounds(n_rounds)
+        # ledger: the stacked-mask window is its own kernel family (the
+        # bool[T,R,K] mask operand rides the dispatch; each window
+        # length is its own compiled executable, hence the block key)
+        rt._ledger_record_store("chaos_window", t.elapsed, n_rounds,
+                                block=n_rounds)
         rt._observe_opaque_block(n_rounds, None, t.elapsed)
         # per-round duplicate accounting from the masks ALREADY compiled
         # for the dispatch (no second mask_at pass); gauges emit once for

@@ -435,6 +435,67 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def cmd_roofline(args) -> int:
+    """Per-kernel roofline table (the cost-ledger console): achieved
+    GB/s and roofline fraction per kernel signature against the device
+    capability registry, from a built-in mixed-codec workload (or
+    whatever the process already ran when imported in-process).
+    ``--export`` writes the full JSON; ``--profile DIR`` additionally
+    wraps the workload in a jax.profiler trace (Perfetto-openable)."""
+    from lasp_tpu.bench_scenarios import roofline_workload
+    from lasp_tpu.telemetry import device_capability, get_ledger
+    from lasp_tpu.telemetry.roofline import profile_capture
+
+    if args.replicas < 2:
+        print("error: --replicas must be >= 2 (no gossip edges)",
+              file=sys.stderr)
+        return 2
+    if args.profile:
+        with profile_capture(args.profile):
+            roofline_workload(args.replicas, rounds=args.rounds)
+    else:
+        roofline_workload(args.replicas, rounds=args.rounds)
+    cap = device_capability()
+    ledger = get_ledger()
+    snap = ledger.snapshot()
+    peak = cap["peak_GBps"]
+    print(
+        f"device: {cap['platform']}/{cap['device_kind']}  "
+        f"roofline {peak if peak is not None else '?'} GB/s "
+        f"({cap['source']})"
+    )
+    print(f"{'KERNEL':<42} {'DISP':>5} {'ROUNDS':>6} {'MB':>9} "
+          f"{'ms':>9} {'GB/s':>8} {'ROOF%':>7}")
+    for ent in snap:
+        gbps = ent["achieved_GBps"]
+        frac = ent["roofline_frac"]
+        print(
+            f"{ent['kernel']:<42} {ent['dispatches']:>5} "
+            f"{ent['rounds']:>6} {ent['bytes'] / 1e6:>9.3f} "
+            f"{ent['seconds'] * 1e3:>9.2f} "
+            f"{gbps if gbps is not None else '-':>8} "
+            f"{('%.2f%%' % (100 * frac)) if frac is not None else '-':>7}"
+        )
+    summary = ledger.summary()
+    print(
+        f"total: {summary['totals']['dispatches']} dispatches, "
+        f"{summary['totals']['bytes'] / 1e6:.3f} MB, "
+        f"achieved {summary['achieved_GBps']} GB/s, "
+        f"roofline_frac {summary['roofline_frac']}"
+    )
+    if args.export:
+        with open(args.export, "w") as f:
+            json.dump(
+                {"capability": cap, "kernels": snap, "summary": summary},
+                f, indent=2,
+            )
+        print(f"exported: {args.export}")
+    if args.profile:
+        print(f"profile trace: {args.profile} (open in Perfetto / "
+              "TensorBoard)")
+    return 0
+
+
 def cmd_inspect(args) -> int:
     from lasp_tpu.store import HostStore
     from lasp_tpu.store.checkpoint import loads_manifest
@@ -606,6 +667,22 @@ def main(argv=None) -> int:
                     help="turn on deep tracing (per-op / per-merge / "
                          "per-edge events) for the driven workload")
 
+    roof = sub.add_parser(
+        "roofline",
+        help="per-kernel cost-ledger table: achieved GB/s + roofline "
+             "fraction per kernel signature against the device "
+             "capability registry (docs/OBSERVABILITY.md)",
+    )
+    roof.add_argument("--replicas", type=int, default=256,
+                      help="population of the built-in workload")
+    roof.add_argument("--rounds", type=int, default=3,
+                      help="re-dirty/convergence cycles to drive")
+    roof.add_argument("--export", default=None, metavar="FILE",
+                      help="write capability + per-kernel table as JSON")
+    roof.add_argument("--profile", default=None, metavar="DIR",
+                      help="wrap the workload in a jax.profiler trace "
+                           "(Perfetto-openable) written to DIR")
+
     ins = sub.add_parser("inspect", help="list a checkpoint's contents")
     ins.add_argument("path")
 
@@ -627,6 +704,7 @@ def main(argv=None) -> int:
         "metrics": cmd_metrics,
         "top": cmd_top,
         "trace": cmd_trace,
+        "roofline": cmd_roofline,
         "inspect": cmd_inspect,
         "bridge": cmd_bridge,
     }[args.verb](args)

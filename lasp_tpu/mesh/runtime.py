@@ -35,6 +35,7 @@ from ..lattice.base import Threshold, replicate
 from ..ops.flatpack import FlatORSet, FlatORSetSpec
 from ..telemetry import counter, events as tel_events, histogram, span
 from ..telemetry.convergence import get_monitor, record_membership
+from ..telemetry.roofline import get_ledger, state_row_bytes
 from ..utils.metrics import StepTrace, Timer
 from .gossip import (
     divergence,
@@ -223,6 +224,10 @@ class ReplicatedRuntime:
         #: cached hot-path instruments: (registry generation, var_ids,
         #: edge-kind tuple, dict) — see _instruments()
         self._tel_cache: "tuple | None" = None
+        #: per-var row-footprint cache for the kernel cost ledger
+        #: (metadata-only; cleared with the plan on every shape-changing
+        #: event — see :meth:`_invalidate_plan`)
+        self._row_bytes_cache: dict = {}
         #: dispatch-plan mode: "auto" groups same-codec variables into
         #: stacked megabatch kernels (``mesh.plan``), "off" keeps the
         #: historical one-kernel-per-variable stepping (the bench's
@@ -285,6 +290,9 @@ class ReplicatedRuntime:
         matches the frontier's own mask degrade). Recompiling is a
         host-only grouping walk; executables for unchanged groups stay
         warm in the kernel cache."""
+        # every plan-invalidating event can also change state shapes:
+        # the ledger's per-var row-footprint cache rides along
+        self._row_bytes_cache.clear()
         if getattr(self, "_plan", None) is None:
             return
         self._plan = None
@@ -1895,12 +1903,9 @@ class ReplicatedRuntime:
         # per-round wire estimate for gossip_bytes_exchanged_total:
         # metadata-only walk (shape/dtype), recomputed here because state
         # shapes only change where _ensure_step already runs
-        fan = (
-            int(self._host_neighbors.shape[1])
-            if self._host_neighbors.ndim == 2
-            else 0
+        self._round_traffic = round_traffic_bytes(
+            self._states, self._ledger_fanout()
         )
-        self._round_traffic = round_traffic_bytes(self._states, fan)
         return tables
 
     def _instruments(self) -> "dict | None":
@@ -2008,6 +2013,79 @@ class ReplicatedRuntime:
         for c, edges_of_kind in tel["edge_recomputes"]:
             c.inc(n * edges_of_kind)
 
+    # -- kernel cost ledger feeds (telemetry.roofline) ------------------------
+    def _ledger_fanout(self) -> int:
+        """THE per-replica neighbor fanout (0 = full-mesh shift mode) —
+        the single definition behind the ledger's traffic signatures
+        and the `gossip_bytes_exchanged_total` wire estimate, so the
+        two accountings can never diverge."""
+        return (
+            int(self._host_neighbors.shape[1])
+            if self._host_neighbors.ndim == 2
+            else 0
+        )
+
+    def _row_bytes(self, var_id: str) -> int:
+        """One variable's per-replica-row byte footprint (metadata-only
+        walk, cached until any shape-changing event clears it alongside
+        the dispatch plan)."""
+        rb = self._row_bytes_cache.get(var_id)
+        if rb is None:
+            rb = self._row_bytes_cache[var_id] = state_row_bytes(
+                self.states[var_id], self.n_replicas
+            )
+        return rb
+
+    def _ledger_record_var(self, family: str, var_id: str, seconds: float,
+                           rows: "int | None" = None,
+                           g_active: int = 1) -> None:
+        """Attribute one per-var / per-group dispatch to the kernel cost
+        ledger under its (codec, spec-shape, R, fanout, bucket, G)
+        signature — the granularity the plan compiler dispatches at."""
+        from ..telemetry import registry as _reg
+
+        if not _reg.enabled():
+            return
+        codec, _spec = self._mesh_meta(var_id)
+        get_ledger().record(
+            family,
+            codec.__name__,
+            n_replicas=self.n_replicas,
+            fanout=self._ledger_fanout(),
+            seconds=seconds,
+            row_bytes=self._row_bytes(var_id),
+            rows=rows,
+            g_active=g_active,
+            leafwise=getattr(codec, "leafwise_join", None) is not None,
+        )
+
+    def _ledger_record_store(self, family: str, seconds: float,
+                             rounds: int,
+                             block: "int | None" = None) -> None:
+        """Attribute one whole-store dispatch (dense step / fused block /
+        on-device while) — bytes are the exact per-round wire estimate
+        the bytes counter already uses (``round_traffic_bytes``).
+        ``block`` keys the signature for fixed-length fused windows
+        (each block length is its own compiled executable, so its first
+        dispatch must land in that signature's compile bucket)."""
+        from ..telemetry import registry as _reg
+
+        if not _reg.enabled():
+            return
+        n_vars = max(len(self.var_ids), 1)
+        get_ledger().record(
+            family,
+            f"store{n_vars}",
+            n_replicas=self.n_replicas,
+            fanout=self._ledger_fanout(),
+            seconds=seconds,
+            bytes_moved=self._round_traffic * rounds,
+            joins=self.n_replicas * self._ledger_fanout() * n_vars * rounds,
+            rounds=rounds,
+            rows=block,
+            n_vars=n_vars,
+        )
+
     def step(self, edge_mask=None) -> int:
         """One bulk-synchronous round: local dataflow sweep + gossip.
         Returns the number of (replica, variable) states the step CHANGED
@@ -2035,6 +2113,7 @@ class ReplicatedRuntime:
         emissions no-op when disabled."""
         self.trace.record_round(residual, elapsed)
         self._record_rounds(1)
+        self._ledger_record_store("step", elapsed, 1)
         tel = self._instruments()
         if tel is not None:
             res_list = res_vec.tolist()
@@ -2111,6 +2190,8 @@ class ReplicatedRuntime:
         self._frontier_after_opaque(first_zero >= 0)
         self.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
         self._record_rounds(block)  # fori always executes the whole block
+        self._ledger_record_store("fused_block", t.elapsed, block,
+                                  block=block)
         self._observe_opaque_block(block, first_zero >= 0, t.elapsed)
         return first_zero
 
@@ -2244,6 +2325,10 @@ class ReplicatedRuntime:
         # (the same convention fused_steps' trace rows use)
         self.trace.record_round(0 if signed_rounds > 0 else -1, t.elapsed)
         self._record_rounds(abs(signed_rounds))
+        if signed_rounds:
+            self._ledger_record_store(
+                "converge", t.elapsed, abs(signed_rounds)
+            )
         self._observe_opaque_block(
             abs(signed_rounds), signed_rounds > 0, t.elapsed
         )
@@ -2372,12 +2457,9 @@ class ReplicatedRuntime:
         if not self._round_traffic:
             # the dense entry points refresh this in _ensure_step; the
             # frontier path owes the same metadata-only walk once
-            fan = (
-                int(self._host_neighbors.shape[1])
-                if self._host_neighbors.ndim == 2
-                else 0
+            self._round_traffic = round_traffic_bytes(
+                self._states, self._ledger_fanout()
             )
-            self._round_traffic = round_traffic_bytes(self._states, fan)
         plan = self._ensure_plan()
         with span("gossip.frontier_round", annotate=True):
             with Timer() as t:
@@ -2604,12 +2686,17 @@ class ReplicatedRuntime:
 
             fn = jax.jit(sparse, donate_argnums=self._frontier_donate())
             self._fused_steps_cache[key] = fn
-        outs, changed = self._run_plan_fn(
-            var_ids, fn, edge_mask,
-            jnp.asarray(rows_mat), jnp.asarray(valid),
-        )
+        with Timer() as t:
+            outs, changed = self._run_plan_fn(
+                var_ids, fn, edge_mask,
+                jnp.asarray(rows_mat), jnp.asarray(valid),
+            )
         for i, v in enumerate(var_ids):
             self.states[v] = outs[i]
+        self._ledger_record_var(
+            "grouped_rows", var_ids[0], t.elapsed, rows=int(bucket),
+            g_active=len(active),
+        )
         return np.asarray(changed)
 
     def _plan_dense_round(self, group, active, edge_mask) -> np.ndarray:
@@ -2643,9 +2730,13 @@ class ReplicatedRuntime:
 
             fn = jax.jit(dense, donate_argnums=self._frontier_donate())
             self._fused_steps_cache[key] = fn
-        outs, changed = self._run_plan_fn(var_ids, fn, edge_mask)
+        with Timer() as t:
+            outs, changed = self._run_plan_fn(var_ids, fn, edge_mask)
         for i, v in enumerate(var_ids):
             self.states[v] = outs[i]
+        self._ledger_record_var(
+            "grouped_dense", var_ids[0], t.elapsed, g_active=len(active)
+        )
         # np.array (copy): the per-member rows become frontier masks that
         # _frontier_fill later mutates in place (the PR4 read-only-view
         # lesson)
@@ -2705,10 +2796,12 @@ class ReplicatedRuntime:
 
             fn = jax.jit(sparse, donate_argnums=self._frontier_donate())
             self._fused_steps_cache[key] = fn
-        new_states, changed = self._run_frontier_fn(
-            var_id, fn, edge_mask, jnp.asarray(padded)
-        )
+        with Timer() as t:
+            new_states, changed = self._run_frontier_fn(
+                var_id, fn, edge_mask, jnp.asarray(padded)
+            )
         self.states[var_id] = new_states
+        self._ledger_record_var("rows", var_id, t.elapsed, rows=int(bucket))
         mask = np.zeros(self.n_replicas, dtype=bool)
         changed = np.asarray(changed)[: rows.size]
         mask[rows[changed]] = True
@@ -2741,10 +2834,15 @@ class ReplicatedRuntime:
 
             fn = jax.jit(dense, donate_argnums=self._frontier_donate())
             self._fused_steps_cache[key] = fn
-        new_states, changed = self._run_frontier_fn(
-            var_id, fn, edge_mask, jnp.zeros((1,), jnp.int32)
-        )
+        with Timer() as t:
+            new_states, changed = self._run_frontier_fn(
+                var_id, fn, edge_mask, jnp.zeros((1,), jnp.int32)
+            )
         self.states[var_id] = new_states
+        self._ledger_record_var(
+            "shift" if self._shift_offsets is not None else "dense",
+            var_id, t.elapsed,
+        )
         # np.array, not asarray: a zero-copy view of a device buffer is
         # READ-ONLY, and this array becomes the frontier mask that
         # _frontier_fill later mutates in place (mask-change degrade)

@@ -36,8 +36,16 @@ The metric catalog and span taxonomy live in docs/OBSERVABILITY.md;
 from __future__ import annotations
 
 from . import convergence, events
+from .capability import device_capability, peak_gbps_for_kind
 from .convergence import ConvergenceMonitor, get_monitor
 from .export import dump_jsonl, metric_events, render_prometheus
+from .roofline import (
+    KernelLedger,
+    capture_scenario,
+    get_ledger,
+    kernel_traffic,
+    profile_capture,
+)
 from .registry import (
     DEFAULT_BUCKETS,
     Counter,
@@ -63,9 +71,16 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "Counter",
     "CounterGroup",
+    "KernelLedger",
+    "capture_scenario",
     "convergence",
+    "device_capability",
     "events",
+    "get_ledger",
     "get_monitor",
+    "kernel_traffic",
+    "peak_gbps_for_kind",
+    "profile_capture",
     "Gauge",
     "Histogram",
     "MetricRegistry",

@@ -1,0 +1,333 @@
+"""Device capability registry + hardened probe-report schema.
+
+Two jobs, both born from the perf trajectory going blind (ISSUE 6):
+
+1. **Capability registry** — the one table of peak HBM bandwidth by
+   device kind (previously duplicated as private ``_ROOFLINE_GBPS``
+   tuples in ``bench.py`` and ``tools/tpu_oneshot.py``, and hardcoded
+   prose in docs/PERF.md), plus a one-shot **measured host-memory
+   bandwidth probe** so CPU-fallback runs get a real roofline
+   denominator instead of ``null``. ``device_capability()`` is the
+   single lookup every consumer (bench child, oneshot capture, kernel
+   cost ledger, ``lasp_tpu roofline``) reads.
+
+2. **Probe-report schema** — r03–r05 fell back to CPU because the TPU
+   probe failed *and the only stderr line surfaced was the harmless
+   experimental-platform WARNING*; the actual fatal error was
+   discarded. :func:`classify_probe_attempt` separates warning noise
+   from the fatal line and classifies the failure (import error / init
+   timeout / signal / no devices / cpu only), and
+   :func:`build_probe_report` assembles the structured record every
+   BENCH artifact now carries. The key sets (:data:`PROBE_REPORT_KEYS`,
+   :data:`PROBE_ATTEMPT_KEYS`) are an interface: the "Probe report
+   schema" table in docs/OBSERVABILITY.md is linted against them both
+   ways by ``tools/check_metrics_catalog.py``.
+
+This module must stay importable WITHOUT jax (the bench parent and the
+capture watcher never initialize a backend — the single-client axon
+tunnel wedges on concurrent connects). Device identity is read only
+when jax is ALREADY imported, the same rule ``spans.annotate`` uses.
+"""
+
+from __future__ import annotations
+
+import re as _re
+import sys
+import time
+
+from . import registry as _registry
+
+#: single-chip peak HBM bandwidth, GB/s, by device-kind substring —
+#: first match wins, so more specific kinds sort first. v5e was pinned
+#: in docs/PERF.md prose before this registry existed.
+PEAK_HBM_GBPS = (
+    ("v6e", 1638.0),
+    ("v6", 1638.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+
+
+def peak_gbps_for_kind(device_kind: str) -> "float | None":
+    """Pinned peak HBM GB/s for a device-kind string, or None when the
+    kind is not in the registry (an unknown accelerator must report an
+    honest null, never a guessed denominator)."""
+    k = str(device_kind).lower()
+    for sub, gbps in PEAK_HBM_GBPS:
+        if sub in k:
+            return gbps
+    return None
+
+
+_host_bw: dict = {}
+
+
+def measure_host_bandwidth(size_mb: int = 128, reps: int = 3) -> float:
+    """One-shot measured host-memory bandwidth, GB/s (cached per
+    ``(size_mb, reps)`` for the process lifetime — a small-buffer probe
+    from a test must never fix the roofline denominator for everyone
+    else): best-of-``reps`` large ``np.copyto`` passes, the read+write
+    traffic convention (2 bytes moved per byte copied). ~100 ms once;
+    never called by lightweight parents (only consumers that actually
+    need a denominator)."""
+    key = (int(size_mb), int(reps))
+    cached = _host_bw.get(key)
+    if cached is not None:
+        return cached
+    import numpy as np
+
+    n = max(1, int(size_mb)) * (1 << 20) // 8
+    src = np.ones(n, dtype=np.float64)
+    dst = np.empty_like(src)
+    best = float("inf")
+    for i in range(reps + 1):
+        t0 = time.perf_counter()
+        np.copyto(dst, src)
+        dt = time.perf_counter() - t0
+        if i:  # first pass warms the pages, not the clock
+            best = min(best, dt)
+    _host_bw[key] = round(2 * n * 8 / best / 1e9, 2)
+    return _host_bw[key]
+
+
+_capability: "dict | None" = None
+#: whether the cached record was resolved with jax importable — a
+#: pre-jax call must NOT pin the measured-host denominator for a
+#: process that later initializes an accelerator backend
+_capability_saw_jax = False
+#: registry generation the capability gauge was last emitted into —
+#: like the ledger's lifetime rule, ``telemetry.reset()`` /
+#: ``scratch_registry()`` wipe the gauge, so a cache HIT must re-emit
+#: into the new generation or the denominator vanishes from exports
+#: while roofline_frac gauges keep appearing
+_capability_gauge_gen: "int | None" = None
+
+
+def _emit_capability_gauge(cap: dict) -> None:
+    global _capability_gauge_gen
+    _registry.gauge(
+        "capability_peak_GBps",
+        help="roofline denominator: peak HBM GB/s (pinned by device "
+             "kind) or measured host-memory bandwidth on CPU",
+        device_kind=cap["device_kind"],
+        source=cap["source"],
+    ).set(cap["peak_GBps"] if cap["peak_GBps"] is not None else 0)
+    _capability_gauge_gen = _registry.generation()
+
+
+def cached_peak_gbps() -> "float | None":
+    """The cached capability's roofline denominator WITHOUT triggering
+    the one-shot host probe — the hot-path accessor (the kernel cost
+    ledger's sampled gauge refresh must never pay a ~100 ms bandwidth
+    measurement inside a dispatch path). None until some read surface
+    (CLI, bench, health, smoke) has resolved :func:`device_capability`
+    — and None again for a record cached before jax appeared (same
+    staleness rule as :func:`device_capability`: a pre-jax measured-host
+    number must never serve as an accelerator run's denominator; the
+    gauges stay unset until a read surface re-resolves)."""
+    if _capability is None:
+        return None
+    if not _capability_saw_jax and "jax" in sys.modules:
+        return None
+    return _capability["peak_GBps"]
+
+
+def device_capability(refresh: bool = False) -> dict:
+    """The attached accelerator's capability record (cached):
+    ``{"platform", "device_kind", "peak_GBps", "source"}`` where source
+    is ``"pinned"`` (registry hit), ``"measured-host"`` (the CPU
+    probe), or ``"unknown"`` (an accelerator kind the registry does not
+    know — ``peak_GBps`` stays None rather than lying). Reads jax only
+    when it is already imported; a jax-free process reports the
+    measured host capability — but a record cached BEFORE jax appeared
+    re-resolves on the first call after import, so an early startup
+    call can never pin host-DRAM bandwidth as a TPU run's denominator."""
+    global _capability, _capability_saw_jax
+    jax_present = "jax" in sys.modules
+    if (_capability is not None and not refresh
+            and (_capability_saw_jax or not jax_present)):
+        if _capability_gauge_gen != _registry.generation():
+            _emit_capability_gauge(_capability)
+        return _capability
+    platform, kind = "cpu", "cpu"
+    if jax_present:
+        import jax
+
+        try:
+            d = jax.devices()[0]
+            platform = str(d.platform)
+            kind = str(getattr(d, "device_kind", d.platform))
+        except Exception:
+            pass  # backend init failure: fall through to the host view
+    peak: "float | None" = None
+    source = "unknown"
+    if platform != "cpu":
+        peak = peak_gbps_for_kind(kind)
+        source = "pinned" if peak is not None else "unknown"
+    else:
+        peak = measure_host_bandwidth()
+        source = "measured-host"
+    cap = {
+        "platform": platform,
+        "device_kind": kind,
+        "peak_GBps": peak,
+        "source": source,
+    }
+    _emit_capability_gauge(cap)
+    _capability = cap
+    _capability_saw_jax = jax_present
+    return cap
+
+
+# ---------------------------------------------------------------------------
+# probe-report schema (the hardened TPU capture path)
+# ---------------------------------------------------------------------------
+
+#: top-level keys of a probe report — linted both ways against the
+#: "Probe report schema" table in docs/OBSERVABILITY.md
+PROBE_REPORT_KEYS = (
+    "ok",
+    "platforms_seen",
+    "attempts",
+    "reason",
+    "elapsed_s",
+)
+
+#: per-attempt keys inside ``probe_report["attempts"]``
+PROBE_ATTEMPT_KEYS = (
+    "attempt",
+    "rc",
+    "classification",
+    "fatal",
+    "warnings",
+    "stderr_tail",
+    "seconds",
+)
+
+#: the bounded-subprocess timeout sentinel. NOT -1: subprocess reports
+#: a child killed by signal N as returncode -N, so -1 is SIGHUP and a
+#: sentinel colliding with it would classify a hangup as init_timeout.
+#: No POSIX signal can produce -257. bench.py's ``_run`` returns this
+#: (a drift test pins the two constants together).
+PROBE_TIMEOUT_RC = -257
+
+#: the closed classification vocabulary (tests pin it)
+PROBE_CLASSIFICATIONS = (
+    "ok",
+    "cpu_only",
+    "init_timeout",
+    "signal",
+    "import_error",
+    "no_devices",
+    "nonzero_exit",
+    "no_probe_output",
+    "budget_exceeded",
+)
+
+#: warning-tier line shapes, ANCHORED to where the emitters put them:
+#: logging-module records lead with the level ("WARNING:..."), and the
+#: warnings module formats "path.py:123: SomeWarning: ...". A fatal
+#: line that merely MENTIONS a warning ("RuntimeError: ... see WARNING
+#: above") must stay in the fatal tier — a substring match would demote
+#: it to noise and null the verdict, the exact r03–r05 blind spot.
+#: Deliberately NO bare "XWarning:" alternative: that shape only
+#: appears as the final line of a RAISED warning (PYTHONWARNINGS=error)
+#: — i.e. precisely when it IS the verdict.
+_WARNING_LINE = _re.compile(
+    r"^WARNING\b"               # logging-module level prefix
+    r"|:\d+:\s+\w*Warning:"     # warnings.warn "file.py:123: XWarning:"
+)
+
+
+def _split_stderr(stderr: str) -> "tuple[list, str | None]":
+    """(warning lines, fatal line): warnings are the known-noise tier
+    (the experimental-platform WARNING that used to masquerade as the
+    failure cause); the fatal line is the LAST non-empty non-warning
+    line — where Python tracebacks and backend errors put the verdict."""
+    warnings: list = []
+    fatal: "str | None" = None
+    for line in (stderr or "").splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if _WARNING_LINE.search(line):
+            warnings.append(line)
+        else:
+            fatal = line
+    return warnings, fatal
+
+
+def classify_probe_attempt(rc: int, stdout: str, stderr: str,
+                           timeout_rc: int = PROBE_TIMEOUT_RC,
+                           budget_exceeded: bool = False,
+                           ) -> "tuple[dict, list]":
+    """Classify one bounded-subprocess probe attempt. Returns
+    ``(attempt_record, platforms)`` where the record carries every
+    :data:`PROBE_ATTEMPT_KEYS` member except ``attempt``/``seconds``
+    (the caller stamps those) and ``platforms`` lists the backend
+    platforms the probe actually saw (``PLATFORMS=...`` on stdout).
+    ``budget_exceeded`` is for a WATCHER that killed a healthy-but-slow
+    child itself: without it the watcher's own SIGTERM would classify
+    as ``signal`` and the record would read like an external kill."""
+    warnings, fatal = _split_stderr(stderr)
+    platforms: list = []
+    for line in (stdout or "").splitlines():
+        if "PLATFORMS=" in line:
+            platforms = [
+                p for p in line.rsplit("PLATFORMS=", 1)[1].strip().split(",")
+                if p
+            ]
+        elif "PLATFORM=" in line:  # the legacy single-platform probe
+            platforms = [line.rsplit("PLATFORM=", 1)[1].strip()]
+    if budget_exceeded:
+        cls = "budget_exceeded"
+    elif rc == 0 and platforms:
+        cls = "ok" if any(p != "cpu" for p in platforms) else "cpu_only"
+    elif rc == 0:
+        # clean exit with no platform evidence (e.g. the capture
+        # watcher classifies a child whose stdout it never saw): a
+        # "nonzero_exit" label here would contradict rc=0
+        cls = "no_probe_output"
+    elif rc == timeout_rc:
+        cls = "init_timeout"
+    elif rc < 0:
+        cls = "signal"
+    elif any(
+        m in (stderr or "")
+        for m in ("ModuleNotFoundError", "ImportError")
+    ):
+        cls = "import_error"
+    elif any(
+        m in (stderr or "")
+        for m in ("No visible device", "no devices", "Unable to initialize "
+                  "backend", "FAILED_PRECONDITION")
+    ):
+        cls = "no_devices"
+    else:
+        cls = "nonzero_exit"
+    record = {
+        "rc": int(rc),
+        "classification": cls,
+        "fatal": fatal,
+        "warnings": warnings,
+        "stderr_tail": (stderr or "")[-2000:],
+    }
+    return record, platforms
+
+
+def build_probe_report(attempts: list, platforms_seen, ok: bool,
+                       reason: "str | None",
+                       elapsed_s: float) -> dict:
+    """Assemble the structured probe report (:data:`PROBE_REPORT_KEYS`)
+    that replaces the swallowed stderr tail in BENCH artifacts."""
+    return {
+        "ok": bool(ok),
+        "platforms_seen": sorted(set(platforms_seen)),
+        "attempts": list(attempts),
+        "reason": reason,
+        "elapsed_s": round(float(elapsed_s), 1),
+    }

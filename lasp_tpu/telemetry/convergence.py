@@ -477,6 +477,15 @@ class ConvergenceMonitor:
         # concurrent with stepping must never pair round-N fields with
         # round-N+1 alerts
         snap["alerts"] = self.alerts(snap)
+        # the roofline view: the kernel cost ledger's condensation
+        # (lazy import — the ledger must never be a reason this module
+        # fails to load in a lightweight process)
+        try:
+            from .roofline import get_ledger
+
+            snap["roofline"] = get_ledger().summary()
+        except Exception:
+            snap["roofline"] = None
         return snap
 
 

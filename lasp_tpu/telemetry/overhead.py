@@ -36,6 +36,7 @@ import numpy as np
 
 from . import events as _events
 from . import registry as _registry
+from . import roofline as _roofline
 from .spans import span
 
 
@@ -122,8 +123,13 @@ def _measure(n_replicas: int, step_samples: int,
     frontier = _measure_frontier(
         step_samples, max(emission_samples // 3, 200)
     )
+    ledger = _measure_ledger(
+        max(emission_samples // 3, 200), step_s,
+        frontier["round_seconds"], frontier["dispatches_per_round"],
+    )
     return {
         "frontier": frontier,
+        "ledger": ledger,
         "event_emit_cost_s": round(event_cost, 9),
         "event_log": {
             k: _events.stats()[k] for k in ("ring_size", "deep")
@@ -212,6 +218,52 @@ def _measure_frontier(step_samples: int, emission_samples: int,
         "overhead_frac": round(cost / round_s if round_s > 0 else 0.0, 4),
         "n_vars": n_vars,
         "n_replicas": n_replicas,
+        "dispatches_per_round": dispatches,
+    }
+
+
+def _measure_ledger(emission_samples: int, step_s: float, round_s: float,
+                    dispatches_per_round: int) -> dict:
+    """Kernel-cost-ledger arm of the guard: one ``ledger.record`` per
+    dispatch is the ONLY cost the roofline observatory adds to the hot
+    path (its timing fences reuse syncs the dispatch already performs),
+    so the guard prices the record itself — the analytic-model compute,
+    the locked dict update, and its amortized share of the sampled
+    gauge refresh (every ``SAMPLE_EVERY``-th record runs the
+    ``gossip.ledger_sample`` span + gauge sets; the loop is long enough
+    to include those ticks). A dense round books ONE store record; a
+    planned frontier round books one per group dispatch."""
+    prev = _registry.enabled()
+    ledger = _roofline.get_ledger()
+    # consume the signature's compile-bucket slot outside the clock so
+    # the measured loop prices the steady-state path
+    ledger.record("rows", "OverheadProbe", n_replicas=1024, fanout=3,
+                  seconds=1e-6, row_bytes=64, rows=16)
+
+    def record_pass(flag: bool) -> float:
+        _registry.set_enabled(flag)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(emission_samples):
+                ledger.record(
+                    "rows", "OverheadProbe", n_replicas=1024, fanout=3,
+                    seconds=1e-6, row_bytes=64, rows=16,
+                )
+            return (time.perf_counter() - t0) / emission_samples
+        finally:
+            _registry.set_enabled(prev)
+
+    cost = max(0.0, record_pass(True) - record_pass(False))
+    per_round = cost * max(dispatches_per_round, 1)
+    return {
+        "cost_per_record_s": round(cost, 9),
+        "dense_overhead_frac": round(
+            cost / step_s if step_s > 0 else 0.0, 4
+        ),
+        "frontier_overhead_frac": round(
+            per_round / round_s if round_s > 0 else 0.0, 4
+        ),
+        "emission_samples": emission_samples,
     }
 
 

@@ -1,0 +1,484 @@
+"""Roofline observatory: analytic per-kernel traffic model + cost ledger.
+
+The perf trajectory was blind (ISSUE 6): ``roofline_GBps`` /
+``roofline_frac`` were null in every BENCH artifact and nothing
+attributed wall time to the kernels the plan compiler actually
+dispatches. This module is the instrument:
+
+- :func:`kernel_traffic` — the **analytic traffic model**: bytes moved
+  and joins performed per dispatch for every gossip kernel family
+  (dense, shift, frontier row-sparse, grouped rows/dense, fused
+  windows, chaos stacked-mask, partitioned boundary exchange), derived
+  from the same ``(codec, spec, R, fanout, bucket, G_active)`` tuple
+  ``mesh.plan.signature_of`` keys kernels by — the JITSPMM observation
+  (PAPERS.md) that cost accounting must live at the specialization
+  granularity, not per run.
+- :class:`KernelLedger` — the **cost ledger**: per kernel-signature
+  dispatch counts, rounds, analytic bytes, joins, and wall seconds
+  (fed by the runtime's dispatch sites, whose ``block_until_ready``
+  syncs already close each timing window), yielding achieved GB/s and
+  roofline fraction per signature against the capability registry
+  (:mod:`.capability`). Sampled gauge refreshes
+  (``roofline_achieved_GBps{kernel}`` / ``roofline_frac{kernel}``,
+  under the ``gossip.ledger_sample`` span) keep the per-record cost a
+  dict update — the overhead guard (:mod:`.overhead`) prices exactly
+  this path.
+- :func:`profile_capture` — the ``jax.profiler`` trace-capture hook:
+  wraps any scenario callable into a Perfetto-openable trace directory.
+
+Two byte conventions, deliberately distinct (docs/OBSERVABILITY.md):
+
+- ``bytes_moved`` — the *ideal-traffic* roofline convention: ``(fanout
+  + 2)`` row-moves per touched row (read own + gathered neighbors +
+  write), the convention the bench headline has always used. This is
+  what achieved GB/s divides.
+- ``xla_lo`` / ``xla_hi`` — calibrated bounds on what
+  ``jit(...).lower(...).compile().cost_analysis()["bytes accessed"]``
+  reports for the same dispatch (operand+output buffers per post-fusion
+  instruction: leafwise codecs fuse to exactly operands-once, generic
+  vclock merges materialize per-column intermediates, row-sparse
+  scatters pay the full-state read+write twice). The cross-check test
+  (tests/telemetry/test_roofline.py) asserts ``xla_lo <= cost_analysis
+  <= xla_hi`` across leafwise / vclock / packed codecs.
+
+The ledger's lifetime follows the registry generation (like the
+runtime's instrument caches): ``telemetry.reset()`` and
+``registry.scratch_registry()`` detach it, so measurement harnesses
+never pollute live attribution.
+
+No jax at module scope (the telemetry import contract);
+:func:`profile_capture` imports it lazily.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+
+from . import registry as _registry
+from .capability import device_capability
+from .spans import span
+
+#: neighbor/row index tables ride int32 on the wire
+_IDX_BYTES = 4
+
+#: every kernel family the model covers (tests pin the vocabulary)
+FAMILIES = (
+    "dense",
+    "shift",
+    "rows",
+    "grouped_dense",
+    "grouped_rows",
+    "step",
+    "fused_block",
+    "converge",
+    "chaos_window",
+    "boundary_exchange",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficEstimate:
+    """One dispatch's analytic traffic: see the module docstring for
+    the two byte conventions."""
+
+    bytes_moved: int
+    xla_lo: int
+    xla_hi: int
+    joins: int
+
+
+def state_row_bytes(states, n_replicas: int) -> int:
+    """Per-replica-row state footprint of a live ``[R, ...]``
+    population, from leaf shape/dtype metadata only (never pulls device
+    buffers — the ``rows_traffic_bytes`` discipline)."""
+    import numpy as np
+
+    total = 0
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(states)
+    except Exception:
+        leaves = [states]
+    for leaf in leaves:
+        dt = getattr(leaf, "dtype", None)
+        size = getattr(leaf, "size", None)
+        if dt is None or size is None:
+            arr = np.asarray(leaf)
+            dt, size = arr.dtype, arr.size
+        total += int(size) * int(dt.itemsize)
+    return total // max(int(n_replicas), 1)
+
+
+def kernel_traffic(
+    family: str,
+    *,
+    row_bytes: int,
+    n_replicas: int,
+    fanout: int,
+    rows: "int | None" = None,
+    g_active: int = 1,
+    window: int = 1,
+    leafwise: bool = True,
+    exchange_rows: int = 0,
+    n_vars: int = 1,
+) -> TrafficEstimate:
+    """Analytic traffic of ONE dispatch of ``family`` (see
+    :data:`FAMILIES`). ``rows`` is the row-sparse bucket (pad slots
+    move bytes too — they are real gather/scatter slots), ``g_active``
+    the stacked group width, ``window`` the fused round count,
+    ``exchange_rows`` the boundary-exchange row total for the
+    partitioned family, ``n_vars`` the store width for the whole-store
+    families (``step`` / ``fused_block`` / ``converge`` /
+    ``chaos_window``, where ``row_bytes`` is the whole STORE's
+    per-replica footprint)."""
+    if family not in FAMILIES:
+        raise ValueError(f"unknown kernel family {family!r} "
+                         f"(expected one of {FAMILIES})")
+    R, K, G, T = int(n_replicas), int(fanout), int(g_active), int(window)
+    S = int(row_bytes) * R  # one member's full-population footprint
+    N = R * K * _IDX_BYTES  # the neighbor table
+    pad = 4096  # small-constant fusion slack (scalars, predicates)
+
+    if family in ("dense", "shift"):
+        ntab = 0 if family == "shift" else N
+        moved = (K + 2) * S
+        lo = 2 * S + ntab
+        hi = (
+            round(1.15 * (2 * S + ntab)) + pad
+            if leafwise
+            else (2 + K) * S + ntab + pad
+        )
+        return TrafficEstimate(moved, lo, hi, R * K)
+
+    if family == "rows":
+        F = int(rows or 0)
+        moved = (K + 2) * F * int(row_bytes) + F * (K + 2) * _IDX_BYTES
+        # measured shape (CPU cost_analysis): ~4S for the scatter's full
+        # read+write on top of the base read, + per-row working buffers
+        lo = 2 * S
+        hi = 4 * S + N + (2 * K + 4) * F * int(row_bytes) + F * 64 + pad
+        return TrafficEstimate(moved, lo, hi, F * K)
+
+    if family == "grouped_dense":
+        moved = G * (K + 2) * S
+        lo = 2 * G * S + N
+        hi = (
+            round(1.15 * (2 * G * S + N)) + pad
+            if leafwise
+            else (2 + K) * G * S + N + pad
+        )
+        return TrafficEstimate(moved, lo, hi, G * R * K)
+
+    if family == "grouped_rows":
+        F = int(rows or 0)
+        moved = G * ((K + 2) * F * int(row_bytes) + F * (K + 2) * _IDX_BYTES)
+        lo = 2 * G * S
+        # the vmapped rows kernel pays ~1.5x the single-var rows cost
+        # per member (batched gathers materialize per-member full-state
+        # intermediates — measured on the CPU backend)
+        hi = (
+            G * (6 * S + (2 * K + 6) * F * int(row_bytes) + F * 64)
+            + N + 4 * pad
+        )
+        return TrafficEstimate(moved, lo, hi, G * F * K)
+
+    if family in ("step", "fused_block", "converge", "chaos_window"):
+        # whole-store families: row_bytes is the STORE's per-replica
+        # footprint; the mask operand of a chaos window adds R*K bools
+        # per round
+        per_round = (K + 2) * S
+        mask = R * K if family == "chaos_window" else 0
+        moved = T * (per_round + mask)
+        lo = T * (2 * S)
+        hi = T * ((2 + K) * S + N + mask) + pad
+        return TrafficEstimate(moved, lo, hi, T * R * K * int(n_vars))
+
+    # boundary_exchange: the partitioned round's wire+local traffic —
+    # local read+write of the population plus the cut rows crossing the
+    # collective twice (send + receive)
+    moved = 2 * S + 2 * int(exchange_rows) * int(row_bytes) + N
+    lo = 2 * S
+    hi = (2 + K) * S + N + 2 * int(exchange_rows) * int(row_bytes) + pad
+    return TrafficEstimate(moved, lo, hi, R * K)
+
+
+def cost_analysis_bytes(compiled) -> "float | None":
+    """``bytes accessed`` from a compiled executable's cost analysis,
+    or None where the backend provides none (the cross-check is
+    best-effort by contract)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    v = ca.get("bytes accessed")
+    return float(v) if v is not None else None
+
+
+# ---------------------------------------------------------------------------
+# the kernel cost ledger
+# ---------------------------------------------------------------------------
+
+
+class KernelLedger:
+    """Per-kernel-signature cost attribution: dispatches, rounds,
+    analytic bytes, joins, wall seconds -> achieved GB/s and roofline
+    fraction. ``record`` is the hot path (a dict update under one
+    lock); every ``SAMPLE_EVERY``-th dispatch of a signature refreshes
+    that signature's gauges under the ``gossip.ledger_sample`` span."""
+
+    #: gauge refresh cadence per signature (the first dispatch always
+    #: samples, so short runs still export)
+    SAMPLE_EVERY = 16
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._kernels: dict = {}
+        self._totals = {
+            "dispatches": 0, "rounds": 0, "bytes": 0, "joins": 0,
+            "seconds": 0.0, "compile_seconds": 0.0,
+        }
+
+    @staticmethod
+    def _label(family, codec_name, n_replicas, fanout, rows, g_active):
+        lab = f"{family}:{codec_name}:R{n_replicas}k{fanout}"
+        if rows:
+            lab += f":b{rows}"
+        if g_active > 1:
+            lab += f":G{g_active}"
+        return lab
+
+    def record(
+        self,
+        family: str,
+        codec_name: str,
+        *,
+        n_replicas: int,
+        fanout: int,
+        seconds: float,
+        row_bytes: int = 0,
+        rows: "int | None" = None,
+        g_active: int = 1,
+        window: int = 1,
+        leafwise: bool = True,
+        bytes_moved: "int | None" = None,
+        joins: "int | None" = None,
+        rounds: "int | None" = None,
+        n_vars: int = 1,
+    ) -> None:
+        """Attribute one dispatch. ``bytes_moved``/``joins`` override
+        the analytic model where the caller already holds the exact
+        figure (the whole-store step's ``round_traffic_bytes``)."""
+        if not _registry.enabled():
+            return
+        if bytes_moved is None or joins is None:
+            est = kernel_traffic(
+                family, row_bytes=row_bytes, n_replicas=n_replicas,
+                fanout=fanout, rows=rows, g_active=g_active, window=window,
+                leafwise=leafwise, n_vars=n_vars,
+            )
+            if bytes_moved is None:
+                bytes_moved = est.bytes_moved
+            if joins is None:
+                joins = est.joins
+        rounds = int(window if rounds is None else rounds)
+        label = self._label(
+            family, codec_name, int(n_replicas), int(fanout),
+            int(rows or 0), int(g_active),
+        )
+        with self._lock:
+            ent = self._kernels.get(label)
+            if ent is None:
+                ent = self._kernels[label] = {
+                    "kernel": label,
+                    "family": family,
+                    "codec": codec_name,
+                    "n_replicas": int(n_replicas),
+                    "fanout": int(fanout),
+                    "bucket": int(rows or 0),
+                    "g_active": int(g_active),
+                    "dispatches": 0,
+                    "rounds": 0,
+                    "bytes": 0,
+                    "joins": 0,
+                    "seconds": 0.0,
+                    "compile_dispatches": 0,
+                    "compile_seconds": 0.0,
+                }
+            if ent["dispatches"] == 0 and ent["compile_dispatches"] == 0:
+                # a signature's FIRST dispatch carries trace+compile
+                # time: bank it separately so achieved GB/s reflects
+                # warm dispatches only (the roofline question), never a
+                # one-off XLA compile
+                ent["compile_dispatches"] += 1
+                ent["compile_seconds"] += float(seconds)
+                self._totals["compile_seconds"] = (
+                    self._totals.get("compile_seconds", 0.0) + float(seconds)
+                )
+                return
+            ent["dispatches"] += 1
+            ent["rounds"] += rounds
+            ent["bytes"] += int(bytes_moved)
+            ent["joins"] += int(joins)
+            ent["seconds"] += float(seconds)
+            tot = self._totals
+            tot["dispatches"] += 1
+            tot["rounds"] += rounds
+            tot["bytes"] += int(bytes_moved)
+            tot["joins"] += int(joins)
+            tot["seconds"] += float(seconds)
+            do_sample = ent["dispatches"] % self.SAMPLE_EVERY == 1
+            if do_sample:
+                sample = dict(ent)
+        if do_sample:
+            self._sample(sample)
+
+    @staticmethod
+    def _rates(ent) -> "tuple[float | None, float | None]":
+        secs = ent["seconds"]
+        if secs <= 0:
+            return None, None
+        gbps = ent["bytes"] / secs / 1e9
+        peak = device_capability().get("peak_GBps")
+        frac = (gbps / peak) if peak else None
+        return round(gbps, 3), (round(frac, 4) if frac is not None else None)
+
+    def _sample(self, ent) -> None:
+        """One sampled gauge refresh for a signature (the throttled
+        export path — the per-record cost must never include a registry
+        walk per dispatch). Uses the NON-BLOCKING cached peak: the
+        one-shot host-bandwidth probe belongs to read surfaces (CLI /
+        bench / health), never a dispatch path."""
+        from .capability import cached_peak_gbps
+
+        with span("gossip.ledger_sample", kernel=ent["kernel"]):
+            secs = ent["seconds"]
+            if secs <= 0:
+                return
+            gbps = round(ent["bytes"] / secs / 1e9, 3)
+            _registry.gauge(
+                "roofline_achieved_GBps",
+                help="achieved GB/s per kernel signature (analytic "
+                     "bytes over ledger-attributed wall time)",
+                kernel=ent["kernel"],
+            ).set(gbps)
+            peak = cached_peak_gbps()
+            if peak:
+                _registry.gauge(
+                    "roofline_frac",
+                    help="achieved GB/s over the capability registry's "
+                         "roofline denominator, per kernel signature",
+                    kernel=ent["kernel"],
+                ).set(round(gbps / peak, 4))
+
+    def totals(self) -> dict:
+        """Whole-ledger accumulators (bench arms diff this around a
+        measured region to attribute bytes to the region)."""
+        with self._lock:
+            return dict(self._totals)
+
+    def snapshot(self) -> list:
+        """Per-signature table (most wall time first), each row carrying
+        achieved GB/s + roofline fraction against the current
+        capability."""
+        with self._lock:
+            rows = [dict(e) for e in self._kernels.values()]
+        for ent in rows:
+            gbps, frac = self._rates(ent)
+            ent["achieved_GBps"] = gbps
+            ent["roofline_frac"] = frac
+        rows.sort(key=lambda e: -e["seconds"])
+        return rows
+
+    def summary(self, top: int = 8) -> dict:
+        """The health-view condensation (``ConvergenceMonitor.health()
+        ["roofline"]``)."""
+        rows = self.snapshot()
+        tot = self.totals()
+        gbps = (
+            round(tot["bytes"] / tot["seconds"] / 1e9, 3)
+            if tot["seconds"] > 0 else None
+        )
+        cap = device_capability() if rows else None
+        peak = cap.get("peak_GBps") if cap else None
+        return {
+            "kernels": [
+                {
+                    k: ent[k]
+                    for k in ("kernel", "family", "dispatches", "rounds",
+                              "bytes", "seconds", "achieved_GBps",
+                              "roofline_frac")
+                }
+                for ent in rows[:top]
+            ],
+            "totals": tot,
+            "achieved_GBps": gbps,
+            "peak_GBps": peak,
+            "roofline_frac": (
+                round(gbps / peak, 4) if gbps and peak else None
+            ),
+        }
+
+
+_ledger: "KernelLedger | None" = None
+_ledger_gen: "int | None" = None
+_ledger_lock = threading.Lock()
+
+
+def get_ledger() -> KernelLedger:
+    """The process-global ledger. Its lifetime follows the registry
+    generation: ``telemetry.reset()`` / ``scratch_registry()`` detach
+    it (a fresh ledger appears), so measurement harnesses never bleed
+    synthetic dispatches into live attribution. Creation is locked: a
+    stepping thread and a health-scrape thread racing the first access
+    after a generation bump must agree on ONE instance, or one side's
+    records would silently vanish."""
+    global _ledger, _ledger_gen
+    gen = _registry.generation()
+    led = _ledger
+    if led is not None and _ledger_gen == gen:
+        return led
+    with _ledger_lock:
+        if _ledger is None or _ledger_gen != gen:
+            _ledger = KernelLedger()
+            _ledger_gen = gen
+        return _ledger
+
+
+# ---------------------------------------------------------------------------
+# profiler capture hook
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def profile_capture(log_dir: str = "./profile_capture"):
+    """Wrap a region in a ``jax.profiler`` trace — the whole-scenario
+    capture hook (`with profile_capture("/tmp/t"): scenario()`), open
+    the resulting directory in Perfetto / TensorBoard. Yields the
+    trace directory. Requires jax (imported lazily — using the hook IS
+    opting into a backend)."""
+    import jax
+
+    with jax.profiler.trace(str(log_dir)):
+        yield str(log_dir)
+
+
+def capture_scenario(fn, log_dir: str = "./profile_capture", **kwargs):
+    """Run ``fn(**kwargs)`` under :func:`profile_capture`; returns
+    ``(result, trace_dir)`` — the one-call form for scenario
+    callables (``capture_scenario(frontier_sparse)``)."""
+    t0 = time.perf_counter()
+    with profile_capture(log_dir) as d:
+        out = fn(**kwargs)
+    _registry.histogram(
+        "profile_capture_seconds",
+        help="wall time of whole-scenario jax.profiler captures",
+    ).observe(time.perf_counter() - t0)
+    return out, d

@@ -20,3 +20,10 @@ def test_telemetry_overhead_under_5_percent():
     # amortized to pre-resolved instruments + skip-if-unchanged
     assert out["frontier"]["round_seconds"] > 0
     assert out["frontier"]["overhead_frac"] < 0.05, out["frontier"]
+    # kernel-cost-ledger arm: one ledger.record per dispatch (its
+    # timing fences reuse the dispatch's own sync) must stay under the
+    # budget on BOTH the dense step (1 record/round) and the planned
+    # frontier round (1 record per group dispatch)
+    assert out["ledger"]["cost_per_record_s"] >= 0
+    assert out["ledger"]["dense_overhead_frac"] < 0.05, out["ledger"]
+    assert out["ledger"]["frontier_overhead_frac"] < 0.05, out["ledger"]

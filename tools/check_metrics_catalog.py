@@ -18,6 +18,11 @@ fails the Makefile ``verify`` target):
   are checked by their literal prefix against templated rows like
   ``merge.<crdt_type>``. Every cataloged span row must still have an
   emission site.
+- **probe-report schema** — the key tuples declared in
+  ``lasp_tpu/telemetry/capability.py`` (``PROBE_REPORT_KEYS`` /
+  ``PROBE_ATTEMPT_KEYS``, parsed statically) must match the "Probe
+  report schema" table rows, both ways — the hardened TPU capture
+  path's artifact contract.
 
 Dynamic metric/event names are invisible to this lint and therefore
 forbidden by convention (docs/OBSERVABILITY.md).
@@ -36,9 +41,10 @@ SRC = os.path.join(REPO, "lasp_tpu")
 DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
 #: a literal metric emission: counter("name"... / gauge('name'... /
-#: histogram("name"...
+#: histogram("name"...  (mixed case allowed after the first char:
+#: unit-suffixed names like roofline_achieved_GBps)
 _EMIT_METRIC = re.compile(
-    r"""\b(?:counter|gauge|histogram)\(\s*['"]([a-z][a-z0-9_]*)['"]"""
+    r"""\b(?:counter|gauge|histogram)\(\s*['"]([a-z][a-zA-Z0-9_]*)['"]"""
 )
 
 #: a literal event emission: events.emit("type"... / events.emit_deep(
@@ -53,7 +59,7 @@ _SPAN_LITERAL = re.compile(r"""\bspan\(\s*['"]([a-z][a-z0-9_.]*)['"]""")
 _SPAN_FPREFIX = re.compile(r"""\bspan\(\s*f['"]([a-z][a-z0-9_.]*)\{""")
 
 #: a catalog row: a markdown table line whose first cell is `name`
-_ROW = re.compile(r"^\|\s*`([a-z][a-z0-9_.<>]*)`\s*\|")
+_ROW = re.compile(r"^\|\s*`([a-z][a-zA-Z0-9_.<>]*)`\s*\|")
 
 #: EVENT_TYPES members in telemetry/events.py: "name",  # comment
 _EVENT_TYPE_DECL = re.compile(r"""^\s*['"]([a-z][a-z0-9_]*)['"],""")
@@ -101,15 +107,39 @@ def declared_event_types() -> set:
     return names
 
 
+def declared_probe_keys() -> set:
+    """PROBE_REPORT_KEYS + PROBE_ATTEMPT_KEYS members, parsed statically
+    from telemetry/capability.py (same no-import rule as the event
+    types)."""
+    path = os.path.join(SRC, "telemetry", "capability.py")
+    names: set = set()
+    key_decl = re.compile(r"""^\s*['"]([a-z][a-z0-9_]*)['"],""")
+    with open(path, encoding="utf-8") as fp:
+        in_block = False
+        for line in fp:
+            if re.match(r"^PROBE_(REPORT|ATTEMPT)_KEYS = \($", line):
+                in_block = True
+                continue
+            if in_block:
+                if line.strip().startswith(")"):
+                    in_block = False
+                    continue
+                m = key_decl.match(line)
+                if m:
+                    names.add(m.group(1))
+    return names
+
+
 def cataloged() -> dict:
     """Doc rows per section: {"metrics": set, "events": set,
-    "spans": set} — section-aware so `bind` the event type can never be
-    confused with a metric row."""
+    "spans": set, "probe": set} — section-aware so `bind` the event
+    type can never be confused with a metric row."""
     if not os.path.exists(DOC):
         print(f"check_metrics_catalog: {DOC} does not exist", file=sys.stderr)
         sys.exit(1)
     section = None
-    out = {"metrics": set(), "events": set(), "spans": set()}
+    out = {"metrics": set(), "events": set(), "spans": set(),
+           "probe": set()}
     with open(DOC, encoding="utf-8") as fp:
         for line in fp:
             if line.startswith("##"):
@@ -120,6 +150,8 @@ def cataloged() -> dict:
                     section = "events"
                 elif "span taxonomy" in title:
                     section = "spans"
+                elif "probe report schema" in title:
+                    section = "probe"
                 else:
                     section = None
                 continue
@@ -224,13 +256,30 @@ def main() -> int:
             + "\n  ".join(span_stale)
         )
 
+    probe_declared = declared_probe_keys()
+    probe_missing_doc = sorted(probe_declared - docs["probe"])
+    if probe_missing_doc:
+        problems.append(
+            "probe-report keys declared in telemetry/capability.py but "
+            "MISSING from the Probe report schema table:\n  "
+            + "\n  ".join(probe_missing_doc)
+        )
+    probe_stale = sorted(docs["probe"] - probe_declared)
+    if probe_stale:
+        problems.append(
+            "probe-report keys cataloged but absent from "
+            "PROBE_REPORT_KEYS/PROBE_ATTEMPT_KEYS (stale rows):\n  "
+            + "\n  ".join(probe_stale)
+        )
+
     if problems:
         print("\n".join(problems))
         return 1
     print(
         f"telemetry catalog OK ({len(code['metrics'])} metrics, "
         f"{len(code['events'])} event types, "
-        f"{len(docs['spans'])} span rows; code == docs)"
+        f"{len(docs['spans'])} span rows, "
+        f"{len(probe_declared)} probe-report keys; code == docs)"
     )
     return 0
 

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Roofline-observatory smoke (Makefile ``verify``): a small mixed-codec
+scenario must produce a NON-NULL roofline fraction for every warm kernel
+signature, the new ``roofline_*`` / ``capability_*`` metrics and the
+``gossip.ledger_sample`` span must be live AND cataloged
+(docs/OBSERVABILITY.md), and the probe-report schema keys must lint both
+ways — the fast guard that the perf instrument of ISSUE 6 cannot
+silently go blind again."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _load_lint():
+    path = os.path.join(REPO, "tools", "check_metrics_catalog.py")
+    spec = importlib.util.spec_from_file_location("catalog_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> int:
+    from lasp_tpu.telemetry import device_capability, get_ledger
+    from lasp_tpu.telemetry import registry as reg
+    from lasp_tpu.telemetry import spans
+
+    # -- capability: a real denominator on every backend --------------------
+    cap = device_capability()
+    assert cap["peak_GBps"] is not None and cap["peak_GBps"] > 0, cap
+    assert cap["source"] in ("pinned", "measured-host"), cap
+
+    # -- drive every ledger-fed family on a mixed-codec store (the ONE
+    # shared workload the `roofline` CLI verb also drives) -------------------
+    from lasp_tpu.bench_scenarios import roofline_workload
+
+    roofline_workload(n_replicas=128, n_vars=9, rounds=2)
+
+    ledger = get_ledger()
+    snap = ledger.snapshot()
+    warm = [e for e in snap if e["dispatches"] > 0]
+    assert warm, "ledger recorded no warm dispatches"
+    families = {e["family"] for e in warm}
+    assert "step" in families and "fused_block" in families, families
+    assert families & {"rows", "grouped_rows", "grouped_dense"}, families
+    for e in warm:
+        assert e["achieved_GBps"] is not None and e["achieved_GBps"] >= 0, e
+        assert e["roofline_frac"] is not None, (
+            f"null roofline_frac for {e['kernel']} — the exact blindness "
+            "this PR removes"
+        )
+    summary = ledger.summary()
+    assert summary["roofline_frac"] is not None, summary
+
+    # -- metrics + span actually exported -----------------------------------
+    names = reg.get_registry().names()
+    for metric in ("roofline_achieved_GBps", "roofline_frac",
+                   "capability_peak_GBps"):
+        assert metric in names, f"{metric} not in the live registry"
+    assert any(
+        e["name"] == "gossip.ledger_sample" for e in spans.events()
+    ), "no gossip.ledger_sample span emitted"
+
+    # -- catalog lint: the new names + probe schema must be documented ------
+    lint = _load_lint()
+    docs = lint.cataloged()
+    for metric in ("roofline_achieved_GBps", "roofline_frac",
+                   "capability_peak_GBps"):
+        assert metric in docs["metrics"], f"{metric} not cataloged"
+    assert "gossip.ledger_sample" in docs["spans"]
+    declared = lint.declared_probe_keys()
+    assert declared == docs["probe"], (
+        "probe-report schema drift", declared ^ docs["probe"]
+    )
+
+    print(
+        f"roofline smoke OK: {len(warm)} warm kernel signatures, "
+        f"peak {cap['peak_GBps']} GB/s ({cap['source']}), "
+        f"achieved {summary['achieved_GBps']} GB/s "
+        f"(frac {summary['roofline_frac']}); catalog in sync"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

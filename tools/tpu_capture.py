@@ -75,19 +75,56 @@ def _terminate(proc: subprocess.Popen) -> None:
         proc.wait()
 
 
+def _classify_attempt(attempt: int, rc: "int | None", stderr_path: str,
+                      saw_init: bool, timed_out: bool,
+                      budget_killed: bool = False) -> None:
+    """Persist a CLASSIFIED probe record for a failed attempt (the
+    schema bench.py's probe_report uses — lasp_tpu.telemetry.capability,
+    which never imports jax): the child's stderr used to vanish into
+    DEVNULL, leaving a wedge indistinguishable from an import error."""
+    try:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        from lasp_tpu.telemetry.capability import (
+            PROBE_TIMEOUT_RC,
+            classify_probe_attempt,
+        )
+
+        stderr = ""
+        if os.path.exists(stderr_path):
+            with open(stderr_path, errors="replace") as f:
+                stderr = f.read()[-8000:]
+        rec, _platforms = classify_probe_attempt(
+            PROBE_TIMEOUT_RC if timed_out else (rc if rc is not None else 1),
+            "", stderr, budget_exceeded=budget_killed,
+        )
+        rec["attempt"] = attempt
+        rec["saw_init"] = saw_init
+        with open(JSONL, "a") as f:
+            f.write(json.dumps({"stage": "probe_report", **rec}) + "\n")
+        log(f"attempt {attempt}: classified {rec['classification']} "
+            f"fatal={rec['fatal']!r}")
+    except Exception as exc:  # classification must never kill the watcher
+        log(f"attempt {attempt}: classification failed: {exc}")
+
+
 def attempt_once(attempt: int) -> bool:
     """One probe+capture child. True iff the headline stage captured."""
     offset = os.path.getsize(JSONL) if os.path.exists(JSONL) else 0
     env = dict(os.environ)
     env["LASP_ONESHOT_BUDGET"] = str(CAPTURE_BUDGET_S)
+    os.makedirs(OUT, exist_ok=True)
+    stderr_path = os.path.join(OUT, f"attempt_{attempt}.stderr")
+    stderr_f = open(stderr_path, "w")
     proc = subprocess.Popen(
         [sys.executable, os.path.join("tools", "tpu_oneshot.py")],
         cwd=REPO, env=env, text=True,
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        stdout=subprocess.DEVNULL, stderr=stderr_f,
     )
     t0 = time.monotonic()
     saw_init = False
     headline_ok = False
+    budget_killed = False
     while proc.poll() is None:
         time.sleep(5)
         records, offset = _new_lines(offset)
@@ -109,9 +146,13 @@ def attempt_once(attempt: int) -> bool:
             log(f"attempt {attempt}: no init after {INIT_TIMEOUT_S}s — "
                 "wedged connect, terminating child")
             _terminate(proc)
+            stderr_f.close()
+            _classify_attempt(attempt, proc.returncode, stderr_path,
+                              saw_init=False, timed_out=True)
             return False
         if now - t0 > CAPTURE_BUDGET_S + 120:
             log(f"attempt {attempt}: budget exceeded, terminating child")
+            budget_killed = True
             _terminate(proc)
             break
     records, offset = _new_lines(offset)
@@ -120,8 +161,13 @@ def attempt_once(attempt: int) -> bool:
             headline_ok = "error" not in rec
         if rec.get("stage"):
             log(f"attempt {attempt}: stage {rec.get('stage')} recorded (final)")
+    stderr_f.close()
     log(f"attempt {attempt}: child exited rc={proc.returncode} "
         f"headline_ok={headline_ok}")
+    if not headline_ok:
+        _classify_attempt(attempt, proc.returncode, stderr_path,
+                          saw_init=saw_init, timed_out=False,
+                          budget_killed=budget_killed)
     return headline_ok
 
 

@@ -41,10 +41,9 @@ OUT_PATH = os.path.join(
     os.environ.get("LASP_ONESHOT_NAME", "oneshot_r05.jsonl"),
 )
 
-_ROOFLINE_GBPS = (
-    ("v6", 1638.0), ("v5p", 2765.0), ("v5e", 819.0), ("v5 lite", 819.0),
-    ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0),
-)
+# peak-bandwidth lookups live in the capability registry
+# (lasp_tpu/telemetry/capability.py) — one table for bench, oneshot,
+# and the kernel cost ledger
 
 
 def emit(stage: str, record: dict) -> None:
@@ -59,20 +58,40 @@ def main() -> int:
     t_start = time.monotonic()
     budget = float(os.environ.get("LASP_ONESHOT_BUDGET", "3600"))
 
-    import jax  # the ONE backend connect of this process
+    try:
+        import jax  # the ONE backend connect of this process
 
-    dev = jax.devices()[0]
+        dev = jax.devices()[0]
+    except BaseException as exc:
+        # a failed connect used to die silently (stdout DEVNULL'd under
+        # the watcher); persist a CLASSIFIED record instead — the same
+        # schema the bench probe report uses
+        import traceback
+
+        from lasp_tpu.telemetry.capability import classify_probe_attempt
+
+        tb = traceback.format_exc()
+        rec, _platforms = classify_probe_attempt(1, "", tb)
+        rec["attempt"] = 1
+        rec["seconds"] = round(time.monotonic() - t_start, 1)
+        emit("init", {"error": f"{type(exc).__name__}: {exc}",
+                      "probe_attempt": rec})
+        return 1
+
+    from lasp_tpu.telemetry.capability import device_capability
+
     kind = str(getattr(dev, "device_kind", dev.platform))
     if dev.platform == "cpu":
-        emit("init", {"error": "platform is cpu; nothing to capture"})
+        emit("init", {"error": "platform is cpu; nothing to capture",
+                      "platforms_seen": sorted(
+                          {str(d.platform) for d in jax.devices()}
+                      )})
         return 1
-    roofline = None
-    for sub, gbps in _ROOFLINE_GBPS:
-        if sub in kind.lower():
-            roofline = gbps
-            break
+    cap = device_capability()
+    roofline = cap["peak_GBps"]
     emit("init", {"platform": dev.platform, "device_kind": kind,
-                  "roofline_GBps": roofline})
+                  "roofline_GBps": roofline,
+                  "capability_source": cap["source"]})
 
     import numpy as np
 
@@ -178,10 +197,11 @@ def main() -> int:
         # child; _dryrun_inline over jax.devices()[:1] runs the SAME
         # sharded lowering (pjit step + shard_map gossip + comm-mesh
         # round, value-asserted) on the real chip
-        ge._dryrun_inline(1)
+        evidence = ge._dryrun_inline(1)
         emit("sharded_step", {
             "n_devices": 1, "ok": True,
             "seconds": round(time.perf_counter() - t0, 2),
+            "evidence": evidence,
             "note": "sharded fused step + shard_map gossip + comm-mesh "
                     "round on the real chip (collectives degenerate at "
                     "n=1; lowering and execution are the claim)",
