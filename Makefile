@@ -29,7 +29,9 @@ test-slow:
 # soak guards the convergence-under-failure invariants (post-heal
 # bit-equality + replay determinism, docs/RESILIENCE.md), a roofline
 # smoke guards the cost ledger's non-null fractions + the probe-report
-# schema (docs/OBSERVABILITY.md "Roofline & cost ledger"), then the
+# schema (docs/OBSERVABILITY.md "Roofline & cost ledger"), a Pallas
+# smoke guards the hand-written kernels' interpret-mode parity and the
+# winner-ships race contract (docs/PERF.md "Pallas kernels"), then the
 # non-slow tests run (the tier-1 shape)
 verify:
 	python tools/check_metrics_catalog.py
@@ -37,6 +39,7 @@ verify:
 	python tools/plan_smoke.py
 	python tools/chaos_smoke.py
 	python tools/roofline_smoke.py
+	python tools/pallas_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

@@ -1,9 +1,26 @@
-"""Compare the Pallas fused gather+join gossip kernel against the XLA path.
+"""Standalone sweep: the hand-written Pallas gossip kernels vs XLA.
 
-Run on the TPU:  python bench_pallas.py  — prints one JSON line per config.
-The Pallas kernel wins when per-replica rows are wide (large element
-universes): the XLA path materializes K gathered copies of each plane in
-HBM per round, the kernel streams rows through VMEM.
+Run on the TPU:  python bench_pallas.py  — prints one JSON line per
+config, two sweeps:
+
+- **dense**: the fused gather+join kernel (``pallas_gossip_round``) over
+  row-width configs. The kernel wins when per-replica rows are wide
+  (large element universes): the XLA path materializes K gathered copies
+  of each plane in HBM per round, the kernel streams rows through VMEM.
+- **frontier**: the row-sparse gather–join–scatter kernel
+  (``pallas_gossip_round_rows``) over a dirty-fraction × bucket × fanout
+  grid — the SpMM-shaped hot kernel of the frontier scheduler. Per
+  config both arms' achieved GB/s and HBM roofline fraction come from
+  the analytic traffic model + capability registry
+  (``telemetry.roofline.kernel_traffic`` / ``capability
+  .device_capability``) — the same denominators the cost ledger and the
+  bench artifacts use, never ad-hoc byte math — and every dispatch
+  feeds the kernel ledger, so a ``lasp_tpu roofline`` after a sweep
+  attributes the sweep's traffic per signature.
+
+In-process (CPU) the script refuses: Mosaic only compiles on TPU, and
+interpret-mode timings would be the emulator's, not the kernel's.
+Parity for both kernels is asserted per config against the XLA round.
 """
 
 from __future__ import annotations
@@ -16,11 +33,209 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def main():
+def _roofline(bytes_moved: int, secs: float, peak: "float | None") -> dict:
+    from lasp_tpu.bench_scenarios import roofline_entry
+
+    return roofline_entry(bytes_moved, secs, peak)
+
+
+def _seed_states(spec, n):
     from lasp_tpu.lattice.base import replicate
+    from lasp_tpu.ops import PackedORSet
+
+    states = replicate(PackedORSet.new(spec), n)
+    return jax.vmap(
+        lambda i, s: PackedORSet.add(
+            spec, s, i % spec.n_elems, i % spec.n_actors
+        )
+    )(jnp.arange(n), states)
+
+
+def dense_sweep(peak, reps: int = 8):
     from lasp_tpu.mesh import gossip_round, random_regular
     from lasp_tpu.ops import PackedORSet, PackedORSetSpec
     from lasp_tpu.ops.pallas_gossip import flatten_plane, pallas_gossip_round
+    from lasp_tpu.telemetry import get_ledger
+    from lasp_tpu.telemetry.roofline import kernel_traffic
+
+    configs = [
+        # (replicas, n_elems, tokens-per-actor)
+        (1 << 15, 128, 32),   # wide rows: 128 elems x 8 words = 4KB/row
+        (1 << 17, 16, 8),     # medium
+        (1 << 20, 8, 4),      # the headline shape (narrow rows)
+    ]
+    k = 3
+    for n, e, tpa in configs:
+        spec = PackedORSetSpec(n_elems=e, n_actors=8, tokens_per_actor=tpa)
+        states = _seed_states(spec, n)
+        nbrs = jnp.asarray(random_regular(n, k, seed=1))
+        row_bytes = 2 * spec.n_elems * spec.n_words * 4
+
+        xla = jax.jit(lambda s, nb: gossip_round(PackedORSet, spec, s, nb))
+        jax.block_until_ready(xla(states, nbrs))
+        t0 = time.perf_counter()
+        out = states
+        for _ in range(reps):
+            out = xla(out, nbrs)
+        jax.block_until_ready(out)
+        xla_s = (time.perf_counter() - t0) / reps
+
+        fe, _ = flatten_plane(states.exists)
+        fr, _ = flatten_plane(states.removed)
+        t0 = time.perf_counter()
+        jax.block_until_ready(pallas_gossip_round(fe, fr, nbrs, block=8))
+        warmup_s = time.perf_counter() - t0
+        # two records per signature: the warm-up dispatch banks into the
+        # ledger's compile bucket (record #1 of a label always does), the
+        # timed reps land as WARM stats — so `lasp_tpu roofline` after a
+        # sweep attributes the traffic instead of showing an empty row
+        get_ledger().record(
+            "pallas_dense", "PackedORSet", n_replicas=n, fanout=k,
+            seconds=warmup_s, row_bytes=row_bytes,
+            bytes_moved=(k + 2) * n * row_bytes, joins=n * k, rounds=1,
+        )
+        t0 = time.perf_counter()
+        pe, pr = fe, fr
+        for _ in range(reps):
+            pe, pr = pallas_gossip_round(pe, pr, nbrs, block=8)
+        jax.block_until_ready((pe, pr))
+        pallas_s = (time.perf_counter() - t0) / reps
+        get_ledger().record(
+            "pallas_dense", "PackedORSet", n_replicas=n, fanout=k,
+            seconds=pallas_s * reps, row_bytes=row_bytes,
+            bytes_moved=(k + 2) * n * row_bytes * reps,
+            joins=n * k * reps, rounds=reps,
+        )
+
+        # cross-check one round
+        ref = xla(states, nbrs)
+        ref_fe, _ = flatten_plane(ref.exists)
+        one_e, _ = pallas_gossip_round(fe, fr, nbrs, block=8)
+        match = bool(jnp.all(one_e == ref_fe))
+
+        est = kernel_traffic(
+            "pallas_dense", row_bytes=row_bytes, n_replicas=n, fanout=k
+        )
+        print(
+            json.dumps(
+                {
+                    "sweep": "dense",
+                    "replicas": n,
+                    "row_bytes": row_bytes,
+                    "xla_round_s": round(xla_s, 4),
+                    "pallas_round_s": round(pallas_s, 4),
+                    "speedup": round(xla_s / pallas_s, 2),
+                    "xla": _roofline(est.bytes_moved, xla_s, peak),
+                    "pallas": _roofline(est.bytes_moved, pallas_s, peak),
+                    "match": match,
+                }
+            )
+        )
+
+
+def frontier_sweep(peak, n: int = 1 << 17, reps: int = 8):
+    """The row-sparse grid: dirty-fraction × bucket × fanout. One round
+    per rep, fresh-seeded rows per config; bucket is the pow2 pad the
+    runtime's `_frontier_bucket` would pick for that dirty count."""
+    from lasp_tpu.mesh import random_regular
+    from lasp_tpu.mesh.gossip import gossip_round_rows
+    from lasp_tpu.ops import PackedORSet, PackedORSetSpec
+    from lasp_tpu.ops.pallas_gossip import pallas_gossip_round_rows
+    from lasp_tpu.telemetry import get_ledger
+    from lasp_tpu.telemetry.roofline import kernel_traffic
+
+    spec = PackedORSetSpec(n_elems=32, n_actors=8, tokens_per_actor=8)
+    row_bytes = 2 * spec.n_elems * spec.n_words * 4
+    states = _seed_states(spec, n)
+    rng = np.random.RandomState(5)
+    for fanout in (2, 3, 8):
+        nbrs = jnp.asarray(random_regular(n, fanout, seed=2))
+        for dirty_frac in (0.001, 0.01, 0.05):
+            f = max(1, int(dirty_frac * n))
+            bucket = 16
+            while bucket < f:
+                bucket <<= 1
+            rows_np = rng.choice(n, size=f, replace=False)
+            padded = np.full(bucket, rows_np[0], dtype=np.int64)
+            padded[:f] = rows_np
+            rows = jnp.asarray(padded)
+
+            xla = jax.jit(
+                lambda s, nb, r: gossip_round_rows(
+                    PackedORSet, spec, s, nb, r
+                )
+            )
+            out = xla(states, nbrs, rows)
+            jax.block_until_ready(out[1])
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = xla(states, nbrs, rows)
+                jax.block_until_ready(out[1])
+            xla_s = (time.perf_counter() - t0) / reps
+
+            pl = jax.jit(
+                lambda s, nb, r: pallas_gossip_round_rows(
+                    PackedORSet, spec, s, nb, r
+                )
+            )
+            est = kernel_traffic(
+                "pallas_rows", row_bytes=row_bytes, n_replicas=n,
+                fanout=fanout, rows=bucket,
+            )
+            t0 = time.perf_counter()
+            pout = pl(states, nbrs, rows)
+            jax.block_until_ready(pout[1])
+            warmup_s = time.perf_counter() - t0
+            # warm-up record -> compile bucket; timed reps -> warm stats
+            # (explicit bytes/joins for ALL reps, so achieved GB/s never
+            # divides one dispatch's analytic bytes by reps' wall time)
+            get_ledger().record(
+                "pallas_rows", "PackedORSet", n_replicas=n, fanout=fanout,
+                seconds=warmup_s, row_bytes=row_bytes, rows=bucket,
+                bytes_moved=est.bytes_moved, joins=est.joins, rounds=1,
+            )
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                pout = pl(states, nbrs, rows)
+                jax.block_until_ready(pout[1])
+            pallas_s = (time.perf_counter() - t0) / reps
+            get_ledger().record(
+                "pallas_rows", "PackedORSet", n_replicas=n, fanout=fanout,
+                seconds=pallas_s * reps, row_bytes=row_bytes, rows=bucket,
+                bytes_moved=est.bytes_moved * reps, joins=est.joins * reps,
+                rounds=reps,
+            )
+
+            same = jax.tree_util.tree_map(
+                lambda a, b: bool(np.array_equal(
+                    np.asarray(a), np.asarray(b))),
+                out, pout,
+            )
+            match = all(jax.tree_util.tree_leaves(same))
+
+            print(
+                json.dumps(
+                    {
+                        "sweep": "frontier",
+                        "replicas": n,
+                        "row_bytes": row_bytes,
+                        "fanout": fanout,
+                        "dirty_frac": dirty_frac,
+                        "rows": f,
+                        "bucket": bucket,
+                        "xla_round_s": round(xla_s, 5),
+                        "pallas_round_s": round(pallas_s, 5),
+                        "speedup": round(xla_s / pallas_s, 2),
+                        "xla": _roofline(est.bytes_moved, xla_s, peak),
+                        "pallas": _roofline(est.bytes_moved, pallas_s, peak),
+                        "match": match,
+                    }
+                )
+            )
+
+
+def main():
+    from lasp_tpu.telemetry.capability import device_capability
 
     if jax.devices()[0].platform not in ("tpu", "axon"):
         # Mosaic only compiles on TPU; anywhere else we would crash in
@@ -33,59 +248,11 @@ def main():
         )
         return
 
-    configs = [
-        # (replicas, n_elems, words-per-elem tag via tokens)
-        (1 << 15, 128, 32),   # wide rows: 128 elems x 8 words = 4KB/row
-        (1 << 17, 16, 8),     # medium
-        (1 << 20, 8, 4),      # the headline shape (narrow rows)
-    ]
-    k = 3
-    for n, e, tpa in configs:
-        spec = PackedORSetSpec(n_elems=e, n_actors=8, tokens_per_actor=tpa)
-        states = replicate(PackedORSet.new(spec), n)
-        r = jnp.arange(n)
-        states = jax.vmap(
-            lambda i, s: PackedORSet.add(spec, s, i % spec.n_elems, i % spec.n_actors)
-        )(r, states)
-        nbrs = jnp.asarray(random_regular(n, k, seed=1))
-
-        xla = jax.jit(lambda s, nb: gossip_round(PackedORSet, spec, s, nb))
-        jax.block_until_ready(xla(states, nbrs))
-        t0 = time.perf_counter()
-        out = states
-        for _ in range(8):
-            out = xla(out, nbrs)
-        jax.block_until_ready(out)
-        xla_s = (time.perf_counter() - t0) / 8
-
-        fe, _ = flatten_plane(states.exists)
-        fr, _ = flatten_plane(states.removed)
-        jax.block_until_ready(pallas_gossip_round(fe, fr, nbrs, block=8))
-        t0 = time.perf_counter()
-        pe, pr = fe, fr
-        for _ in range(8):
-            pe, pr = pallas_gossip_round(pe, pr, nbrs, block=8)
-        jax.block_until_ready((pe, pr))
-        pallas_s = (time.perf_counter() - t0) / 8
-
-        # cross-check one round
-        ref = xla(states, nbrs)
-        ref_fe, _ = flatten_plane(ref.exists)
-        one_e, _ = pallas_gossip_round(fe, fr, nbrs, block=8)
-        match = bool(jnp.all(one_e == ref_fe))
-
-        print(
-            json.dumps(
-                {
-                    "replicas": n,
-                    "row_bytes": spec.n_elems * spec.n_words * 4,
-                    "xla_round_s": round(xla_s, 4),
-                    "pallas_round_s": round(pallas_s, 4),
-                    "speedup": round(xla_s / pallas_s, 2),
-                    "match": match,
-                }
-            )
-        )
+    cap = device_capability()
+    peak = cap["peak_GBps"]
+    print(json.dumps({"capability": cap}))
+    dense_sweep(peak)
+    frontier_sweep(peak)
 
 
 if __name__ == "__main__":

@@ -159,7 +159,15 @@ def gossip_round_rows(codec, spec, states, neighbors, rows, edge_mask=None,
     group's stacked dispatch carries members with fewer dirty rows than
     the group bucket — and how a fully QUIESCENT member rides a group
     round as an empty row-mask (all slots invalid, every write an exact
-    no-op) instead of forcing a dense fallback."""
+    no-op) instead of forcing a dense fallback.
+
+    This function defines the round's CONTRACT; the hand-written Mosaic
+    twin (:func:`lasp_tpu.ops.pallas_gossip.pallas_gossip_round_rows`)
+    must stay bit-identical to it — states AND changed flags — and the
+    runtime races the two per dispatch signature, shipping the winner
+    (docs/PERF.md "Pallas kernels"). Changes to the pad-slot or
+    changed-accounting semantics here must land in the Pallas kernel in
+    the same commit (tests/ops/test_pallas_rows.py is the gate)."""
     rows = jnp.asarray(rows)
     nbr_idx = neighbors[rows]  # [F, K]
     old = jax.tree_util.tree_map(lambda x: x[rows], states)

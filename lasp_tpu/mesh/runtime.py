@@ -216,6 +216,25 @@ class ReplicatedRuntime:
         #: are reachable). Autotunable per run — the frontier_sparse
         #: bench scenario derives it from measured arm timings.
         self.frontier_crossover = 0.25
+        #: Pallas row-sparse dispatch arm (ops.pallas_gossip): "auto"
+        #: races the hand-written Mosaic kernel against the XLA lowering
+        #: once per dispatch signature on non-CPU backends and ships the
+        #: winner (the dense Pallas-vs-XLA measured gate, now on the
+        #: frontier path); "off" keeps XLA unconditionally; "interpret"
+        #: races the interpret-mode emulator — CPU-runnable, for the
+        #: parity/race machinery tests and pallas_smoke only (the
+        #: emulator is orders slower, so it never wins by accident but
+        #: its timing still lands in :attr:`impl_block_seconds`).
+        self.pallas_rows_mode = "auto"
+        #: winner-ships race results per dispatch signature:
+        #: ``{label: {"xla": s, "pallas_rows": s, "winner": name}}`` —
+        #: the frontier_sparse / many_vars bench scenarios lift these
+        #: into their ``impl_block_seconds`` artifacts.
+        self.impl_block_seconds: dict = {}
+        #: which arm each cached sparse-dispatch key ships (keys of
+        #: ``_fused_steps_cache`` -> "xla" | "pallas_rows"), so the
+        #: kernel ledger attributes the dispatch to the right family
+        self._rows_arm_of: dict = {}
         #: set by shard(): states live under a NamedSharding (frontier
         #: telemetry then also reports per-shard dirty counts)
         self._frontier_shards: "int | None" = None
@@ -2684,8 +2703,37 @@ class ReplicatedRuntime:
                 )
                 return unstack_group(new_g, n_g), changed
 
-            fn = jax.jit(sparse, donate_argnums=self._frontier_donate())
+            arms = {
+                "xla": jax.jit(sparse, donate_argnums=self._frontier_donate())
+            }
+            interp = self._pallas_rows_interpret(
+                codec, spec, self.states[var_ids[0]]
+            )
+            if interp is not None:
+                from ..ops.pallas_gossip import (
+                    pallas_gossip_round_rows_grouped,
+                )
+
+                def sparse_pl(states_tuple, neighbors, mask, row_idx,
+                              valid_):
+                    stacked = stack_group(states_tuple)
+                    new_g, changed = pallas_gossip_round_rows_grouped(
+                        codec, spec, stacked, neighbors, row_idx, valid_,
+                        mask, interpret=interp,
+                    )
+                    return unstack_group(new_g, n_g), changed
+
+                arms["pallas_rows"] = jax.jit(
+                    sparse_pl, donate_argnums=self._frontier_donate()
+                )
+            fn, arm = self._race_rows_arms(
+                f"grouped_rows:{group.codec.__name__}"
+                f":G{len(active)}b{int(bucket)}",
+                arms, tuple(self.states[v] for v in var_ids),
+                (edge_mask, jnp.asarray(rows_mat), jnp.asarray(valid)),
+            )
             self._fused_steps_cache[key] = fn
+            self._rows_arm_of[key] = arm
         with Timer() as t:
             outs, changed = self._run_plan_fn(
                 var_ids, fn, edge_mask,
@@ -2694,7 +2742,10 @@ class ReplicatedRuntime:
         for i, v in enumerate(var_ids):
             self.states[v] = outs[i]
         self._ledger_record_var(
-            "grouped_rows", var_ids[0], t.elapsed, rows=int(bucket),
+            "pallas_rows"
+            if self._rows_arm_of.get(key) == "pallas_rows"
+            else "grouped_rows",
+            var_ids[0], t.elapsed, rows=int(bucket),
             g_active=len(active),
         )
         return np.asarray(changed)
@@ -2760,6 +2811,106 @@ class ReplicatedRuntime:
                 self._poisoned = f"{type(exc).__name__}: {str(exc)[:200]}"
             raise
 
+    # -- Pallas row-sparse dispatch arm (winner-ships race) -------------------
+    def _pallas_rows_interpret(self, codec, spec, states_sample):
+        """Whether the Pallas row-sparse arm contends for a dispatch
+        signature, and in which mode: None = XLA only (mode "off", a
+        codec with no rows-plan — e.g. riak_dt_map's embedded-field
+        merge — or a CPU/GPU backend where Mosaic cannot compile);
+        False = compiled Mosaic (TPU); True = interpret-mode emulator
+        (the test/smoke mode)."""
+        mode = self.pallas_rows_mode
+        if mode not in ("auto", "off", "interpret"):
+            raise ValueError(
+                f"unknown pallas_rows_mode {mode!r} "
+                "('auto', 'off', or 'interpret')"
+            )
+        if mode == "off" or self._partition is not None:
+            return None
+        from ..ops.pallas_gossip import rows_plan_of
+
+        if rows_plan_of(codec, spec, states_sample) is None:
+            return None
+        if mode == "interpret":
+            return True
+        if jax.devices()[0].platform not in ("tpu", "axon"):
+            return None  # compiled Mosaic needs a real chip (not CPU/GPU)
+        return False
+
+    def _race_rows_arms(self, label: str, arms: dict, states_in, extra):
+        """Winner-ships selection between the XLA and Pallas row-sparse
+        arms of ONE dispatch signature: compile+warm each arm on a COPY
+        of the live population (donation consumes the copies, never the
+        live states), then time one warm dispatch each on the actual
+        hardware. Both timings land in ``impl_block_seconds[label]``
+        and the winner's jitted fn ships for every later same-signature
+        dispatch — the dense Pallas-vs-XLA measured gate
+        (bench_scenarios.orset_anti_entropy), moved into the runtime so
+        ANY frontier workload gets the race, not just the bench. A
+        Mosaic compile/run failure drops that arm (recorded under
+        ``<arm>_error``), never the dispatch. The transient copy means
+        the first dispatch of a signature briefly holds one extra
+        population copy in HBM — the same footprint the bench probes
+        already pay. Returns ``(winner_fn, winner_name)``."""
+        if len(arms) == 1:
+            return arms["xla"], "xla"
+        timings: dict = {}
+        fns: dict = {}
+        outs: dict = {}
+        for name, fn in arms.items():
+            try:
+                copy = jax.tree_util.tree_map(jnp.array, states_in)
+                out = fn(copy, self.neighbors, *extra)
+                jax.block_until_ready(out[1])  # compile + warm
+                copy = jax.tree_util.tree_map(jnp.array, states_in)
+                with Timer() as t:
+                    out = fn(copy, self.neighbors, *extra)
+                    jax.block_until_ready(out[1])
+                timings[name] = t.elapsed
+                fns[name] = fn
+                outs[name] = out
+            except Exception as exc:
+                if name == "xla":
+                    raise  # the baseline arm must work
+                timings[f"{name}_error"] = str(exc)[:200]
+        if len(outs) > 1:
+            # the race doubles as the bit-equality gate: identical
+            # inputs (fresh copies of the same population, same rows /
+            # mask) must produce identical states AND changed flags
+            # across arms, or the Pallas arm is dropped loudly — a
+            # wrong-but-fast kernel must never win a timing race
+            ref = outs["xla"]
+            for name, got in outs.items():
+                if name == "xla":
+                    continue
+                # device-side reduction: one scalar per leaf crosses to
+                # the host, never the two full populations
+                same = jax.tree_util.tree_map(
+                    lambda a, b: bool(jnp.array_equal(a, b)), ref, got,
+                )
+                if not all(jax.tree_util.tree_leaves(same)):
+                    del fns[name]
+                    timings[f"{name}_error"] = "parity mismatch vs xla"
+        # the emulator arm never ships (it exists to exercise the race
+        # machinery off-TPU); its timing is still recorded
+        contenders = {
+            n for n in fns
+            if not (n == "pallas_rows" and self.pallas_rows_mode == "interpret")
+        } or set(fns)
+        winner = min(contenders, key=timings.get)
+        rec = {
+            k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in timings.items()
+        }
+        rec["winner"] = winner
+        self.impl_block_seconds[label] = rec
+        counter(
+            "gossip_pallas_race_total",
+            help="row-sparse dispatch-arm races resolved, by winner",
+            winner=winner,
+        ).inc()
+        return fns[winner], winner
+
     #: sparse-round row buckets are padded to powers of two (floor 16) so
     #: one compiled kernel serves a band of frontier sizes instead of one
     #: executable per distinct row count
@@ -2794,14 +2945,40 @@ class ReplicatedRuntime:
                     codec, spec, states_v, neighbors, row_idx, mask
                 )
 
-            fn = jax.jit(sparse, donate_argnums=self._frontier_donate())
+            arms = {
+                "xla": jax.jit(sparse, donate_argnums=self._frontier_donate())
+            }
+            interp = self._pallas_rows_interpret(
+                codec, spec, self.states[var_id]
+            )
+            if interp is not None:
+                from ..ops.pallas_gossip import pallas_gossip_round_rows
+
+                def sparse_pl(states_v, neighbors, mask, row_idx):
+                    return pallas_gossip_round_rows(
+                        codec, spec, states_v, neighbors, row_idx, mask,
+                        interpret=interp,
+                    )
+
+                arms["pallas_rows"] = jax.jit(
+                    sparse_pl, donate_argnums=self._frontier_donate()
+                )
+            fn, arm = self._race_rows_arms(
+                f"rows:{codec.__name__}:b{int(bucket)}", arms,
+                self.states[var_id], (edge_mask, jnp.asarray(padded)),
+            )
             self._fused_steps_cache[key] = fn
+            self._rows_arm_of[key] = arm
         with Timer() as t:
             new_states, changed = self._run_frontier_fn(
                 var_id, fn, edge_mask, jnp.asarray(padded)
             )
         self.states[var_id] = new_states
-        self._ledger_record_var("rows", var_id, t.elapsed, rows=int(bucket))
+        self._ledger_record_var(
+            "pallas_rows"
+            if self._rows_arm_of.get(key) == "pallas_rows" else "rows",
+            var_id, t.elapsed, rows=int(bucket),
+        )
         mask = np.zeros(self.n_replicas, dtype=bool)
         changed = np.asarray(changed)[: rows.size]
         mask[rows[changed]] = True

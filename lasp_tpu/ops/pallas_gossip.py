@@ -95,23 +95,36 @@ def _round_kernel(
 def pallas_gossip_round(exists, removed, neighbors, block: int = 8, interpret: bool = False):
     """One pull-gossip round over packed OR-Set planes.
 
-    ``exists``/``removed``: uint32[R, D] with D a multiple of 128 and R a
-    multiple of ``block``; ``neighbors``: int32[R, K]. Returns the joined
-    planes (same shapes)."""
+    ``exists``/``removed``: uint32[R, D] with D a multiple of 128;
+    ``neighbors``: int32[R, K]. Returns the joined planes (same shapes).
+    Arbitrary replica counts are legal: the replica axis pads to the
+    ``block`` boundary inside this wrapper (pad rows are zero rows that
+    gather row 0 — real rows never reference them, and their outputs are
+    sliced off, which masks them out of the scatter), so any population
+    can ship the Pallas arm instead of silently falling back to XLA on a
+    divisibility assert."""
     r_total, d = exists.shape
     k = neighbors.shape[1]
     assert d % LANE == 0, f"lane dim {d} must be a multiple of {LANE}"
-    assert r_total % block == 0, f"{r_total} rows not divisible by block {block}"
+    pad_rows = (-r_total) % block
+    if pad_rows:
+        exists = jnp.pad(exists, ((0, pad_rows), (0, 0)))
+        removed = jnp.pad(removed, ((0, pad_rows), (0, 0)))
+        neighbors = jnp.concatenate(
+            [neighbors,
+             jnp.zeros((pad_rows, k), dtype=neighbors.dtype)], axis=0
+        )
+    r_padded = r_total + pad_rows
     w = d // LANE
     # 3D layout: replica axis outside the (8, 128)-tiled trailing pair so
     # per-row dynamic HBM slices are legal at any index
-    e3 = exists.reshape(r_total, w, LANE)
-    m3 = removed.reshape(r_total, w, LANE)
+    e3 = exists.reshape(r_padded, w, LANE)
+    m3 = removed.reshape(r_padded, w, LANE)
 
     kernel = functools.partial(_round_kernel, block=block, k=k)
     out_e, out_r = pl.pallas_call(
         kernel,
-        grid=(r_total // block,),
+        grid=(r_padded // block,),
         in_specs=[
             pl.BlockSpec((block, k), lambda i: (i, 0), memory_space=pltpu.SMEM),
             pl.BlockSpec(
@@ -138,12 +151,386 @@ def pallas_gossip_round(exists, removed, neighbors, block: int = 8, interpret: b
             pltpu.SemaphoreType.DMA((k,)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((r_total, w, LANE), jnp.uint32),
-            jax.ShapeDtypeStruct((r_total, w, LANE), jnp.uint32),
+            jax.ShapeDtypeStruct((r_padded, w, LANE), jnp.uint32),
+            jax.ShapeDtypeStruct((r_padded, w, LANE), jnp.uint32),
         ],
         interpret=interpret,
     )(neighbors, e3, m3, e3, m3)
-    return out_e.reshape(r_total, d), out_r.reshape(r_total, d)
+    out_e = out_e.reshape(r_padded, d)[:r_total]
+    out_r = out_r.reshape(r_padded, d)[:r_total]
+    return out_e, out_r
+
+
+# ---------------------------------------------------------------------------
+# row-sparse frontier gossip: signature-specialized gather–join–scatter
+# ---------------------------------------------------------------------------
+#
+# The frontier scheduler's hot kernel (``gossip.gossip_round_rows`` /
+# ``_grouped``) is SpMM-shaped: gather the K neighbor rows of every
+# dirty-bucket slot, fold the lattice join, scatter the joined rows
+# back. The XLA lowering materializes one ``[F, ...]`` gathered copy per
+# fan-in column per leaf in HBM before the joins fuse; this kernel
+# streams instead — per slot, the (K+1) rows DMA straight from the
+# HBM-resident ``[G, R, ...]`` planes into double-buffered VMEM scratch
+# (slot i+1's copies are in flight while slot i joins — the JITSPMM
+# move of specializing the instruction stream per sparsity signature),
+# the join runs in VMEM, and the joined rows land in a ``[G, F, ...]``
+# output that the wrapper scatters back in place with the same donated
+# ``.at[rows].set`` the XLA kernel uses. The scatter stays OUTSIDE the
+# kernel deliberately: rows inside one bucket may name each other as
+# neighbors, and the round's contract is that every gather reads the
+# PRE-round state — an in-kernel in-place write would let a later grid
+# step observe an earlier step's join (schedule-dependent, not
+# bit-identical). "Fast and Fusiest" (PAPERS.md) grounds exactly this
+# fusion boundary: fuse gather+join (the bandwidth-bound stages), leave
+# the order-sensitive scatter to the donated XLA epilogue.
+#
+# Supported join families (``rows_plan_of``):
+#
+# - ``leafwise`` — codecs declaring ``leafwise_join`` ("or"/"max"): the
+#   fold is the same elementwise op on every leaf (G-Set, G-Counter,
+#   packed/flat OR-Sets). Dead edges are SKIPPED rather than
+#   substituted with the own row: or/max are absorbing on the
+#   already-accumulated own state, so the result is bit-identical to
+#   the XLA round's own-state substitution.
+# - ``vclock`` — the (clock, dots) dot-matrix pair (OR-SWOT): the
+#   ``lattice.dots.merge_dots`` survival rule evaluated leafwise in
+#   VMEM (clock rides as ``[1, A]`` so the ``dots > clock`` compare
+#   broadcasts across the element sublanes). Skipping a dead edge is
+#   again exact: every reachable state satisfies ``dots <= own clock``
+#   pointwise, under which ``merge(acc, old) == acc`` bit-for-bit.
+#
+# The per-slot CHANGED flag is a raw leaf-inequality reduction computed
+# in-kernel (SMEM output). On reachable states this equals
+# ``~codec.equal``: the packed codecs' masked removed-plane compare
+# coincides with raw equality because ``removed ⊆ exists`` is an
+# invariant of every constructor/op/merge (asserted across codecs by
+# tests/ops/test_pallas_rows.py). The PR5 pad-slot contract holds
+# as in the XLA kernel: pad slots compute and scatter the JOINED value
+# (duplicate slots write identical values by idempotence; out-of-reach
+# rows join to themselves by the frontier invariant) and ``valid``
+# only gates the changed accounting — grouped CHANGED stays
+# bit-identical.
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class RowsPlan:
+    """How one codec's state maps onto the row-sparse kernel."""
+
+    kind: str  # "leafwise" | "vclock"
+    op: "str | None" = None  # leafwise op ("or" | "max")
+
+
+def rows_plan_of(codec, spec, states) -> "RowsPlan | None":
+    """The kernel plan for ``codec``'s states, or None when this codec
+    cannot ride the Pallas row-sparse arm (the dispatch race then keeps
+    the XLA kernel — e.g. riak_dt_map's embedded-field merge)."""
+    del spec
+    kind = getattr(codec, "leafwise_join", None)
+    if kind in ("or", "max"):
+        return RowsPlan(kind="leafwise", op=kind)
+    if getattr(states, "_fields", None) == ("clock", "dots"):
+        return RowsPlan(kind="vclock")
+    return None
+
+
+def tuned_rows_block(row_bytes: int, bucket: int, fanout: int) -> int:
+    """The tuned slots-per-grid-block for one dispatch signature: VMEM
+    holds 2 double-buffer sets of (fanout+1) gathered rows plus the
+    block's output rows, so wide rows get narrow blocks and narrow rows
+    amortize grid overhead across wider ones. Kept a pure function of
+    the ``(codec row bytes, bucket, fanout)`` signature so a cached
+    variant's configuration is reproducible."""
+    budget = 2 << 20  # ~2 MiB of VMEM per signature
+    per_slot = max((2 * (int(fanout) + 1) + 1) * max(int(row_bytes), 1), 1)
+    fb = max(budget // per_slot, 1)
+    fb = 1 << (int(fb).bit_length() - 1)  # floor to a power of two
+    fb = max(2, min(fb, 32))
+    # never wider than the (pow2-ceil of the) bucket itself
+    while fb > max(int(bucket), 1):
+        fb >>= 1
+    return max(fb, 1)
+
+
+def _vclock_join(acc, nbr):
+    """``lattice.dots.merge_dots`` on VMEM views: ``acc``/``nbr`` are
+    ``[clock[1, A], dots[E, A]]``. Same op sequence as the XLA merge, so
+    the fold is bit-identical."""
+    ca, da = acc
+    cb, db = nbr
+    clock = jnp.maximum(ca, cb)
+    keep_a = (da > 0) & ((da == db) | (da > cb))
+    keep_b = (db > 0) & ((db == da) | (db > ca))
+    zero = jnp.zeros_like(da)
+    dots = jnp.maximum(
+        jnp.where(keep_a, da, zero), jnp.where(keep_b, db, zero)
+    )
+    return [clock, dots]
+
+
+def _leafwise_join_fn(op_name: str):
+    op = jnp.bitwise_or if op_name == "or" else jnp.maximum
+    return lambda acc, nbr: [op(a, b) for a, b in zip(acc, nbr)]
+
+
+def _rows_kernel(*refs, n_leaves: int, k: int, fb: int, masked: bool, join):
+    """Gather–join body: grid ``(G, F // fb)``; per slot, DMA the own
+    row + K neighbor rows of every leaf into the double-buffered VMEM
+    scratch (prefetching slot i+1 while joining slot i), fold the join
+    in k order (the XLA kernels' fold order), flag CHANGED, and write
+    the joined rows to the block's output."""
+    rows_s, nbr_s = refs[0], refs[1]
+    i0 = 2
+    mask_s = None
+    if masked:
+        mask_s = refs[2]
+        i0 = 3
+    planes = refs[i0:i0 + n_leaves]
+    outs = refs[i0 + n_leaves:i0 + 2 * n_leaves]
+    changed_s = refs[i0 + 2 * n_leaves]
+    scr = refs[i0 + 2 * n_leaves + 1:i0 + 3 * n_leaves + 1]
+    sems = refs[i0 + 3 * n_leaves + 1:]
+    g = pl.program_id(0)
+
+    def dmas(slot, buf):
+        """The slot's (K+1) per-leaf row copies (reconstructed
+        identically for start and wait — the dense kernel's pattern)."""
+        row = rows_s[0, slot]
+        cps = []
+        for leaf in range(n_leaves):
+            cps.append(pltpu.make_async_copy(
+                planes[leaf].at[g, row], scr[leaf].at[buf, 0],
+                sems[leaf].at[buf, 0],
+            ))
+        for j in range(k):
+            idx = nbr_s[0, slot, j]
+            for leaf in range(n_leaves):
+                cps.append(pltpu.make_async_copy(
+                    planes[leaf].at[g, idx], scr[leaf].at[buf, j + 1],
+                    sems[leaf].at[buf, j + 1],
+                ))
+        return cps
+
+    for c in dmas(0, 0):  # warm-up: slot 0 into buffer 0
+        c.start()
+
+    def body(i, _):
+        buf = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < fb)
+        def _prefetch():  # slot i+1 streams while slot i joins
+            for c in dmas(i + 1, jax.lax.rem(i + 1, 2)):
+                c.start()
+
+        for c in dmas(i, buf):
+            c.wait()
+        own = [scr[leaf][buf, 0] for leaf in range(n_leaves)]
+        acc = list(own)
+        for j in range(k):
+            nbr = [scr[leaf][buf, j + 1] for leaf in range(n_leaves)]
+            merged = join(acc, nbr)
+            if masked:
+                live = mask_s[0, i, j] != 0
+                acc = [jnp.where(live, m, a) for m, a in zip(merged, acc)]
+            else:
+                acc = merged
+        diff = jnp.bool_(False)
+        for a, o in zip(acc, own):
+            diff = diff | jnp.any(a != o)
+        changed_s[0, i] = diff.astype(jnp.int32)
+        for leaf in range(n_leaves):
+            outs[leaf][0, i] = acc[leaf]
+        return 0
+
+    jax.lax.fori_loop(0, fb, body, 0)
+
+
+def _leaf_views(leaves, kind: str):
+    """4D ``[G, R, S1, S2]`` kernel views of grouped state leaves (pure
+    reshapes — never a padding copy; a non-lane-multiple flat width
+    rides as a single ``[1, d]`` tile row and Mosaic pads lanes
+    internally). vclock keeps natural shapes: clock ``[G, R, 1, A]``
+    (broadcastable against dots' sublanes), dots ``[G, R, E, A]``."""
+    views = []
+    for i, leaf in enumerate(leaves):
+        g, r = leaf.shape[:2]
+        if kind == "vclock":
+            if i == 0:  # clock [G, R, A]
+                views.append(leaf.reshape(g, r, 1, leaf.shape[2]))
+            else:  # dots [G, R, E, A]
+                views.append(leaf)
+            continue
+        d = 1
+        for s in leaf.shape[2:]:
+            d *= int(s)
+        if d % LANE == 0:
+            views.append(leaf.reshape(g, r, d // LANE, LANE))
+        else:
+            views.append(leaf.reshape(g, r, 1, d))
+    return views
+
+
+#: compiled row-sparse kernel variants, keyed per dispatch signature —
+#: (kind, op, leaf row shapes+dtypes, K, fb, G, F, masked, interpret) —
+#: the ``plan.signature_of`` granularity plus the (block, bucket)
+#: tuning; one entry serves every same-signature dispatch.
+_ROWS_CALLS: dict = {}
+_ROWS_CALL_STATS = {"built": 0, "hits": 0}
+
+
+def rows_kernel_cache_stats() -> dict:
+    """``{"built": n, "hits": n}`` for the signature-specialized kernel
+    cache (tests assert same-signature dispatches share one variant)."""
+    return dict(_ROWS_CALL_STATS)
+
+
+def _rows_call(key, *, kind, op, row_shapes, dtypes, g, r, fp, k, fb,
+               masked, interpret):
+    fn = _ROWS_CALLS.get(key)
+    if fn is not None:
+        _ROWS_CALL_STATS["hits"] += 1
+        return fn
+    _ROWS_CALL_STATS["built"] += 1
+    n_leaves = len(row_shapes)
+    join = _vclock_join if kind == "vclock" else _leafwise_join_fn(op)
+    kernel = functools.partial(
+        _rows_kernel, n_leaves=n_leaves, k=k, fb=fb, masked=masked,
+        join=join,
+    )
+    in_specs = [
+        pl.BlockSpec((1, fb), lambda gi, bi: (gi, bi),
+                     memory_space=pltpu.SMEM),  # rows
+        pl.BlockSpec((1, fb, k), lambda gi, bi: (gi, bi, 0),
+                     memory_space=pltpu.SMEM),  # neighbor rows
+    ]
+    if masked:
+        in_specs.append(
+            pl.BlockSpec((1, fb, k), lambda gi, bi: (gi, bi, 0),
+                         memory_space=pltpu.SMEM)  # edge-mask slots
+        )
+    in_specs.extend(
+        pl.BlockSpec(memory_space=pl.ANY) for _ in range(n_leaves)
+    )
+    out_specs = [
+        pl.BlockSpec((1, fb) + shape, lambda gi, bi: (gi, bi, 0, 0),
+                     memory_space=pltpu.VMEM)
+        for shape in row_shapes
+    ]
+    out_specs.append(
+        pl.BlockSpec((1, fb), lambda gi, bi: (gi, bi),
+                     memory_space=pltpu.SMEM)  # changed flags
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((g, fp) + shape, dt)
+        for shape, dt in zip(row_shapes, dtypes)
+    ]
+    out_shape.append(jax.ShapeDtypeStruct((g, fp), jnp.int32))
+    scratch = [
+        pltpu.VMEM((2, k + 1) + shape, dt)
+        for shape, dt in zip(row_shapes, dtypes)
+    ]
+    scratch.extend(
+        pltpu.SemaphoreType.DMA((2, k + 1)) for _ in range(n_leaves)
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid=(g, fp // fb),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )
+    _ROWS_CALLS[key] = fn
+    return fn
+
+
+def pallas_gossip_round_rows_grouped(codec, spec, states, neighbors, rows,
+                                     valid, edge_mask=None, *,
+                                     block: "int | None" = None,
+                                     interpret: bool = False):
+    """Pallas twin of :func:`lasp_tpu.mesh.gossip.gossip_round_rows_grouped`
+    — bit-identical contract: ``states`` leaves ``[G, R, ...]``,
+    ``rows: int[G, F]`` (bucket-padded, duplicates legal),
+    ``valid: bool[G, F]``; returns ``(new_states, changed: bool[G, F])``.
+    Traceable (the runtime jits it with donated states); the kernel
+    variant is cached per dispatch signature. ``block`` overrides the
+    tuned slots-per-grid-block."""
+    plan = rows_plan_of(codec, spec, states)
+    if plan is None:
+        raise ValueError(
+            f"{getattr(codec, 'name', codec)}: no Pallas row-sparse plan "
+            "(codec is neither leafwise nor a (clock, dots) pair)"
+        )
+    leaves, treedef = jax.tree_util.tree_flatten(states)
+    g, _r = leaves[0].shape[:2]
+    rows = jnp.asarray(rows, jnp.int32)
+    f = int(rows.shape[-1])
+    row_bytes = sum(
+        max(int(np.prod(leaf.shape[2:], dtype=np.int64)), 1)
+        * leaf.dtype.itemsize
+        for leaf in leaves
+    )
+    k = int(neighbors.shape[1])
+    fb = int(block) if block else tuned_rows_block(row_bytes, f, k)
+    fp = -(-f // fb) * fb
+    if fp != f:  # pad the bucket to the block boundary with slot-0 dupes
+        rows = jnp.concatenate(
+            [rows, jnp.broadcast_to(rows[:, :1], (g, fp - f))], axis=1
+        )
+    nbr = jnp.asarray(neighbors, jnp.int32)[rows]  # [G, Fp, K]
+    operands = [rows, nbr]
+    masked = edge_mask is not None
+    if masked:
+        operands.append(jnp.asarray(edge_mask)[rows].astype(jnp.int32))
+    views = _leaf_views(leaves, plan.kind)
+    row_shapes = tuple(v.shape[2:] for v in views)
+    dtypes = tuple(v.dtype for v in views)
+    call = _rows_call(
+        (plan.kind, plan.op, row_shapes, dtypes, g,
+         int(leaves[0].shape[1]), fp, k, fb, masked, bool(interpret)),
+        kind=plan.kind, op=plan.op, row_shapes=row_shapes, dtypes=dtypes,
+        g=g, r=int(leaves[0].shape[1]), fp=fp, k=k, fb=fb, masked=masked,
+        interpret=bool(interpret),
+    )
+    outs = call(*operands, *views)
+    out_rows, changed_i32 = outs[:-1], outs[-1]
+    new_leaves = []
+    for leaf, out4 in zip(leaves, out_rows):
+        nr = out4.reshape((g, fp) + leaf.shape[2:])
+        new_leaves.append(
+            jax.vmap(lambda x, rr, vv: x.at[rr].set(vv))(leaf, rows, nr)
+        )
+    new_states = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    changed = (changed_i32[:, :f] != 0) & jnp.asarray(valid, bool)
+    return new_states, changed
+
+
+def pallas_gossip_round_rows(codec, spec, states, neighbors, rows,
+                             edge_mask=None, valid=None, *,
+                             block: "int | None" = None,
+                             interpret: bool = False):
+    """Pallas twin of :func:`lasp_tpu.mesh.gossip.gossip_round_rows` for
+    one ``[R, ...]`` population — the grouped kernel at G=1. ``valid``
+    optional exactly as in the XLA kernel (absent = raw changed flags
+    over every slot; duplicates' writes are identical by idempotence)."""
+    states_g = jax.tree_util.tree_map(lambda x: x[None], states)
+    rows_g = jnp.asarray(rows)[None]
+    valid_g = (
+        jnp.ones(rows_g.shape, bool) if valid is None
+        else jnp.asarray(valid)[None]
+    )
+    new_g, changed = pallas_gossip_round_rows_grouped(
+        codec, spec, states_g, neighbors, rows_g, valid_g, edge_mask,
+        block=block, interpret=interpret,
+    )
+    return (
+        jax.tree_util.tree_map(lambda x: x[0], new_g), changed[0]
+    )
 
 
 def flatten_plane(plane, lane: int = LANE):

@@ -64,13 +64,19 @@ from .spans import span
 #: neighbor/row index tables ride int32 on the wire
 _IDX_BYTES = 4
 
-#: every kernel family the model covers (tests pin the vocabulary)
+#: every kernel family the model covers (tests pin the vocabulary).
+#: ``pallas_dense`` / ``pallas_rows`` are the hand-written Mosaic
+#: kernels (ops.pallas_gossip) — separate families on purpose, so the
+#: roofline table shows the Pallas arm's achieved HBM fraction NEXT TO
+#: the XLA arm it raced (the measure→specialize→verify loop of ISSUE 7)
 FAMILIES = (
     "dense",
     "shift",
     "rows",
     "grouped_dense",
     "grouped_rows",
+    "pallas_dense",
+    "pallas_rows",
     "step",
     "fused_block",
     "converge",
@@ -153,6 +159,34 @@ def kernel_traffic(
             else (2 + K) * S + ntab + pad
         )
         return TrafficEstimate(moved, lo, hi, R * K)
+
+    if family == "pallas_dense":
+        # the hand-written streamed gather+join (ops.pallas_gossip.
+        # pallas_gossip_round): K row reads + 1 own read + 1 write per
+        # replica per plane, never a gathered HBM intermediate — ideal
+        # traffic IS the dense convention (same numerator, so the two
+        # arms' achieved GB/s compare directly), and the bounds are
+        # tight around it because the kernel cannot materialize more
+        moved = (K + 2) * S
+        lo = 2 * S + N
+        hi = (2 + K) * S + N + pad
+        return TrafficEstimate(moved, lo, hi, R * K)
+
+    if family == "pallas_rows":
+        # the row-sparse gather–join–scatter kernel (ops.pallas_gossip.
+        # pallas_gossip_round_rows[_grouped]): per bucket slot, (K+1)
+        # leaf-row DMAs in + the joined row out of VMEM, double-buffered
+        # — same ideal numerator as the XLA ``rows`` family so the race
+        # compares like-for-like; the hi bound adds the donated scatter
+        # epilogue's full-state read+write (outside the kernel, still
+        # this dispatch's traffic)
+        F = int(rows or 0)
+        moved = G * ((K + 2) * F * int(row_bytes) + F * (K + 2) * _IDX_BYTES)
+        lo = G * (K + 2) * F * int(row_bytes)
+        hi = (
+            2 * G * S + G * (2 * K + 4) * F * int(row_bytes) + N + pad
+        )
+        return TrafficEstimate(moved, lo, hi, G * F * K)
 
     if family == "rows":
         F = int(rows or 0)

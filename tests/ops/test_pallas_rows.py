@@ -1,0 +1,338 @@
+"""Row-sparse Pallas gossip kernel: bit-equality against the XLA
+``gossip_round_rows`` / ``gossip_round_rows_grouped`` kernels in
+interpret mode on the CPU mesh, across codec families (leafwise or/max,
+packed two-plane, vclock), bucket sizes, valid-mask patterns, and edge
+masks — plus the signature cache, the dense kernel's pad fix, and the
+runtime's winner-ships dispatch race (exercised end-to-end via the
+interpret arm). Compiled Mosaic execution is exercised on the real chip
+by bench_pallas.py / tools/pallas_smoke.py / the driver."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lasp_tpu.lattice.base import replicate
+from lasp_tpu.lattice.gcounter import GCounter, GCounterSpec
+from lasp_tpu.lattice.gset import GSet, GSetSpec
+from lasp_tpu.lattice.orswot import ORSWOT, ORSWOTSpec
+from lasp_tpu.mesh import gossip_round, random_regular
+from lasp_tpu.mesh.gossip import (
+    gossip_round_rows,
+    gossip_round_rows_grouped,
+)
+from lasp_tpu.ops import PackedORSet, PackedORSetSpec
+from lasp_tpu.ops.pallas_gossip import (
+    flatten_plane,
+    pallas_gossip_round,
+    pallas_gossip_round_rows,
+    pallas_gossip_round_rows_grouped,
+    rows_kernel_cache_stats,
+    rows_plan_of,
+    tuned_rows_block,
+    unflatten_plane,
+)
+
+N, K = 48, 3
+
+
+def tree_eq(a, b) -> bool:
+    same = jax.tree_util.tree_map(
+        lambda x, y: bool(np.array_equal(np.asarray(x), np.asarray(y))),
+        a, b,
+    )
+    return all(jax.tree_util.tree_leaves(same))
+
+
+def seeded(kind: str, n: int = N):
+    """A population with non-trivial per-row divergence for one codec."""
+    r = jnp.arange(n)
+    if kind == "gset":
+        spec = GSetSpec(n_elems=16)
+        st = replicate(GSet.new(spec), n)
+        st = jax.vmap(lambda i, s: GSet.add(spec, s, i % 16))(r, st)
+        return GSet, spec, st
+    if kind == "gcounter":
+        spec = GCounterSpec(n_actors=4)
+        st = replicate(GCounter.new(spec), n)
+        st = jax.vmap(
+            lambda i, s: GCounter.increment(spec, s, i % 4)
+        )(r, st)
+        return GCounter, spec, st
+    if kind == "orswot":
+        spec = ORSWOTSpec(n_elems=8, n_actors=4)
+        st = replicate(ORSWOT.new(spec), n)
+        st = jax.vmap(lambda i, s: ORSWOT.add(spec, s, i % 8, i % 4))(r, st)
+        # removals too, so dead dots exercise the survival rule
+        st = jax.vmap(
+            lambda i, s: jax.lax.cond(
+                i % 7 == 0,
+                lambda x: ORSWOT.remove(spec, x, i % 8),
+                lambda x: x,
+                s,
+            )
+        )(r, st)
+        return ORSWOT, spec, st
+    assert kind == "packed"
+    spec = PackedORSetSpec(n_elems=16, n_actors=8, tokens_per_actor=8)
+    st = replicate(PackedORSet.new(spec), n)
+    st = jax.vmap(
+        lambda i, s: PackedORSet.add(spec, s, i % 16, i % 8)
+    )(r, st)
+    st = jax.vmap(
+        lambda i, s: jax.lax.cond(
+            i % 5 == 0,
+            lambda x: PackedORSet.remove(spec, x, i % 16),
+            lambda x: x,
+            s,
+        )
+    )(r, st)
+    return PackedORSet, spec, st
+
+
+CODECS = ("gset", "gcounter", "orswot", "packed")
+
+
+@pytest.mark.parametrize("kind", CODECS)
+@pytest.mark.parametrize("bucket", [5, 16, 33])
+def test_rows_matches_xla_across_codecs_and_buckets(kind, bucket):
+    """Single-population parity: states AND changed flags bit-identical
+    to ``gossip_round_rows`` for every codec family the kernel plans
+    (leafwise or/max, two-plane packed, vclock), at bucket sizes below/
+    at/above the tuned grid block (non-pow2 buckets exercise the
+    wrapper's slot-0 pad)."""
+    codec, spec, st = seeded(kind)
+    nbrs = jnp.asarray(random_regular(N, K, seed=3))
+    rng = np.random.RandomState(bucket)
+    rows = jnp.asarray(rng.randint(0, N, size=bucket))
+    ref = gossip_round_rows(codec, spec, st, nbrs, rows)
+    got = pallas_gossip_round_rows(
+        codec, spec, st, nbrs, rows, interpret=True
+    )
+    assert tree_eq(ref, got)
+
+
+@pytest.mark.parametrize("kind", ("gset", "orswot", "packed"))
+def test_rows_matches_xla_under_edge_mask(kind):
+    """Dead edges: the kernel SKIPS the dead neighbor's merge where the
+    XLA round substitutes the row's own state — bit-identical because
+    or/max are absorbing on the accumulated own state and the vclock
+    merge is idempotent against any already-absorbed ancestor."""
+    codec, spec, st = seeded(kind)
+    nbrs = jnp.asarray(random_regular(N, K, seed=5))
+    rng = np.random.RandomState(7)
+    mask = jnp.asarray(rng.rand(N, K) > 0.4)
+    rows = jnp.asarray(rng.randint(0, N, size=12))
+    ref = gossip_round_rows(codec, spec, st, nbrs, rows, mask)
+    got = pallas_gossip_round_rows(
+        codec, spec, st, nbrs, rows, mask, interpret=True
+    )
+    assert tree_eq(ref, got)
+
+
+@pytest.mark.parametrize("kind", CODECS)
+def test_grouped_matches_xla_with_valid_masks(kind):
+    """Grouped parity at G=3 with per-member valid patterns: dense, a
+    pad tail, and a fully-invalid (quiescent) member that must ride
+    through bit-unchanged with all-False changed flags — the PR5
+    pad-slot contract the runtime's plan dispatch relies on."""
+    codec, spec, st = seeded(kind)
+    g, f = 3, 10
+    stacked = jax.tree_util.tree_map(
+        lambda x: jnp.stack([x, x[::-1], x]), st
+    )
+    nbrs = jnp.asarray(random_regular(N, K, seed=9))
+    rng = np.random.RandomState(11)
+    rows = jnp.asarray(rng.randint(0, N, size=(g, f)))
+    valid = jnp.asarray(
+        np.stack([
+            np.ones(f, bool),                      # dense member
+            np.arange(f) < 4,                      # pad tail
+            np.zeros(f, bool),                     # quiescent member
+        ])
+    )
+    ref = gossip_round_rows_grouped(
+        codec, spec, stacked, nbrs, rows, valid
+    )
+    got = pallas_gossip_round_rows_grouped(
+        codec, spec, stacked, nbrs, rows, valid, interpret=True
+    )
+    assert tree_eq(ref, got)
+    assert not np.asarray(got[1])[2].any()  # quiescent member: no change
+
+
+def test_grouped_matches_xla_with_edge_mask_and_duplicates():
+    """Edge mask + duplicate row slots together (bucket padding names
+    the same row twice): idempotent joins make duplicate scatter writes
+    identical, masked or not."""
+    codec, spec, st = seeded("gcounter")
+    stacked = jax.tree_util.tree_map(lambda x: jnp.stack([x, x]), st)
+    nbrs = jnp.asarray(random_regular(N, K, seed=13))
+    rng = np.random.RandomState(17)
+    mask = jnp.asarray(rng.rand(N, K) > 0.3)
+    rows = jnp.asarray([[1, 1, 4, 9, 9, 9, 20, 33]] * 2)
+    valid = jnp.asarray([[True] * 8, [True, True, True, False] + [False] * 4])
+    ref = gossip_round_rows_grouped(
+        codec, spec, stacked, nbrs, rows, valid, mask
+    )
+    got = pallas_gossip_round_rows_grouped(
+        codec, spec, stacked, nbrs, rows, valid, mask, interpret=True
+    )
+    assert tree_eq(ref, got)
+
+
+def test_changed_flag_matches_codec_equal_on_packed():
+    """The kernel's CHANGED flag is a raw leaf-inequality reduction;
+    the packed codecs' ``equal`` masks the removed plane with exists.
+    They coincide because ``removed ⊆ exists`` is an invariant of every
+    constructor / op / merge — asserted here across gossip rounds, so
+    the kernel's shortcut can never silently diverge."""
+    codec, spec, st = seeded("packed")
+    nbrs = jnp.asarray(random_regular(N, K, seed=19))
+    for _ in range(3):
+        assert bool(jnp.all((st.removed & ~st.exists) == 0))
+        st = gossip_round(codec, spec, st, nbrs)
+    assert bool(jnp.all((st.removed & ~st.exists) == 0))
+
+
+def test_signature_cache_shares_variants():
+    """Same-signature dispatches reuse ONE compiled variant; a new
+    bucket or codec builds a new one (the JITSPMM specialization
+    granularity, keyed like ``plan.signature_of``)."""
+    codec, spec, st = seeded("gset")
+    nbrs = jnp.asarray(random_regular(N, K, seed=23))
+    rows = jnp.arange(8)
+    before = rows_kernel_cache_stats()
+    pallas_gossip_round_rows(codec, spec, st, nbrs, rows, interpret=True)
+    mid = rows_kernel_cache_stats()
+    pallas_gossip_round_rows(
+        codec, spec, st, nbrs, rows + 1, interpret=True
+    )
+    after = rows_kernel_cache_stats()
+    assert mid["built"] >= before["built"]
+    assert after["built"] == mid["built"]  # same signature: no rebuild
+    assert after["hits"] == mid["hits"] + 1
+
+
+def test_unplannable_codec_raises():
+    """A codec with neither a leafwise join nor a (clock, dots) pair
+    must refuse loudly — the dispatch race then keeps XLA."""
+    from lasp_tpu.lattice import CrdtMap, MapSpec
+
+    spec = MapSpec(
+        fields=(("a", GSet, GSetSpec(n_elems=4)),), n_actors=2
+    )
+    st = replicate(CrdtMap.new(spec), 8)
+    assert rows_plan_of(CrdtMap, spec, st) is None
+    with pytest.raises(ValueError, match="no Pallas row-sparse plan"):
+        pallas_gossip_round_rows(
+            CrdtMap, spec, st,
+            jnp.zeros((8, 2), jnp.int32), jnp.arange(4), interpret=True
+        )
+
+
+def test_tuned_block_is_pure_and_bounded():
+    """The (block, bucket) tuning is a pure function of the signature
+    (reproducible cache keys) and stays inside the VMEM budget."""
+    assert tuned_rows_block(64, 256, 3) == tuned_rows_block(64, 256, 3)
+    for rb in (4, 64, 4096, 1 << 20):
+        for bucket in (1, 5, 16, 1024):
+            for k in (1, 3, 16):
+                fb = tuned_rows_block(rb, bucket, k)
+                assert 1 <= fb <= 32
+                assert fb & (fb - 1) == 0  # power of two
+
+
+def test_dense_pad_fix_arbitrary_population():
+    """Satellite 1: ``pallas_gossip_round`` pads the replica axis to the
+    block boundary internally — populations not divisible by the block
+    ship the dense Pallas arm instead of tripping an assert."""
+    spec = PackedORSetSpec(n_elems=16, n_actors=8, tokens_per_actor=8)
+    for n in (27, 33):
+        codec, _, st = (PackedORSet, spec, None)
+        r = jnp.arange(n)
+        st = replicate(PackedORSet.new(spec), n)
+        st = jax.vmap(
+            lambda i, s: PackedORSet.add(spec, s, i % 16, i % 8)
+        )(r, st)
+        nbrs = jnp.asarray(random_regular(n, K, seed=29))
+        ref = gossip_round(PackedORSet, spec, st, nbrs)
+        fe, _ = flatten_plane(st.exists)
+        fr, _ = flatten_plane(st.removed)
+        oe, orr = pallas_gossip_round(fe, fr, nbrs, block=8, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(unflatten_plane(oe, st.exists.shape)),
+            np.asarray(ref.exists),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(unflatten_plane(orr, st.removed.shape)),
+            np.asarray(ref.removed),
+        )
+
+
+# -- the runtime's winner-ships dispatch race --------------------------------
+
+
+def _race_runtime(plan: str, mode: str, n: int = 48):
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=4)
+    ids = [
+        store.declare(id="g0", type="lasp_gset", n_elems=16),
+        store.declare(id="g1", type="lasp_gset", n_elems=16),
+        store.declare(id="c0", type="riak_dt_gcounter", n_actors=4),
+    ]
+    rt = ReplicatedRuntime(
+        store, Graph(store), n, random_regular(n, K, seed=31), plan=plan
+    )
+    rt.pallas_rows_mode = mode
+    rng = np.random.RandomState(37)
+    for v in ids:
+        rows = rng.choice(n, 3, replace=False)
+        if v == "c0":
+            rt.update_batch(
+                v, [(int(r), ("increment",), ("lane", int(r) % 4))
+                    for r in rows]
+            )
+        else:
+            rt.update_batch(
+                v, [(int(r), ("add", f"e{int(r) % 8}"), f"a{int(r)}")
+                    for r in rows]
+            )
+    return rt, ids
+
+
+@pytest.mark.parametrize("plan", ["auto", "off"])
+def test_runtime_race_interpret_parity_and_records(plan):
+    """End-to-end dispatch race on CPU via the interpret arm: the raced
+    runtime's fixed point is bit-identical to the XLA-only runtime,
+    both arms' timings land in ``impl_block_seconds`` with a winner,
+    and the emulator arm never ships (parity-check-only — the CPU
+    degradation contract)."""
+    rt_ref, ids = _race_runtime(plan, "off")
+    while rt_ref.frontier_step():
+        pass
+    ref = {v: jax.tree_util.tree_map(np.asarray, rt_ref.states[v])
+           for v in ids}
+    assert rt_ref.impl_block_seconds == {}  # no race under "off"
+
+    rt, ids = _race_runtime(plan, "interpret")
+    while rt.frontier_step():
+        pass
+    got = {v: jax.tree_util.tree_map(np.asarray, rt.states[v])
+           for v in ids}
+    assert tree_eq(ref, got)
+    assert rt.impl_block_seconds, "race recorded nothing"
+    for label, rec in rt.impl_block_seconds.items():
+        assert "xla" in rec and "winner" in rec, (label, rec)
+        assert "pallas_rows" in rec or "pallas_rows_error" in rec
+        # the interpret emulator must never ship a dispatch
+        assert rec["winner"] == "xla"
+
+
+def test_runtime_race_mode_validation():
+    rt, _ids = _race_runtime("auto", "banana")
+    with pytest.raises(ValueError, match="pallas_rows_mode"):
+        rt.frontier_step()

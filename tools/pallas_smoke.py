@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Pallas-kernel smoke (Makefile ``verify``): interpret-mode parity for
+the hand-written Mosaic kernels — the dense packed-OR-Set gather+join
+(``pallas_gossip_round``, including the satellite-1 non-divisible-
+population pad fix) and the row-sparse gather–join–scatter kernel
+(``pallas_gossip_round_rows[_grouped]``) across leafwise / vclock /
+packed codecs with edge masks and valid masks — plus a winner-ships
+race dry run: a runtime under ``pallas_rows_mode="interpret"`` must
+converge bit-identically to the XLA-only runtime, record BOTH arms'
+timings per dispatch signature, never ship the emulator, and land
+``pallas_rows`` / ``pallas_dense`` roofline rows (non-null fractions)
+in the kernel ledger. Compiled Mosaic is exercised on the real chip by
+bench_pallas.py; this smoke keeps the contract guarded on every
+backend. See docs/PERF.md "Pallas kernels"."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _tree_eq(a, b) -> bool:
+    same = jax.tree_util.tree_map(
+        lambda x, y: bool(np.array_equal(np.asarray(x), np.asarray(y))),
+        a, b,
+    )
+    return all(jax.tree_util.tree_leaves(same))
+
+
+def dense_parity() -> None:
+    """``pallas_gossip_round`` == XLA ``gossip_round`` on packed planes,
+    at a population NOT divisible by the grid block (the pad fix)."""
+    from lasp_tpu.lattice.base import replicate
+    from lasp_tpu.mesh import gossip_round, random_regular
+    from lasp_tpu.ops import PackedORSet, PackedORSetSpec
+    from lasp_tpu.ops.pallas_gossip import (
+        flatten_plane,
+        pallas_gossip_round,
+        unflatten_plane,
+    )
+
+    spec = PackedORSetSpec(n_elems=16, n_actors=8, tokens_per_actor=8)
+    n = 27  # 27 % 8 != 0: ships via the wrapper's internal pad
+    st = replicate(PackedORSet.new(spec), n)
+    st = jax.vmap(
+        lambda i, s: PackedORSet.add(spec, s, i % 16, i % 8)
+    )(jnp.arange(n), st)
+    nbrs = jnp.asarray(random_regular(n, 3, seed=41))
+    ref = gossip_round(PackedORSet, spec, st, nbrs)
+    fe, _ = flatten_plane(st.exists)
+    fr, _ = flatten_plane(st.removed)
+    oe, orr = pallas_gossip_round(fe, fr, nbrs, block=8, interpret=True)
+    assert _tree_eq(
+        (unflatten_plane(oe, st.exists.shape),
+         unflatten_plane(orr, st.removed.shape)),
+        (ref.exists, ref.removed),
+    ), "dense Pallas kernel diverged from gossip_round"
+
+
+def rows_parity() -> None:
+    """Row-sparse parity across the kernel's join families (leafwise
+    or, vclock, packed two-plane) under edge masks + grouped valid
+    masks — bit-identical states AND changed flags."""
+    from lasp_tpu.lattice.base import replicate
+    from lasp_tpu.lattice.gset import GSet, GSetSpec
+    from lasp_tpu.lattice.orswot import ORSWOT, ORSWOTSpec
+    from lasp_tpu.mesh import random_regular
+    from lasp_tpu.mesh.gossip import (
+        gossip_round_rows,
+        gossip_round_rows_grouped,
+    )
+    from lasp_tpu.ops import PackedORSet, PackedORSetSpec
+    from lasp_tpu.ops.pallas_gossip import (
+        pallas_gossip_round_rows,
+        pallas_gossip_round_rows_grouped,
+    )
+
+    n, k = 40, 3
+    r = jnp.arange(n)
+    pops = []
+    spec = GSetSpec(n_elems=16)
+    st = replicate(GSet.new(spec), n)
+    pops.append((GSet, spec, jax.vmap(
+        lambda i, s: GSet.add(spec, s, i % 16))(r, st)))
+    spec = ORSWOTSpec(n_elems=8, n_actors=4)
+    st = replicate(ORSWOT.new(spec), n)
+    pops.append((ORSWOT, spec, jax.vmap(
+        lambda i, s: ORSWOT.add(spec, s, i % 8, i % 4))(r, st)))
+    spec = PackedORSetSpec(n_elems=16, n_actors=8, tokens_per_actor=8)
+    st = replicate(PackedORSet.new(spec), n)
+    pops.append((PackedORSet, spec, jax.vmap(
+        lambda i, s: PackedORSet.add(spec, s, i % 16, i % 8))(r, st)))
+
+    nbrs = jnp.asarray(random_regular(n, k, seed=43))
+    rng = np.random.RandomState(47)
+    mask = jnp.asarray(rng.rand(n, k) > 0.4)
+    rows = jnp.asarray(rng.randint(0, n, size=10))
+    for codec, spec, st in pops:
+        ref = gossip_round_rows(codec, spec, st, nbrs, rows, mask)
+        got = pallas_gossip_round_rows(
+            codec, spec, st, nbrs, rows, mask, interpret=True
+        )
+        assert _tree_eq(ref, got), (
+            f"row-sparse Pallas kernel diverged for {codec.__name__}"
+        )
+        # grouped twin with a pad tail + a quiescent member
+        stacked = jax.tree_util.tree_map(
+            lambda x: jnp.stack([x, x[::-1]]), st
+        )
+        rows_g = jnp.asarray(rng.randint(0, n, size=(2, 8)))
+        valid = jnp.asarray(
+            np.stack([np.arange(8) < 5, np.zeros(8, bool)])
+        )
+        ref_g = gossip_round_rows_grouped(
+            codec, spec, stacked, nbrs, rows_g, valid
+        )
+        got_g = pallas_gossip_round_rows_grouped(
+            codec, spec, stacked, nbrs, rows_g, valid, interpret=True
+        )
+        assert _tree_eq(ref_g, got_g), (
+            f"grouped row-sparse kernel diverged for {codec.__name__}"
+        )
+
+
+def race_dry_run() -> None:
+    """Winner-ships dry run off-TPU: the interpret arm contends, both
+    arms' timings land per signature, the emulator never ships, the
+    raced fixed point is bit-identical to XLA-only, and the ledger
+    carries warm ``pallas_rows`` + ``pallas_dense`` roofline rows."""
+    from lasp_tpu.bench_scenarios import (
+        _pallas_dense_probe,
+        _pallas_rows_probe,
+    )
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.store import Store
+    from lasp_tpu.telemetry import get_ledger
+
+    def build(mode):
+        store = Store(n_actors=4)
+        ids = [
+            store.declare(id="g0", type="lasp_gset", n_elems=16),
+            store.declare(id="g1", type="lasp_gset", n_elems=16),
+        ]
+        rt = ReplicatedRuntime(
+            store, Graph(store), 48, random_regular(48, 3, seed=53)
+        )
+        rt.pallas_rows_mode = mode
+        for v in ids:
+            rt.update_batch(
+                v, [(i, ("add", f"e{i % 8}"), f"a{i}") for i in (3, 17, 31)]
+            )
+        return rt, ids
+
+    rt_ref, ids = build("off")
+    while rt_ref.frontier_step():
+        pass
+    rt, ids = build("interpret")
+    while rt.frontier_step():
+        pass
+    assert _tree_eq(
+        {v: rt_ref.states[v] for v in ids},
+        {v: rt.states[v] for v in ids},
+    ), "raced runtime diverged from XLA-only runtime"
+    assert rt.impl_block_seconds, "race recorded no arm timings"
+    for label, rec in rt.impl_block_seconds.items():
+        assert "xla" in rec and "winner" in rec, (label, rec)
+        assert "pallas_rows" in rec or "pallas_rows_error" in rec, (
+            label, rec
+        )
+        assert rec["winner"] == "xla", (
+            f"interpret emulator shipped a dispatch: {label}"
+        )
+
+    # ledger + roofline entries for both hand-written kernel families
+    rows_arm = _pallas_rows_probe(rt, ids)
+    dense_arm = _pallas_dense_probe()
+    for name, arm in (("pallas_rows", rows_arm),
+                      ("pallas_dense", dense_arm)):
+        assert arm is not None and arm["seconds"] > 0, (name, arm)
+        assert arm["achieved_GBps"] is not None, (name, arm)
+        assert arm["roofline_frac"] is not None, (name, arm)
+    warm = {
+        e["family"]
+        for e in get_ledger().snapshot()
+        if e["dispatches"] > 0 and e["roofline_frac"] is not None
+    }
+    assert {"pallas_rows", "pallas_dense"} <= warm, warm
+
+
+def main() -> int:
+    dense_parity()
+    rows_parity()
+    race_dry_run()
+    print(
+        "pallas smoke OK: dense + row-sparse interpret parity "
+        "(leafwise/vclock/packed, masks), race dry run recorded both "
+        "arms + ledger roofline rows"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
