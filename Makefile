@@ -31,8 +31,11 @@ test-slow:
 # smoke guards the cost ledger's non-null fractions + the probe-report
 # schema (docs/OBSERVABILITY.md "Roofline & cost ledger"), a Pallas
 # smoke guards the hand-written kernels' interpret-mode parity and the
-# winner-ships race contract (docs/PERF.md "Pallas kernels"), then the
-# non-slow tests run (the tier-1 shape)
+# winner-ships race contract (docs/PERF.md "Pallas kernels"), a
+# dataflow-fusion smoke guards the propagate megakernel's fused-vs-
+# per-edge bit-identity over a mixed-codec graph with a non-stackable
+# edge plus its live roofline row (docs/PERF.md "Dataflow fusion"),
+# then the non-slow tests run (the tier-1 shape)
 verify:
 	python tools/check_metrics_catalog.py
 	python tools/frontier_smoke.py
@@ -40,6 +43,7 @@ verify:
 	python tools/chaos_smoke.py
 	python tools/roofline_smoke.py
 	python tools/pallas_smoke.py
+	python tools/dataflow_fusion_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

@@ -476,6 +476,18 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["many_vars"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- whole-graph dataflow fusion arm (~seconds): one deep write wave
+    # over 74 mixed-codec combinator edges, per-edge host round loop vs
+    # the on-device fixed-point megakernel from identical snapshots —
+    # bit-identical states + round counts asserted inside the scenario;
+    # both arm round-loop medians land in its impl_block_seconds ------------
+    try:
+        from lasp_tpu.bench_scenarios import dataflow_chain
+
+        detail["dataflow_chain"] = dataflow_chain()
+    except Exception as exc:
+        detail["dataflow_chain"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- chaos recovery arm (~seconds): composite nemesis (partition +
     # rolling crash) over a seeded population; records rounds-to-heal,
     # degraded-read repair traffic, and soak-vs-fault-free wall time,

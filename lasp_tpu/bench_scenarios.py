@@ -307,6 +307,20 @@ def roofline_workload(n_replicas: int = 128, n_vars: int = 12,
     # backend — the per-arm achieved-HBM-fraction view of ISSUE 7
     _pallas_rows_probe(rt, ids)
     _pallas_dense_probe()
+    # the dataflow propagate megakernel's family: two fused propagates
+    # over a small combinator chain (the first banks as compile time),
+    # so the roofline table always carries a warm `dataflow_fused` row
+    df_store, df_g = _build_dataflow_chains(n_chains=3, depth=2)
+    for rep in range(2):
+        for c in range(3):
+            kind = c % 3
+            if kind == 0:
+                df_store.update(f"g{c}_0", ("add", rep), "w")
+            elif kind == 1:
+                df_store.update(f"s{c}_0", ("add", f"e{rep}"), "w")
+            else:
+                df_store.update(f"o{c}_0", ("add", f"x{rep}"), "w")
+        df_g.propagate(mode="fused")
     return rt
 
 
@@ -1371,6 +1385,209 @@ def many_vars(
     }
 
 
+def _build_dataflow_chains(n_chains: int, depth: int):
+    """The ``dataflow_chain`` graph: ``n_chains`` parallel depth-``depth``
+    combinator chains cycling the three dataflow codec shapes — G-Set
+    ``map`` (leafwise, projection tables), OR-Set ``filter`` (leafwise
+    token planes), OR-SWOT ``bind_to`` (vclock codec) — plus a ``union``
+    cascade joining the G-Set chain tails. Parallel same-kind chains put
+    same-signature edges at every level, the shape the fused compiler
+    stacks into ``[G, ...]`` vmapped groups."""
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.store import Store
+
+    store = Store(n_actors=2)
+    g = Graph(store)
+    gset_tails = []
+    for c in range(n_chains):
+        kind = c % 3
+        if kind == 0:
+            cur = store.declare(id=f"g{c}_0", type="lasp_gset", n_elems=8)
+            for d in range(depth):
+                cur = g.map(
+                    cur, (lambda k: (lambda x: x + k))(d + 1),
+                    dst=f"g{c}_{d + 1}", dst_elems=8,
+                )
+            gset_tails.append(cur)
+        elif kind == 1:
+            cur = store.declare(
+                id=f"s{c}_0", type="lasp_orset", n_elems=4, n_actors=2,
+                tokens_per_actor=16,
+            )
+            for d in range(depth):
+                cur = g.filter(cur, lambda t: True, dst=f"s{c}_{d + 1}")
+        else:
+            store.declare(
+                id=f"o{c}_0", type="riak_dt_orswot", n_elems=4, n_actors=2
+            )
+            for d in range(depth):
+                g.bind_to(f"o{c}_{d + 1}", f"o{c}_{d}")
+    u = None
+    for i in range(len(gset_tails) - 1):
+        u = g.union(u or gset_tails[0], gset_tails[i + 1], dst=f"u{i}")
+    return store, g
+
+
+def dataflow_chain(n_chains: int = 9, depth: int = 8, reps: int = 3) -> dict:
+    """Whole-graph dataflow fusion A/B (the ISSUE-8 tentpole evidence):
+    one deep write wave — every chain head written once — propagated to
+    its fixed point under both schedulers from identical snapshots:
+
+    - **per_edge**: the historical frontier-scheduled host loop — one
+      jitted eligible-subset dispatch + a changed-flags host sync per
+      sweep, O(k) round-trips for a k-round wave;
+    - **fused**: the dirty closure compiled into ONE on-device
+      fixed-point megakernel (``dataflow.plan`` — leveled,
+      same-signature-stacked, ``lax.while_loop`` round control), one
+      dispatch for the whole wave.
+
+    Both arms replay the identical cold schedule ``reps`` times warm
+    (states + dirty marks + edge-ran flags restored per rep; compiles
+    land in the cold pass, outside the clock) and the scenario ASSERTS
+    the fusion contract: bit-identical final states on every variable
+    and identical round counts. ``impl_block_seconds`` carries the
+    ROUND-LOOP seconds per arm (the engine's own
+    ``dataflow_propagate_seconds`` clock — refresh/ingest host work is
+    identical across arms and reported separately under ``timing``);
+    ``impl_roofline`` prices BOTH arms against one shared ideal-traffic
+    numerator (the ``dataflow_fused`` ledger convention: one Jacobi
+    sweep over the closure × sweeps executed), so achieved GB/s
+    compares like-for-like — exactly the Pallas-race convention."""
+    import jax
+    import jax.numpy as jnp
+
+    from lasp_tpu.telemetry import get_ledger, get_registry
+
+    def hist_sum() -> float:
+        fam = get_registry().snapshot().get("dataflow_propagate_seconds")
+        if not fam:
+            return 0.0
+        return sum(s["sum"] for s in fam["series"])
+
+    def seed(store):
+        for c in range(n_chains):
+            kind = c % 3
+            if kind == 0:
+                store.update(f"g{c}_0", ("add", c), "w")
+            elif kind == 1:
+                store.update(f"s{c}_0", ("add", f"e{c}"), "w")
+            else:
+                store.update(f"o{c}_0", ("add", f"x{c}"), "w")
+
+    def snapshot(store, g):
+        return (
+            {v: jax.tree_util.tree_map(jnp.array, store.state(v))
+             for v in store.ids()},
+            dict(store.dirty_seq), store.mutations, g._dirty_cursor,
+        )
+
+    def restore(store, g, snap):
+        states, dirty_seq, mutations, cursor = snap
+        for v, st in states.items():
+            store._vars[v].state = jax.tree_util.tree_map(jnp.array, st)
+        store.dirty_seq = dict(dirty_seq)
+        store.mutations = mutations
+        g._dirty_cursor = cursor
+        # every edge owes its initial run again: the warm rep replays
+        # the cold pass's exact schedule (same dirty closure, same
+        # eligible subsets), hitting the compiled executables
+        g._edge_ran = [False] * len(g.edges)
+        g._clean_mark = None
+
+    results: dict = {}
+    finals: dict = {}
+    n_edges = rounds = None
+    plan_shape = None
+    fused_bytes_per_rep = 0
+    for arm, mode in (("per_edge", "per_edge"), ("fused", "fused")):
+        store, g = _build_dataflow_chains(n_chains, depth)
+        n_edges = len(g.edges)
+        seed(store)
+        snap = snapshot(store, g)
+        cold_rounds = g.propagate(mode=mode)  # compiles outside the clock
+        if arm == "fused":
+            ents = [e for k, e in g._cache._entries.items()
+                    if k[0] == "fused" and e is not None]
+            plan_shape = {
+                "groups": len(ents[0].groups),
+                "edges_stacked": ents[0].n_stacked,
+                "sweep_bytes": ents[0].sweep_bytes,
+            }
+        loop_secs, wall_secs = [], []
+        bytes0 = get_ledger().totals()["bytes"]
+        for _ in range(reps):
+            restore(store, g, snap)
+            h0 = hist_sum()
+            (r, wall) = _timed(lambda: g.propagate(mode=mode))
+            loop = hist_sum() - h0
+            # telemetry disabled -> the engine's histogram clock no-ops;
+            # fall back to wall time rather than divide by zero later
+            loop_secs.append(loop if loop > 0.0 else wall)
+            wall_secs.append(wall)
+            assert r == cold_rounds  # identical replay
+        if arm == "fused":
+            fused_bytes_per_rep = (
+                get_ledger().totals()["bytes"] - bytes0
+            ) // reps
+        results[arm] = {
+            "roundloop_seconds": float(np.median(loop_secs)),
+            "propagate_seconds": float(np.median(wall_secs)),
+            "seconds_each": [round(s, 6) for s in loop_secs],
+            "noise_band": round(
+                max(loop_secs) / max(min(loop_secs), 1e-9), 2
+            ),
+            "rounds": cold_rounds,
+        }
+        rounds = cold_rounds
+        finals[arm] = {
+            v: jax.tree_util.tree_map(np.asarray, store.state(v))
+            for v in store.ids()
+        }
+        del store, g
+
+    # the fusion contract, asserted at the bench shape: identical round
+    # counts and bit-identical final states across the two schedulers
+    assert results["per_edge"]["rounds"] == results["fused"]["rounds"]
+    same = jax.tree_util.tree_map(
+        lambda a, b: bool(np.array_equal(a, b)),
+        finals["per_edge"], finals["fused"],
+    )
+    assert all(jax.tree_util.tree_leaves(same)), "scheduler states diverged"
+
+    pe_s = results["per_edge"]["roundloop_seconds"]
+    fu_s = results["fused"]["roundloop_seconds"]
+    impl_roofline = _arm_roofline({
+        arm: (fused_bytes_per_rep * reps,
+              results[arm]["roundloop_seconds"] * reps)
+        for arm in results
+    })
+    return {
+        "scenario": f"dataflow_chain_{n_edges}e",
+        "n_edges": n_edges,
+        "n_chains": n_chains,
+        "depth": depth,
+        "rounds": rounds,
+        "plan": plan_shape,
+        "impl_block_seconds": {
+            "per_edge": round(pe_s, 6),
+            "fused": round(fu_s, 6),
+        },
+        "impl_roofline": impl_roofline,
+        "timing": {
+            "policy": f"median of {reps} warm cold-schedule replays per "
+                      "arm; roundloop = the engine's "
+                      "dataflow_propagate_seconds clock (excludes the "
+                      "arm-identical refresh/ingest host work)",
+            "per_edge": results["per_edge"],
+            "fused": results["fused"],
+        },
+        "dataflow_impl": "fused" if fu_s <= pe_s else "per_edge",
+        "fused_speedup": round(pe_s / fu_s, 2),
+        "engine": "Graph.propagate(mode=per_edge|fused)",
+        "check": "bit-identical states + round counts across schedulers",
+    }
+
+
 def packed_vs_dense(n_replicas: int = 1 << 20, blocks: int = 4, block: int = 8) -> dict:
     """Same engine workload (OR-Set source + map edge + random gossip),
     identical seeds and round counts, run twice: dense codec state vs the
@@ -1747,5 +1964,6 @@ SCENARIOS = {
     "partitioned_gossip": partitioned_gossip,
     "frontier_sparse": frontier_sparse,
     "many_vars": many_vars,
+    "dataflow_chain": dataflow_chain,
     "chaos_heal": chaos_heal,
 }

@@ -607,9 +607,9 @@ def main(argv=None) -> int:
     scen.add_argument(
         "name",
         choices=["adcounter_10m", "adcounter_6", "bridge_throughput",
-                 "chaos_heal", "frontier_sparse", "gset_1k", "many_vars",
-                 "orset_100k", "packed_vs_dense", "partitioned_gossip",
-                 "pipeline_1m"],
+                 "chaos_heal", "dataflow_chain", "frontier_sparse",
+                 "gset_1k", "many_vars", "orset_100k", "packed_vs_dense",
+                 "partitioned_gossip", "pipeline_1m"],
     )
     scen.add_argument("--replicas", type=int, default=0,
                       help="override the population for sized scenarios")

@@ -73,6 +73,12 @@ class Edge:
     #: actually changes something, so the steady state (no new terms) pays
     #: no host->device upload per propagate
     _tables_cache = None
+    #: may this edge stack with same-signature peers in a fused propagate
+    #: megakernel (``dataflow.plan``)? The graph compiler's poison guard
+    #: flips this to False (on the INSTANCE) when a group containing the
+    #: edge fails to trace stacked — the loud per-group fallback — and an
+    #: operator can pre-poison an edge the same way.
+    stackable = True
 
     def describe(self) -> dict:
         """Provenance record — which variables feed this edge's output,
@@ -113,6 +119,15 @@ class Edge:
 
     def contribution(self, tables, *src_states):
         raise NotImplementedError
+
+    def signature(self) -> "tuple | None":
+        """Stacking signature: edges with equal signatures run IDENTICAL
+        traced contribution code over identically-shaped tables and
+        source states, so a fused propagate round can stack them into
+        one ``[G, ...]`` vmapped evaluation (``dataflow.plan``; the
+        granularity mirrors ``mesh.plan.signature_of``). None = never
+        stack (unknown edge classes are conservatively singletons)."""
+        return None
 
 
 class ProjectEdge(Edge):
@@ -165,6 +180,14 @@ class ProjectEdge(Edge):
         if self.kind == "filter":
             return (jnp.asarray(self._keep),)
         return (jnp.asarray(self._proj),)
+
+    def signature(self):
+        # map and fold share one traced kernel (both are projection-table
+        # contributions — fold only differs in how the HOST builds the
+        # table), so they stack together; filter's keep-mask kernel is
+        # its own family
+        stack_kind = "filter" if self.kind == "filter" else "proj"
+        return (stack_kind, self.family, self.src_spec, self.dst_spec)
 
     def contribution(self, tables, src):
         (table,) = tables
@@ -250,6 +273,10 @@ class PairwiseEdge(Edge):
             jnp.asarray(self._valid[1]),
         )
 
+    def signature(self):
+        return (self.kind, self.family, self.l_spec, self.r_spec,
+                self.dst_spec)
+
     def contribution(self, tables, left, right):
         inv_l, valid_l, inv_r, valid_r = tables
         if self.family == "gset":
@@ -313,6 +340,10 @@ class ProductEdge(Edge):
         self.l_spec, self.r_spec = l_var.spec, r_var.spec
         self.dst_spec = store.variable(dst).spec
 
+    def signature(self):
+        return ("product", self.family, self.l_spec, self.r_spec,
+                self.dst_spec)
+
     def contribution(self, tables, left, right):
         del tables
         if self.family == "gset":
@@ -340,6 +371,10 @@ class BindToEdge(Edge):
         src_var, dst_var = store.variable(src), store.variable(dst)
         if src_var.spec != dst_var.spec:
             raise TypeError("bind_to requires identically-specced variables")
+        self.spec = src_var.spec
+
+    def signature(self):
+        return ("bind_to", self.spec)
 
     def contribution(self, tables, src):
         del tables

@@ -17,6 +17,8 @@ replacing the reference tests' ``timer:sleep`` waits (SURVEY.md §4 caveat).
 from __future__ import annotations
 
 import dataclasses
+import time
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +26,7 @@ import numpy as np
 
 from ..lattice.orset import ORSetSpec
 from ..lattice.gset import GSetSpec
+from . import plan as dplan
 from .edges import BindToEdge, Edge, PairwiseEdge, ProductEdge, ProjectEdge
 
 
@@ -68,11 +71,6 @@ class PairUniverse:
         return frozenset(out)
 
 
-def _select(pred, a, b):
-    """Per-leaf ``where`` over same-structure pytrees (the inflation gate)."""
-    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
-
-
 class Graph:
     """Static combinator graph over a :class:`~lasp_tpu.store.Store`.
 
@@ -90,9 +88,18 @@ class Graph:
         self._clean_mark: tuple | None = None  # (store.mutations, n_edges)
         #: frontier scheduling over edges: has edge i contributed at
         #: least once since the last _build? (a never-run edge is always
-        #: eligible); plus the per-eligible-subset jit cache
+        #: eligible)
         self._edge_ran: list = []
-        self._subset_fns: dict = {}
+        #: the ONE keyed propagate-executable cache (FIFO-64): per-edge
+        #: eligible-subset round fns AND fused megakernels, per
+        #: dirty-subset signature (dataflow.plan.PropagateCache)
+        self._cache = dplan.PropagateCache()
+        #: propagate scheduling: "auto" compiles the dirty closure into
+        #: one on-device fixed-point megakernel and falls back loudly to
+        #: the per-edge host loop on compile/dispatch failure; "fused"
+        #: raises instead of falling back; "per_edge" is the historical
+        #: one-dispatch-per-sweep path (the bench A/B arm)
+        self.fusion: str = "auto"
         #: store.mutations value whose writes this graph has fully
         #: propagated — feeds Store.dirty_since for the initial frontier
         self._dirty_cursor: int = 0
@@ -337,11 +344,9 @@ class Graph:
             for dst, cs in contribs.items():
                 codec, spec = meta[dst]
                 cur = states[dst]
-                new = cur
-                for c in cs:
-                    merged = codec.merge(spec, new, c)
-                    # inflation gate = bind rule (src/lasp_core.erl:301-311)
-                    new = _select(codec.is_inflation(spec, new, merged), merged, new)
+                # merge chain + inflation gate = bind rule, shared with
+                # the subset round and the fused megakernel (plan.py)
+                new = dplan.merge_into_dst(codec, spec, cur, cs)
                 # ¬equal, not strict-inflation: vclock types can change dots
                 # under equal clocks (same blindness as the mesh residual)
                 residual += (~codec.equal(spec, cur, new)).astype(jnp.int32)
@@ -351,9 +356,11 @@ class Graph:
         self._round_fn_pure = round_fn
         self._jitted = jax.jit(round_fn)
         # frontier bookkeeping starts over: every edge owes one run
-        # against the rebuilt tables/universes
+        # against the rebuilt tables/universes; the executable cache
+        # (subset round fns + fused megakernels) keys by edge indices,
+        # which a rebuild may have re-meant
         self._edge_ran = [False] * len(edges)
-        self._subset_fns = {}
+        self._cache = dplan.PropagateCache()
 
     def _subset_round(self, idx: tuple):
         """Jitted sweep over ONLY the edges named by ``idx`` (indices into
@@ -362,16 +369,13 @@ class Graph:
         merged into their dst (idempotent join), so re-evaluating them is
         pure waste. Returns ``(fn, dst_order)`` where ``fn(states,
         tables) -> (new_states, changed: bool[len(dst_order)])`` — the
-        per-dst change flags seed the next round's dirty set."""
-        cached = self._subset_fns.get(idx)
+        per-dst change flags seed the next round's dirty set. Lives in
+        the shared FIFO-bounded :class:`~.plan.PropagateCache` next to
+        the fused megakernels (one bound, one hit/built ledger)."""
+        key = ("subset", idx)
+        cached = self._cache.get(key)
         if cached is not None:
             return cached
-        # bounded: distinct dirty patterns each compile an executable; a
-        # long-lived process alternating write sets must not accumulate
-        # them without limit (FIFO eviction — dicts preserve insertion
-        # order, and a re-compile after eviction is just a warm retrace)
-        if len(self._subset_fns) >= 64:
-            self._subset_fns.pop(next(iter(self._subset_fns)))
         sel = [(i, self.edges[i]) for i in idx]
         dst_order: list = []
         for _i, e in sel:
@@ -389,40 +393,53 @@ class Graph:
             for dst in dst_order:
                 codec, spec = meta[dst]
                 cur = states[dst]
-                new = cur
-                for c in contribs[dst]:
-                    merged = codec.merge(spec, new, c)
-                    new = _select(
-                        codec.is_inflation(spec, new, merged), merged, new
-                    )
+                new = dplan.merge_into_dst(codec, spec, cur, contribs[dst])
                 changed.append(~codec.equal(spec, cur, new))
                 new_states[dst] = new
             return new_states, jnp.stack(changed)
 
         out = (jax.jit(round_fn), tuple(dst_order))
-        self._subset_fns[idx] = out
+        self._cache.put(key, out)
         return out
 
-    def propagate(self, max_rounds: int | None = None) -> int:
-        """Run jitted rounds to the fixed point; ingest results back into the
-        store (waking threshold watches). Returns the number of rounds that
-        performed work. Replaces every ``timer:sleep`` in the reference test
-        suite with a convergence predicate (SURVEY.md §4).
+    def propagate(
+        self, max_rounds: int | None = None, mode: str | None = None
+    ) -> int:
+        """Run rounds to the fixed point; ingest results back into the
+        store (waking threshold watches). Returns the number of rounds
+        that performed work. Replaces every ``timer:sleep`` in the
+        reference test suite with a convergence predicate (SURVEY.md §4).
 
-        Frontier-scheduled: each round sweeps ONLY the edges whose
-        sources moved — seeded from the store's dirty set
-        (``Store.dirty_vars``, marked on every bind/update/ingest write),
-        then per-round from the dsts the previous sweep changed. An edge
-        whose sources are all clean contributes exactly what it already
-        merged (idempotent join), so skipping it cannot change the fixed
-        point or the round count — same states, same rounds, less work
-        (one write into a 50-edge graph recomputes its own chain, not
-        the whole graph)."""
+        Scheduling (``mode``, default ``self.fusion`` = ``"auto"``):
+
+        - ``"auto"`` / ``"fused"`` — the dirty closure (edges reachable
+          from the store's dirty set, plus never-ran edges) compiles
+          into ONE on-device fixed-point megakernel
+          (``dataflow.plan``): a leveled, same-signature-stacked Jacobi
+          sweep inside a ``lax.while_loop`` that exits when the per-dst
+          change flags are all-false — a k-round, e-edge propagate is
+          one dispatch instead of O(k·e). Bit-identical values AND
+          round counts vs the per-edge path (the closure argument is
+          the same idempotent-join argument as edge skipping; the sweep
+          body is the same Jacobi round). ``"auto"`` falls back to the
+          per-edge path loudly (``dataflow_plan_fallbacks_total`` +
+          ``RuntimeWarning``) when a megakernel fails to build or run;
+          ``"fused"`` raises instead.
+        - ``"per_edge"`` — the historical frontier-scheduled host loop:
+          each sweep dispatches ONLY the edges whose sources moved,
+          with host-side round control between sweeps (the bench A/B
+          arm, and the fallback target)."""
         if not self.edges:
             return 0
         if self._clean_mark == (self.store.mutations, len(self.edges)):
             return 0  # nothing written since the last fixed point
-        from ..telemetry import counter, histogram, span
+        mode = self.fusion if mode is None else mode
+        if mode not in ("auto", "fused", "per_edge"):
+            raise ValueError(
+                f"unknown propagate mode {mode!r} "
+                "(expected auto/fused/per_edge)"
+            )
+        from ..telemetry import span
         from ..utils.metrics import Timer
 
         self.refresh()
@@ -431,106 +448,31 @@ class Graph:
         tables = tuple(e.device_tables() for e in self.edges)
         states = {v: self.store.state(v) for v in self._var_ids}
         limit = max_rounds if max_rounds is not None else len(self.edges) + 1
-        rounds = 0
-        executed = 0  # jitted sweeps issued
-        runs = [0] * len(self.edges)  # per-edge contribution evaluations
         dirty = self.store.dirty_since(self._dirty_cursor) & set(
             self._var_ids
         )
+        #: shared run accounting, filled by whichever body executed —
+        #: the finally-emission lands for the non-convergence raise too
+        #: (a runaway propagate is exactly what an operator scrapes for)
+        stats = {
+            "rounds": 0, "executed": 0, "runs": [0] * len(self.edges),
+            "fused": False, "changed_by_dst": None,
+        }
+        t = Timer()
         try:
-            with span("dataflow.propagate", edges=len(self.edges)):
-                with Timer() as t:
-                    for _ in range(limit):
-                        eligible = tuple(
-                            i
-                            for i, e in enumerate(self.edges)
-                            if not self._edge_ran[i]
-                            or (dirty & set(e.srcs))
-                        )
-                        if not eligible:
-                            break  # empty frontier: no edge can move
-                        fn, dst_order = self._subset_round(eligible)
-                        states, changed_vec = fn(states, tables)
-                        executed += 1
-                        for i in eligible:
-                            self._edge_ran[i] = True
-                            runs[i] += 1
-                        dirty = {
-                            d
-                            for d, c in zip(
-                                dst_order, np.asarray(changed_vec).tolist()
-                            )
-                            if c
-                        }
-                        if not dirty:
-                            break
-                        rounds += 1
-                    else:
-                        raise RuntimeError(
-                            f"dataflow did not converge within {limit} "
-                            "rounds (cyclic graph? raise max_rounds)"
-                        )
-        finally:
-            # emissions land for the non-convergence raise too — a
-            # runaway propagate is exactly what an operator scrapes for
-            counter(
-                "dataflow_rounds_total",
-                help="jitted dataflow sweeps executed",
-            ).inc(executed)
-            histogram(
-                "dataflow_propagate_seconds",
-                help="wall time of a propagate-to-fixpoint run",
-            ).observe(t.elapsed)
-            # per-edge recompute counts, by combinator kind — with
-            # frontier scheduling an edge only recomputes in sweeps
-            # where it was eligible; the skipped evaluations are counted
-            # too (the "work the frontier saved" metric)
-            by_kind: dict = {}
-            skipped_by_kind: dict = {}
-            for i, e in enumerate(self.edges):
-                by_kind[e.kind] = by_kind.get(e.kind, 0) + runs[i]
-                skipped_by_kind[e.kind] = (
-                    skipped_by_kind.get(e.kind, 0) + executed - runs[i]
-                )
-            for kind, n in by_kind.items():
-                if n:
-                    counter(
-                        "dataflow_edge_recomputes_total",
-                        help="edge contribution evaluations, by combinator "
-                             "kind",
-                        kind=kind,
-                    ).inc(n)
-            total_skipped = 0
-            for kind, n in skipped_by_kind.items():
-                if n:
-                    total_skipped += n
-                    counter(
-                        "dataflow_edges_skipped_total",
-                        help="edge evaluations skipped by frontier "
-                             "scheduling (source set clean), by kind",
-                        kind=kind,
-                    ).inc(n)
-            # causal log: one coarse record per propagate run; the deep
-            # tier adds per-edge recompute provenance (srcs -> dst, the
-            # trail `lasp_tpu trace --var` reconstructs values from)
-            from ..telemetry import events as tel_events
-
-            tel_events.emit(
-                "propagate", rounds=rounds, sweeps=executed,
-                edges=len(self.edges),
-            )
-            if total_skipped:
-                tel_events.emit(
-                    "frontier_skip", skipped=int(total_skipped),
-                    sweeps=executed, edges=len(self.edges),
-                )
-            if tel_events.deep_enabled():
-                for i, e in enumerate(self.edges):
-                    d = e.describe()
-                    tel_events.emit_deep(
-                        "edge_recompute", var=d["dst"], kind=d["kind"],
-                        srcs=d["srcs"], sweeps=runs[i],
+            with t, span("dataflow.propagate", edges=len(self.edges)):
+                done = False
+                if mode != "per_edge":
+                    done = self._propagate_fused(
+                        states, tables, dirty, limit, stats,
+                        strict=(mode == "fused"),
                     )
+                if not done:
+                    self._propagate_per_edge(
+                        states, tables, dirty, limit, stats
+                    )
+        finally:
+            self._emit_propagate_telemetry(stats, t.elapsed)
         pre_ingest = self.store.mutations
         writes = self.store.ingest(states)
         if self.store.mutations == pre_ingest + writes:
@@ -543,4 +485,207 @@ class Graph:
             # a watch callback wrote during ingest; stay dirty so the next
             # propagate folds that write in
             self._clean_mark = None
-        return rounds
+        return stats["rounds"]
+
+    def _propagate_fused(
+        self, states, tables, dirty, limit, stats, strict: bool
+    ) -> bool:
+        """The megakernel body: compile (or fetch) the dirty closure's
+        fused executable and run the WHOLE fixed point in one dispatch.
+        Mutates ``states``/``stats`` in place; returns True when this
+        path handled the propagate, False to fall back to the per-edge
+        loop (never after device state was consumed — the fused
+        executable is functional, so a failed dispatch leaves ``states``
+        untouched)."""
+        idx = dplan.closure_edges(self.edges, self._edge_ran, dirty)
+        if not idx:
+            return True  # empty frontier: no edge can move (0 rounds)
+        key = ("fused", idx)
+        ent = self._cache.get(key)
+        if ent is dplan.POISON:
+            if strict:
+                raise RuntimeError(
+                    "fused propagate for this dirty pattern previously "
+                    "failed to build; mode='fused' refuses the fallback"
+                )
+            return False
+        from ..telemetry import counter
+        from ..telemetry.roofline import get_ledger
+
+        t0 = time.perf_counter()
+        try:
+            if ent is None:
+                ent = dplan.compile_fused(self, idx, states, tables)
+                self._cache.put(key, ent)
+            # the round budget is a traced operand: one executable per
+            # dirty pattern serves every max_rounds a caller passes
+            out = ent.fn(states, tables, jnp.int32(limit))
+            jax.block_until_ready(out[1:])
+        except Exception as exc:  # noqa: BLE001 — the loud-fallback contract
+            self._cache.poison(key)
+            counter(
+                "dataflow_plan_fallbacks_total",
+                help="fused-propagate fallbacks, by reason: `stack` = a "
+                     "same-signature group failed to trace stacked and "
+                     "was demoted to per-edge evaluation; `dispatch` = "
+                     "a fused megakernel failed to build or run and the "
+                     "propagate fell back to the per-edge path",
+                reason="dispatch",
+            ).inc()
+            if strict:
+                raise
+            warnings.warn(
+                f"fused propagate fell back to the per-edge path "
+                f"(dirty closure {idx}): {exc!r}",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return False
+        secs = time.perf_counter() - t0
+        new_states, counts, sweeps, pending = out
+        sweeps = int(sweeps)
+        pending = bool(pending)
+        counts = np.asarray(counts)
+        get_ledger().record(
+            "dataflow_fused", "Graph",
+            n_replicas=1, fanout=len(idx), seconds=secs,
+            row_bytes=ent.sweep_bytes, window=sweeps, rounds=sweeps,
+            bytes_moved=ent.sweep_bytes * sweeps,
+            joins=len(idx) * sweeps, n_vars=len(idx),
+        )
+        for i in idx:
+            self._edge_ran[i] = True
+            stats["runs"][i] = sweeps
+        stats["executed"] = sweeps
+        stats["fused"] = True
+        stats["changed_by_dst"] = {
+            d: int(c) for d, c in zip(ent.dst_order, counts.tolist())
+        }
+        # productive sweeps: the loop exits right after its first
+        # unproductive sweep (the convergence check), so rounds =
+        # sweeps - 1 — unless the budget ran out mid-flight, where every
+        # executed sweep was productive (the host loop counts the same)
+        stats["rounds"] = sweeps if pending else max(sweeps - 1, 0)
+        states.update(
+            {v: new_states[v] for v in ent.dst_order}
+        )
+        if pending:
+            raise RuntimeError(
+                f"dataflow did not converge within {limit} "
+                "rounds (cyclic graph? raise max_rounds)"
+            )
+        return True
+
+    def _propagate_per_edge(self, states, tables, dirty, limit, stats):
+        """The historical frontier-scheduled host loop: each sweep
+        dispatches ONLY the edges whose sources moved — seeded from the
+        store's dirty set, then per-round from the dsts the previous
+        sweep changed. An edge whose sources are all clean contributes
+        exactly what it already merged (idempotent join), so skipping
+        it cannot change the fixed point or the round count — same
+        states, same rounds, less work. Mutates ``states``/``stats``
+        in place."""
+        cur = states
+        for _ in range(limit):
+            eligible = tuple(
+                i
+                for i, e in enumerate(self.edges)
+                if not self._edge_ran[i] or (dirty & set(e.srcs))
+            )
+            if not eligible:
+                break  # empty frontier: no edge can move
+            fn, dst_order = self._subset_round(eligible)
+            cur, changed_vec = fn(cur, tables)
+            stats["executed"] += 1
+            for i in eligible:
+                self._edge_ran[i] = True
+                stats["runs"][i] += 1
+            dirty = {
+                d
+                for d, c in zip(dst_order, np.asarray(changed_vec).tolist())
+                if c
+            }
+            if not dirty:
+                break
+            stats["rounds"] += 1
+        else:
+            raise RuntimeError(
+                f"dataflow did not converge within {limit} "
+                "rounds (cyclic graph? raise max_rounds)"
+            )
+        states.update(cur)
+
+    def _emit_propagate_telemetry(self, stats, elapsed: float) -> None:
+        """The propagate run's whole emission path, factored out so the
+        overhead guard (``telemetry.overhead``) can price the fused hot
+        path exactly: counters, the per-kind recompute/skip accounting,
+        and the coarse causal-log records (including the fused window's
+        per-dst changed counts — the summary that keeps ``lasp_tpu
+        trace --var`` lineage from silently dropping fused rounds)."""
+        from ..telemetry import counter, histogram
+        from ..telemetry import events as tel_events
+
+        executed = stats["executed"]
+        runs = stats["runs"]
+        counter(
+            "dataflow_rounds_total",
+            help="jitted dataflow sweeps executed",
+        ).inc(executed)
+        histogram(
+            "dataflow_propagate_seconds",
+            help="wall time of a propagate-to-fixpoint run",
+        ).observe(elapsed)
+        # per-edge recompute counts, by combinator kind — an edge only
+        # recomputes in sweeps where it was scheduled (eligible subset
+        # on the per-edge path, dirty closure on the fused path); the
+        # skipped evaluations are counted too (the "work the frontier
+        # saved" metric)
+        by_kind: dict = {}
+        skipped_by_kind: dict = {}
+        for i, e in enumerate(self.edges):
+            by_kind[e.kind] = by_kind.get(e.kind, 0) + runs[i]
+            skipped_by_kind[e.kind] = (
+                skipped_by_kind.get(e.kind, 0) + executed - runs[i]
+            )
+        for kind, n in by_kind.items():
+            if n:
+                counter(
+                    "dataflow_edge_recomputes_total",
+                    help="edge contribution evaluations, by combinator "
+                         "kind",
+                    kind=kind,
+                ).inc(n)
+        total_skipped = 0
+        for kind, n in skipped_by_kind.items():
+            if n:
+                total_skipped += n
+                counter(
+                    "dataflow_edges_skipped_total",
+                    help="edge evaluations skipped by frontier "
+                         "scheduling (source set clean), by kind",
+                    kind=kind,
+                ).inc(n)
+        # causal log: one coarse record per propagate run — the fused
+        # path's record carries the per-dst changed-sweep counts (the
+        # only per-round signal that survives the on-device loop); the
+        # deep tier adds per-edge recompute provenance (srcs -> dst,
+        # the trail `lasp_tpu trace --var` reconstructs values from)
+        attrs = {
+            "rounds": stats["rounds"], "sweeps": executed,
+            "edges": len(self.edges), "fused": stats["fused"],
+        }
+        if stats["changed_by_dst"] is not None:
+            attrs["changed_by_dst"] = stats["changed_by_dst"]
+        tel_events.emit("propagate", **attrs)
+        if total_skipped:
+            tel_events.emit(
+                "frontier_skip", skipped=int(total_skipped),
+                sweeps=executed, edges=len(self.edges),
+            )
+        if tel_events.deep_enabled():
+            for i, e in enumerate(self.edges):
+                d = e.describe()
+                tel_events.emit_deep(
+                    "edge_recompute", var=d["dst"], kind=d["kind"],
+                    srcs=d["srcs"], sweeps=runs[i],
+                )

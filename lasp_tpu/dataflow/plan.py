@@ -1,0 +1,395 @@
+"""Whole-graph dataflow fusion: the propagate plan compiler.
+
+``Graph.propagate`` historically walked the combinator DAG one jitted
+sweep at a time — host-side round control, a host sync per sweep to read
+the change flags, and a host-side dirty-set walk between sweeps. A
+k-round propagate over e edges therefore cost O(k) dispatches (each a
+full eligible-subset retrace key) even though every sweep is the same
+pure function of the previous states. DrJAX (PAPERS.md: mapped
+MapReduce primitives as traceable JAX ops) is the blueprint this module
+follows: express the WHOLE combinator graph as one traced program and
+let the fixed-point iteration run on device.
+
+The compiler:
+
+1. **closes over the dirty set** — :func:`closure_edges` computes the
+   forward closure of the initially-dirty variables through the edge
+   DAG (plus never-ran edges). Edges outside the closure can never
+   become eligible during this propagate, so they are excluded from the
+   traced program entirely (the megakernel is keyed per *dirty-subset
+   signature*, exactly like the per-edge path's eligible-subset cache).
+2. **levels the DAG** — :func:`level_groups` assigns each closure edge
+   a topological level (longest source-distance of its inputs; cyclic
+   graphs clamp deterministically) and, WITHIN each level, groups edges
+   by stacking signature (``Edge.signature()`` — edge kind × src/dst
+   codec × spec, the ``mesh.plan.signature_of`` granularity, shared via
+   ``mesh.plan.hashable_signature``).
+3. **stacks each group** — a group's tables and source states stack
+   leafwise into ``[G, ...]`` super-tensors (``mesh.plan.stack_group``)
+   and ONE vmapped contribution evaluates all members; a group that
+   fails to trace stacked is demoted to per-edge evaluation, loudly
+   (``dataflow_plan_fallbacks_total{reason="stack"}`` + a
+   ``RuntimeWarning``), and its members are poisoned non-stackable.
+4. **runs the fixed point on device** — the compiled round function
+   drives ``ops.fused.fused_dataflow_rounds``' ``lax.while_loop``:
+   rounds repeat until the per-dst change flags are all-false (or the
+   round budget is hit, surfaced as the same non-convergence error the
+   host loop raises). One dispatch replaces O(k·e) — the whole win is
+   dispatch/sync amortization.
+
+**Why bit-identity holds** (the contract that made PR 5 safe to ship):
+the round body is the SAME Jacobi sweep the per-edge path executes —
+every contribution reads the pre-round states, contributions merge into
+each dst in edge-index order through the same inflation gate, and
+change flags use the same ``~codec.equal``. Stacking is vmap of a
+deterministic computation (the same computation, batched) and the
+closure argument is the idempotent-join argument frontier scheduling
+already relies on: an excluded edge's contribution is already absorbed
+in its dst, so re-evaluating it cannot move anything. Level order does
+NOT chain values inside a sweep (no Gauss–Seidel): chaining would
+converge deep pipelines in fewer rounds but change the observable
+per-round state trajectory (threshold watches fire from ingested
+states) and the reported round counts — that is the fusion boundary,
+per "Fast and Fusiest" (PAPERS.md): fuse everything that preserves the
+schedule, cut where it wouldn't (see docs/PERF.md "Dataflow fusion").
+
+The per-dirty-pattern executables live in ONE keyed, FIFO-bounded
+:class:`PropagateCache` shared by the fused megakernels and the
+per-edge path's eligible-subset round functions (formerly two caches),
+with hit/built counters under ``dataflow_plan_*``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from ..mesh.plan import hashable_signature, stack_group
+from ..telemetry import counter, gauge
+
+#: cache sentinel: this key failed to build or dispatch; callers fall
+#: back to the per-edge path without retrying the compile every run
+POISON = object()
+
+
+def tree_select(pred, a, b):
+    """Per-leaf ``where`` over same-structure pytrees (the inflation
+    gate — the ``bind`` rule's accept/ignore select)."""
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def merge_into_dst(codec, spec, cur, contribs):
+    """The ONE per-dst merge chain every round builder shares: fold the
+    contributions into ``cur`` in the given order — join, then accept
+    through the inflation gate (the ``bind`` rule,
+    ``src/lasp_core.erl:301-311``). The fused megakernel
+    (:func:`make_round_fn`), the per-edge subset round, and the
+    whole-graph dense round all call THIS, so the bit-identity contract
+    between the three schedulers cannot drift."""
+    new = cur
+    for c in contribs:
+        merged = codec.merge(spec, new, c)
+        new = tree_select(codec.is_inflation(spec, new, merged), merged, new)
+    return new
+
+
+class PropagateCache:
+    """The ONE keyed propagate-executable cache: per-edge
+    eligible-subset round functions (``("subset", idx)``) and fused
+    megakernels (``("fused", idx)`` — the round budget is a traced
+    operand, never part of the key) share one FIFO-bounded dict
+    — a long-lived process alternating write sets must not accumulate
+    compiled executables without limit, and splitting the bound across
+    two caches (the PR 3 shape) doubled the worst case. Hits and builds
+    export under ``dataflow_plan_cache_*`` by kind."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = capacity
+        self._entries: dict = {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key):
+        ent = self._entries.get(key)
+        if ent is not None and ent is not POISON:
+            # a POISON lookup is not a hit: nothing compiled is being
+            # reused, and counting it would let a fallback storm look
+            # like a healthy hit/built ratio
+            counter(
+                "dataflow_plan_cache_hits_total",
+                help="propagate executable-cache hits (subset round fns "
+                     "+ fused megakernels share one FIFO-bounded cache)",
+                kind=key[0],
+            ).inc()
+        return ent
+
+    def put(self, key, value) -> None:
+        if len(self._entries) >= self.capacity:
+            # FIFO eviction (dicts preserve insertion order); a
+            # re-compile after eviction is just a warm retrace
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[key] = value
+        counter(
+            "dataflow_plan_cache_built_total",
+            help="propagate executables built into the shared cache, "
+                 "by kind (subset round fn / fused megakernel)",
+            kind=key[0],
+        ).inc()
+
+    def poison(self, key) -> None:
+        """Mark a fused key permanently failed (until the next graph
+        rebuild) so every later propagate goes straight per-edge
+        instead of re-raising the same compile error."""
+        if len(self._entries) >= self.capacity and key not in self._entries:
+            self._entries.pop(next(iter(self._entries)))
+        self._entries[key] = POISON
+
+
+def closure_edges(edges, edge_ran, dirty) -> tuple:
+    """Indices of every edge that could become eligible during this
+    propagate: the forward closure of the initially-dirty variables
+    through the DAG, plus never-ran edges (which owe their initial
+    evaluation regardless). Deterministic (index-sorted)."""
+    dirty = set(dirty)
+    # hoisted: this fixpoint walk runs on EVERY propagate (cache hits
+    # included), so per-pass set rebuilds would grow O(depth x edges)
+    src_sets = [set(e.srcs) for e in edges]
+    sel: set = set()
+    moved = True
+    while moved:
+        moved = False
+        for i, e in enumerate(edges):
+            if i in sel:
+                continue
+            if not edge_ran[i] or (dirty & src_sets[i]):
+                sel.add(i)
+                dirty.add(e.dst)
+                moved = True
+    return tuple(sorted(sel))
+
+
+def _stack_sig(edge):
+    """The normalized stacking signature of one edge, or None (never
+    stack): consults the edge's poison flag, its declared signature,
+    and the shared hashability rule; the concrete class is part of the
+    key so two edge kinds can never collide into one group."""
+    if not edge.stackable:
+        return None
+    raw = edge.signature()
+    if raw is None:
+        return None
+    return hashable_signature(type(edge), *raw)
+
+
+def level_groups(edges, idx) -> list:
+    """``[[edge_index, ...], ...]`` — the closure's edges organized as
+    same-signature groups within topological levels, ordered by
+    (level, first edge index). Levels come from longest-path relaxation
+    over the dst-dependency DAG restricted to ``idx`` (source variables
+    sit at depth 0); a cyclic graph stops relaxing at the iteration
+    bound, keeping levels finite and deterministic — correctness never
+    depends on the leveling, only grouping locality does."""
+    sel = [(i, edges[i]) for i in idx]
+    depth: dict = {}
+    for _ in range(len(sel) + 1):
+        moved = False
+        for _i, e in sel:
+            d = 1 + max((depth.get(s, 0) for s in e.srcs), default=0)
+            if depth.get(e.dst, 0) < d:
+                depth[e.dst] = d
+                moved = True
+        if not moved:
+            break
+    levels: dict = {}
+    for i, e in sel:
+        lv = min(max((depth.get(s, 0) for s in e.srcs), default=0), len(sel))
+        levels.setdefault(lv, []).append(i)
+    groups: list = []
+    for lv in sorted(levels):
+        by_sig: dict = {}
+        order: list = []
+        for i in levels[lv]:
+            sig = _stack_sig(edges[i])
+            key = ("__solo__", i) if sig is None else sig
+            if key not in by_sig:
+                by_sig[key] = []
+                order.append(key)
+            by_sig[key].append(i)
+        groups.extend(by_sig[k] for k in order)
+    return groups
+
+
+def _stacked_struct(tree, g: int):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct((g,) + tuple(x.shape), x.dtype), tree
+    )
+
+
+def guard_groups(edges, groups, states, tables) -> list:
+    """The per-group poison guard: every multi-edge group must trace
+    its stacked vmapped contribution (shape-level, via ``eval_shape`` —
+    no device work); a group that cannot is demoted to per-edge
+    singletons LOUDLY (counter + warning) and its members are poisoned
+    non-stackable so later compiles skip the attempt."""
+    out: list = []
+    for g in groups:
+        if len(g) == 1:
+            out.append(g)
+            continue
+        e0 = edges[g[0]]
+        tab_struct = _stacked_struct(tables[g[0]], len(g))
+        src_structs = [
+            _stacked_struct(states[e0.srcs[p]], len(g))
+            for p in range(len(e0.srcs))
+        ]
+        try:
+            jax.eval_shape(
+                jax.vmap(lambda t, *s: e0.contribution(t, *s)),
+                tab_struct, *src_structs,
+            )
+            out.append(g)
+        except Exception as exc:  # noqa: BLE001 — the loud-fallback contract
+            for i in g:
+                edges[i].stackable = False
+            counter(
+                "dataflow_plan_fallbacks_total",
+                help="fused-propagate fallbacks, by reason: `stack` = a "
+                     "same-signature group failed to trace stacked and "
+                     "was demoted to per-edge evaluation; `dispatch` = "
+                     "a fused megakernel failed to build or run and the "
+                     "propagate fell back to the per-edge path",
+                reason="stack",
+            ).inc()
+            warnings.warn(
+                f"dataflow fusion: group {tuple(g)} "
+                f"({type(e0).__name__}/{e0.kind}) cannot stack — demoted "
+                f"to per-edge evaluation: {exc!r}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            out.extend([i] for i in g)
+    return out
+
+
+def make_round_fn(edges, groups, meta, dst_order):
+    """One traced Jacobi sweep over the closure: per group, stacked
+    (vmapped over ``[G, ...]`` super-tensors) or per-edge contributions
+    — all reading the PRE-round states — then per-dst merges in
+    edge-index order through the inflation gate. Returns
+    ``round_fn(states, tables) -> (new_states, changed: bool[len(
+    dst_order)])``, the exact contract of the per-edge subset round."""
+
+    def round_fn(states, tables):
+        contribs: dict = {d: [] for d in dst_order}
+        for group in groups:
+            if len(group) == 1:
+                i = group[0]
+                e = edges[i]
+                c = e.contribution(tables[i], *[states[s] for s in e.srcs])
+                contribs[e.dst].append((i, c))
+                continue
+            e0 = edges[group[0]]
+            tabs = stack_group([tables[i] for i in group])
+            srcs = [
+                stack_group([states[edges[i].srcs[p]] for i in group])
+                for p in range(len(e0.srcs))
+            ]
+            out = jax.vmap(lambda t, *s: e0.contribution(t, *s))(tabs, *srcs)
+            for j, i in enumerate(group):
+                contribs[edges[i].dst].append(
+                    (i, jax.tree_util.tree_map(lambda x, _j=j: x[_j], out))
+                )
+        new_states = dict(states)
+        changed = []
+        for dst in dst_order:
+            codec, spec = meta[dst]
+            cur = states[dst]
+            new = merge_into_dst(
+                codec, spec, cur,
+                [c for _i, c in sorted(contribs[dst], key=lambda t: t[0])],
+            )
+            changed.append(~codec.equal(spec, cur, new))
+            new_states[dst] = new
+        return new_states, jnp.stack(changed)
+
+    return round_fn
+
+
+@dataclasses.dataclass
+class FusedPropagate:
+    """One compiled megakernel: the jitted while-loop executable plus
+    the host-side metadata its dispatches report against."""
+
+    fn: object  # jit((states, tables) -> (states, counts, sweeps, pending))
+    dst_order: tuple
+    groups: tuple
+    n_stacked: int  # edges served by multi-member stacked groups
+    sweep_bytes: int  # analytic traffic of ONE sweep (the ledger feed)
+
+
+def _tree_bytes(tree) -> int:
+    return sum(
+        int(leaf.size) * int(leaf.dtype.itemsize)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def sweep_traffic_bytes(edges, idx, states, tables) -> int:
+    """Analytic bytes one Jacobi sweep moves: every closure edge reads
+    its source states and tables; every distinct dst is read and
+    written once through the merge chain (the ideal-traffic convention
+    of the ``dataflow_fused`` roofline family)."""
+    total = 0
+    dsts: set = set()
+    for i in idx:
+        e = edges[i]
+        total += sum(_tree_bytes(states[s]) for s in e.srcs)
+        total += _tree_bytes(tables[i])
+        dsts.add(e.dst)
+    total += sum(2 * _tree_bytes(states[d]) for d in dsts)
+    return total
+
+
+def compile_fused(graph, idx, states, tables) -> FusedPropagate:
+    """Compile the megakernel for one dirty-subset signature: level +
+    group the closure, guard the groups, close the round function over
+    the graph's edge objects, and wrap it in the on-device fixed-point
+    loop (``ops.fused.fused_dataflow_rounds``) under one ``jax.jit``.
+    The round budget rides as a TRACED scalar operand, so one compiled
+    executable serves every ``max_rounds`` a caller passes (the budget
+    is not part of the cache key — varying budgets must not churn the
+    shared FIFO bound)."""
+    from ..ops.fused import fused_dataflow_rounds
+
+    edges = graph.edges
+    groups = guard_groups(
+        edges, level_groups(edges, idx), states, tables
+    )
+    dst_order: list = []
+    for i in idx:
+        if edges[i].dst not in dst_order:
+            dst_order.append(edges[i].dst)
+    meta = {d: graph._meta(d) for d in dst_order}
+    round_fn = make_round_fn(edges, groups, meta, tuple(dst_order))
+    n_dsts = len(dst_order)
+
+    fn = jax.jit(
+        lambda s, t, lim: fused_dataflow_rounds(round_fn, s, t, n_dsts, lim)
+    )
+    gauge(
+        "dataflow_plan_groups",
+        help="edge groups in the last compiled fused-propagate "
+             "megakernel (same-signature edges stack into one vmapped "
+             "contribution per group)",
+    ).set(len(groups))
+    return FusedPropagate(
+        fn=fn,
+        dst_order=tuple(dst_order),
+        groups=tuple(tuple(g) for g in groups),
+        n_stacked=sum(len(g) for g in groups if len(g) > 1),
+        sweep_bytes=sweep_traffic_bytes(edges, idx, states, tables),
+    )

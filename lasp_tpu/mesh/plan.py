@@ -89,16 +89,26 @@ class DispatchPlan:
         }
 
 
+def hashable_signature(*parts):
+    """The shared can-this-signature-group rule: the parts as one tuple,
+    or None when any part refuses to hash. Unhashable signatures degrade
+    to SINGLETON groups rather than failing a plan — the defensive
+    contract both plan compilers share (this gossip plan's
+    :func:`signature_of` and the dataflow graph compiler's edge
+    signatures, ``dataflow.plan.edge_signature``)."""
+    try:
+        hash(parts)
+    except TypeError:
+        return None
+    return parts
+
+
 def signature_of(runtime, var_id: str):
     """The grouping signature of one variable as the mesh sees it, or
     None when the spec is not hashable (defensive: such a variable
     degrades to a singleton group rather than failing the plan)."""
     codec, spec = runtime._mesh_meta(var_id)
-    try:
-        hash(spec)
-    except TypeError:
-        return None
-    return (codec, spec)
+    return hashable_signature(codec, spec)
 
 
 def compile_plan(runtime) -> DispatchPlan:

@@ -146,6 +146,45 @@ def fused_chaos_rounds_grouped(codec, spec, states, neighbors, masks):
     )
 
 
+def fused_dataflow_rounds(round_fn, states, tables, n_dsts: int,
+                          max_rounds):
+    """The dataflow propagate megakernel's fixed-point loop: run the
+    compiled leveled Jacobi sweep (``dataflow.plan.make_round_fn`` —
+    same-signature edge groups stacked and vmapped, merges per dst in
+    edge-index order) inside ONE ``lax.while_loop`` until the per-dst
+    change flags are all-false or ``max_rounds`` sweeps have run. The
+    whole k-sweep fixed point is one device dispatch — the host loop it
+    replaces paid a dispatch plus a changed-flags sync per sweep.
+
+    Returns ``(new_states, per_dst_rounds: int32[n_dsts], sweeps:
+    int32, pending: bool)`` — ``per_dst_rounds[i]`` counts the sweeps
+    that changed ``dst_order[i]`` (the causal event log's per-dst
+    summary for the fused window), ``sweeps`` the sweeps executed, and
+    ``pending`` whether the budget ran out while flags were still
+    flipping (the caller surfaces that as the same non-convergence
+    error the host loop raises). Gossip's monotone-join argument makes
+    productive sweeps a prefix: when ``pending`` is False the last
+    sweep is the (unproductive) convergence check, so the per-edge
+    path's round count is exactly ``sweeps - 1``. ``max_rounds`` may be
+    a TRACED scalar (the compiler passes the budget as an operand so
+    one executable serves every budget a caller names)."""
+
+    def cond(carry):
+        _s, _counts, i, go = carry
+        return go & (i < max_rounds)
+
+    def body(carry):
+        s, counts, i, _go = carry
+        new, changed = round_fn(s, tables)
+        return new, counts + changed.astype(jnp.int32), i + 1, jnp.any(changed)
+
+    return jax.lax.while_loop(
+        cond, body,
+        (states, jnp.zeros((n_dsts,), jnp.int32), jnp.int32(0),
+         jnp.bool_(True)),
+    )
+
+
 def fused_frontier_rounds(
     codec, spec, states, neighbors, frontier, n_rounds: int, edge_mask=None
 ):

@@ -213,7 +213,10 @@ def causal_history(var, lineage: "dict | None" = None) -> list:
     ``Graph.lineage`` map ``{var: {"srcs": [...], ...}}`` — so a derived
     output's history reaches back through its combinator edges to the
     source updates), and population-level context (membership changes,
-    deliveries), ordered by ``seq``."""
+    deliveries, and ``propagate`` summaries — a FUSED propagate's
+    per-round work is opaque to the ring, so the summary record with
+    its per-dst changed counts is the only trace of those windows),
+    ordered by ``seq``."""
     wanted = {var}
     if lineage:
         wanted |= set(lineage)
@@ -223,7 +226,10 @@ def causal_history(var, lineage: "dict | None" = None) -> list:
         r
         for r in events()
         if r.get("var") in wanted
-        or (r.get("var") is None and r["etype"] in ("membership", "delivery"))
+        or (
+            r.get("var") is None
+            and r["etype"] in ("membership", "delivery", "propagate")
+        )
     ]
     out.sort(key=lambda r: r["seq"])
     return out

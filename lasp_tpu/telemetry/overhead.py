@@ -127,9 +127,13 @@ def _measure(n_replicas: int, step_samples: int,
         max(emission_samples // 3, 200), step_s,
         frontier["round_seconds"], frontier["dispatches_per_round"],
     )
+    dataflow = _measure_dataflow(
+        step_samples, max(emission_samples // 3, 200)
+    )
     return {
         "frontier": frontier,
         "ledger": ledger,
+        "dataflow": dataflow,
         "event_emit_cost_s": round(event_cost, 9),
         "event_log": {
             k: _events.stats()[k] for k in ("ring_size", "deep")
@@ -219,6 +223,86 @@ def _measure_frontier(step_samples: int, emission_samples: int,
         "n_vars": n_vars,
         "n_replicas": n_replicas,
         "dispatches_per_round": dispatches,
+    }
+
+
+def _measure_dataflow(step_samples: int, emission_samples: int,
+                      depth: int = 6) -> dict:
+    """Fused-propagate arm of the guard (the ISSUE-8 hot path): one
+    ``Graph.propagate`` in fused mode is ONE device dispatch plus the
+    emission path — the ``dataflow.propagate`` span,
+    ``Graph._emit_propagate_telemetry`` (counters, per-kind accounting,
+    the summarizing ``propagate`` event with per-dst changed counts),
+    and the megakernel's single ``dataflow_fused`` ledger record. The
+    guard prices exactly that path against a fused propagate that
+    actually dispatches a multi-sweep fixed point (an OR-Set filter
+    chain: constant token space at any depth, so the denominator is the
+    steady state, with no interner growth or host table rebuilds inside
+    the clock — a token-minting re-add dirties the whole chain each
+    sample)."""
+    from ..dataflow import Graph
+    from ..store import Store
+
+    prev = _registry.enabled()
+    store = Store(n_actors=2)
+    g = Graph(store)
+    src = store.declare(
+        id="src", type="lasp_orset", n_elems=4, n_actors=2,
+        tokens_per_actor=4 * step_samples + 16,
+    )
+    cur = src
+    for i in range(depth):
+        cur = g.filter(cur, lambda t: True, dst=f"f{i}")
+    store.update(src, ("add", "x"), "w")
+    g.propagate()  # compile + warm the megakernel (the cold dispatch)
+
+    stats = {
+        "rounds": depth, "executed": depth + 1,
+        "runs": [depth + 1] * len(g.edges), "fused": True,
+        "changed_by_dst": {f"f{i}": depth - i for i in range(depth)},
+    }
+    ledger = _roofline.get_ledger()
+    rec = dict(n_replicas=1, fanout=depth, seconds=1e-6, row_bytes=2048,
+               window=depth + 1, rounds=depth + 1,
+               bytes_moved=2048 * (depth + 1), joins=depth * (depth + 1),
+               n_vars=depth)
+    # consume the signature's compile-bucket slot outside the clock
+    ledger.record("dataflow_fused", "OverheadProbe", **rec)
+
+    def emission_pass(flag: bool) -> float:
+        _registry.set_enabled(flag)
+        try:
+            t0 = time.perf_counter()
+            for _ in range(emission_samples):
+                with span("dataflow.propagate", annotate=True):
+                    pass
+                g._emit_propagate_telemetry(stats, 1e-6)
+                ledger.record("dataflow_fused", "OverheadProbe", **rec)
+            return (time.perf_counter() - t0) / emission_samples
+        finally:
+            _registry.set_enabled(prev)
+
+    cost = max(0.0, emission_pass(True) - emission_pass(False))
+
+    _registry.set_enabled(False)
+    try:
+        secs = []
+        for _ in range(step_samples):
+            # a fresh token on the source inflates it and re-dirties the
+            # whole chain: every timed propagate dispatches a real
+            # (depth+1)-sweep fixed point, never the clean-mark no-op
+            store.update(src, ("add", "x"), "w")
+            secs.append(_timed(g.propagate))
+        prop_s = min(secs)
+    finally:
+        _registry.set_enabled(prev)
+    return {
+        "emission_cost_per_propagate_s": round(cost, 9),
+        "propagate_seconds": round(prop_s, 6),
+        "overhead_frac": round(cost / prop_s if prop_s > 0 else 0.0, 4),
+        "edges": len(g.edges),
+        "sweeps_per_propagate": depth + 1,
+        "emission_samples": emission_samples,
     }
 
 

@@ -82,6 +82,7 @@ FAMILIES = (
     "converge",
     "chaos_window",
     "boundary_exchange",
+    "dataflow_fused",
 )
 
 
@@ -230,6 +231,23 @@ def kernel_traffic(
         lo = T * (2 * S)
         hi = T * ((2 + K) * S + N + mask) + pad
         return TrafficEstimate(moved, lo, hi, T * R * K * int(n_vars))
+
+    if family == "dataflow_fused":
+        # the whole-graph propagate megakernel (dataflow.plan +
+        # ops.fused.fused_dataflow_rounds): ``row_bytes`` is the
+        # analytic traffic of ONE Jacobi sweep over the dirty closure
+        # (every closure edge reads its source states + tables, every
+        # distinct dst reads + writes once through the merge chain —
+        # ``dataflow.plan.sweep_traffic_bytes``), ``window`` the sweeps
+        # the on-device while_loop executed, ``n_vars`` the closure's
+        # edge count. The xla bounds are nominal here: a while_loop's
+        # ``cost_analysis`` is trip-count-blind, so no calibrated
+        # cross-check exists for this family (unlike dense/rows/
+        # grouped); the hi bound covers per-dst merge intermediates.
+        moved = T * int(row_bytes)
+        lo = T * int(row_bytes)
+        hi = 3 * T * int(row_bytes) + pad
+        return TrafficEstimate(moved, lo, hi, T * int(n_vars))
 
     # boundary_exchange: the partitioned round's wire+local traffic —
     # local read+write of the population plus the cut rows crossing the

@@ -122,3 +122,78 @@ def test_dirty_cursor_is_per_graph():
     g2.propagate()  # must still see the write
     assert store.value("d1") == {1}
     assert store.value("d2") == {1}
+
+
+def _two_graphs():
+    """Two graphs sharing one store, each with a private chain off the
+    shared source plus a private source — the multi-graph cursor shape
+    under fused propagate (ISSUE 8 satellite)."""
+    store = Store(n_actors=4)
+    g1, g2 = Graph(store), Graph(store)
+    a = store.declare(id="a", type="lasp_gset", n_elems=8)
+    m1 = g1.map(a, lambda x: x * 10, dst="g1_m", dst_elems=8)
+    g1.map(m1, lambda x: x + 1, dst="g1_t", dst_elems=8)
+    p1 = store.declare(id="p1", type="lasp_gset", n_elems=8)
+    g1.map(p1, lambda x: -x, dst="g1_p", dst_elems=8)
+    m2 = g2.map(a, lambda x: x * 100, dst="g2_m", dst_elems=8)
+    g2.map(m2, lambda x: x + 2, dst="g2_t", dst_elems=8)
+    return store, g1, g2, a, p1
+
+
+def test_multigraph_cursors_interleaved_fused_and_per_edge():
+    """Interleaved fused/per-edge sweeps over a shared store: each
+    graph's cursor consumes exactly ITS unseen writes — never skipping
+    one (a write landing between the two graphs' propagates), never
+    double-consuming (a re-propagate after the other graph swept)."""
+    store, g1, g2, a, p1 = _two_graphs()
+    store.update(a, ("add", 1), "w")
+    assert g1.propagate(mode="fused") >= 1
+    # a write BETWEEN the graphs' sweeps: g2 still owes both
+    store.update(a, ("add", 2), "w")
+    assert g2.propagate(mode="per_edge") >= 1
+    assert store.value("g2_t") == {102, 202}
+    # g1 saw only the first write so far; the fused sweep must fold the
+    # second in (its cursor held at the pre-write mark)
+    assert store.value("g1_t") == {11}
+    assert g1.propagate(mode="fused") >= 1
+    assert store.value("g1_t") == {11, 21}
+    # no double-consume: both graphs are clean now (0 rounds, no work)
+    assert g1.propagate(mode="fused") == 0
+    assert g2.propagate(mode="per_edge") == 0
+    # a write into g1's PRIVATE chain: g2's propagate stays clean and
+    # must not advance g1's view past the unseen write
+    store.update(p1, ("add", 5), "w")
+    assert g2.propagate(mode="fused") == 0
+    assert g1.propagate(mode="fused") >= 1
+    assert store.value("g1_p") == {-5}
+
+
+def test_multigraph_fused_matches_per_edge_after_interleaving():
+    """The same interleaved schedule driven all-fused vs all-per-edge
+    lands identical values on every variable of both graphs."""
+    import jax
+    import numpy as np
+
+    def run(mode):
+        store, g1, g2, a, p1 = _two_graphs()
+        store.update(a, ("add", 1), "w")
+        r = [g1.propagate(mode=mode)]
+        store.update(a, ("add", 3), "w")
+        store.update(p1, ("add", 7), "w")
+        r.append(g2.propagate(mode=mode))
+        r.append(g1.propagate(mode=mode))
+        store.update(a, ("add", 4), "w")
+        r.append(g2.propagate(mode=mode))
+        r.append(g1.propagate(mode=mode))
+        return store, r
+
+    s_f, r_f = run("fused")
+    s_p, r_p = run("per_edge")
+    assert r_f == r_p
+    for v in s_f.ids():
+        fa = jax.tree_util.tree_leaves(s_f.state(v))
+        pa = jax.tree_util.tree_leaves(s_p.state(v))
+        assert all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(fa, pa)
+        ), v

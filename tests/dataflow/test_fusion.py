@@ -1,0 +1,390 @@
+"""Whole-graph dataflow fusion (``dataflow.plan``): the propagate
+megakernel must be bit-identical to the per-edge path — same values,
+same round counts — across codecs, graph shapes, and interleavings, and
+every non-stackable corner must fall back LOUDLY (counter + warning),
+never silently wrong. The shared FIFO propagate-executable cache and
+the fused window's causal-log summary are pinned here too."""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.dataflow import plan as dplan
+from lasp_tpu.store import Store
+from lasp_tpu.telemetry import get_registry
+
+
+def _counter_value(name, **labels):
+    fam = get_registry().snapshot().get(name)
+    if not fam:
+        return 0
+    return sum(
+        s["value"] for s in fam["series"]
+        if all(s["labels"].get(k) == v for k, v in labels.items())
+    )
+
+
+def _states_equal(store_a, store_b) -> bool:
+    for v in store_a.ids():
+        a = jax.tree_util.tree_leaves(store_a.state(v))
+        b = jax.tree_util.tree_leaves(store_b.state(v))
+        if not all(
+            np.array_equal(np.asarray(x), np.asarray(y))
+            for x, y in zip(a, b)
+        ):
+            return False
+    return True
+
+
+def _mixed_graph():
+    """Every edge kind x every dataflow codec family: parallel orswot
+    bind_to chains (vclock codec), stacked G-Set map chains feeding a
+    union, an OR-Set filter feeding a product — the shape the fused
+    compiler levels, groups, and stacks."""
+    store = Store(n_actors=2)
+    g = Graph(store)
+    for c in range(2):
+        store.declare(
+            id=f"o{c}_0", type="riak_dt_orswot", n_elems=4, n_actors=2
+        )
+        for d in range(3):
+            g.bind_to(f"o{c}_{d + 1}", f"o{c}_{d}")
+    a = store.declare(id="a", type="lasp_gset", n_elems=8)
+    b = store.declare(id="b", type="lasp_gset", n_elems=8)
+    m1 = g.map(a, lambda x: x * 10, dst="m1", dst_elems=8)
+    m2 = g.map(b, lambda x: x * 10, dst="m2", dst_elems=8)
+    g.union(m1, m2, dst="u")
+    s = store.declare(
+        id="s", type="lasp_orset", n_elems=4, n_actors=2, tokens_per_actor=8
+    )
+    f = g.filter(s, lambda t: True, dst="f")
+    g.product(f, s, dst="p")
+    return store, g
+
+
+def _drive(store, g, mode) -> list:
+    """A write/propagate interleaving touching every chain, with a
+    removal mid-stream (vclock dots moving under an equal-clock-blind
+    residual is exactly what ``~codec.equal`` change flags must see)."""
+    rounds = []
+    for c in range(2):
+        store.update(f"o{c}_0", ("add", f"e{c}"), "w")
+    store.update("a", ("add", 1), "w")
+    store.update("s", ("add", "z"), "w")
+    rounds.append(g.propagate(mode=mode))
+    store.update("o0_0", ("remove", "e0"), "w")
+    store.update("b", ("add", 2), "w")
+    rounds.append(g.propagate(mode=mode))
+    store.update("a", ("add", 3), "w")
+    rounds.append(g.propagate(mode=mode))
+    return rounds
+
+
+def test_fused_bit_identical_to_per_edge_mixed_codecs():
+    s1, g1 = _mixed_graph()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any fallback is a test failure
+        fused_rounds = _drive(s1, g1, "fused")
+    s2, g2 = _mixed_graph()
+    per_edge_rounds = _drive(s2, g2, "per_edge")
+    assert fused_rounds == per_edge_rounds
+    assert _states_equal(s1, s2)
+    assert s1.value("u") == {10, 20, 30}
+    assert s1.value("o0_3") == set()  # the removal reached the chain tail
+    assert s1.value("o1_3") == {"e1"}
+
+
+def test_auto_mode_is_fused_and_default():
+    store, g = _mixed_graph()
+    assert g.fusion == "auto"
+    store.update("a", ("add", 1), "w")
+    g.propagate()  # default mode
+    from lasp_tpu.telemetry import events as tel_events
+
+    rec = [e for e in tel_events.events() if e["etype"] == "propagate"][-1]
+    assert rec["attrs"]["fused"] is True
+
+
+def test_unknown_mode_rejected():
+    store, g = _mixed_graph()
+    store.update("a", ("add", 1), "w")
+    with pytest.raises(ValueError, match="unknown propagate mode"):
+        g.propagate(mode="bogus")
+
+
+# -- compiler internals ------------------------------------------------------
+
+def test_closure_edges_forward_closure_and_never_ran():
+    store = Store(n_actors=2)
+    g = Graph(store)
+    a = store.declare(id="a", type="lasp_gset", n_elems=4)
+    b = g.map(a, lambda x: x, dst="b", dst_elems=4)
+    g.map(b, lambda x: x, dst="c", dst_elems=4)
+    x = store.declare(id="x", type="lasp_gset", n_elems=4)
+    g.map(x, lambda x: x, dst="y", dst_elems=4)
+    # never-ran edges are always in the closure
+    assert dplan.closure_edges(g.edges, [False] * 3, set()) == (0, 1, 2)
+    # a dirty source pulls its whole downstream chain, not the x->y edge
+    assert dplan.closure_edges(g.edges, [True] * 3, {"a"}) == (0, 1)
+    assert dplan.closure_edges(g.edges, [True] * 3, {"b"}) == (1,)
+    assert dplan.closure_edges(g.edges, [True] * 3, {"x"}) == (2,)
+    assert dplan.closure_edges(g.edges, [True] * 3, set()) == ()
+
+
+def test_level_groups_stack_same_signature_per_level():
+    store = Store(n_actors=2)
+    g = Graph(store)
+    for i in range(3):
+        v = store.declare(id=f"v{i}", type="lasp_gset", n_elems=4)
+        m = g.map(v, lambda x: x, dst=f"m{i}", dst_elems=4)
+        g.map(m, lambda x: x, dst=f"t{i}", dst_elems=4)
+    idx = tuple(range(6))
+    groups = dplan.level_groups(g.edges, idx)
+    # 2 levels x 3 same-signature map edges each -> 2 stacked groups
+    assert sorted(sorted(grp) for grp in groups) == [[0, 2, 4], [1, 3, 5]]
+
+
+def test_pre_poisoned_edge_stays_singleton():
+    store = Store(n_actors=2)
+    g = Graph(store)
+    for i in range(2):
+        v = store.declare(id=f"v{i}", type="lasp_gset", n_elems=4)
+        g.map(v, lambda x: x, dst=f"m{i}", dst_elems=4)
+    g.edges[0].stackable = False  # the operator pre-poison hook
+    groups = dplan.level_groups(g.edges, (0, 1))
+    assert sorted(sorted(grp) for grp in groups) == [[0], [1]]
+    # and the fused propagate still lands the right values
+    store.update("v0", ("add", 1), "w")
+    store.update("v1", ("add", 2), "w")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        g.propagate(mode="fused")
+    assert store.value("m0") == {1} and store.value("m1") == {2}
+
+
+def test_guard_demotes_unstackable_group_loudly():
+    """A group whose stacked trace fails is demoted to per-edge
+    singletons with a RuntimeWarning + fallback counter, and its
+    members are poisoned non-stackable for later compiles."""
+    store = Store(n_actors=2)
+    g = Graph(store)
+    for i in range(2):
+        v = store.declare(id=f"v{i}", type="lasp_gset", n_elems=4)
+        g.map(v, lambda x: x, dst=f"m{i}", dst_elems=4)
+    g.refresh()
+    states = {v: store.state(v) for v in store.ids()}
+    tables = tuple(e.device_tables() for e in g.edges)
+    groups = dplan.level_groups(g.edges, (0, 1))
+    assert any(len(grp) == 2 for grp in groups)
+
+    def broken(tables, src):
+        raise ValueError("cannot batch this")
+
+    g.edges[0].contribution = broken
+    before = _counter_value("dataflow_plan_fallbacks_total", reason="stack")
+    with pytest.warns(RuntimeWarning, match="cannot stack"):
+        out = dplan.guard_groups(g.edges, groups, states, tables)
+    assert sorted(sorted(grp) for grp in out) == [[0], [1]]
+    assert not g.edges[0].stackable and not g.edges[1].stackable
+    assert (
+        _counter_value("dataflow_plan_fallbacks_total", reason="stack")
+        == before + 1
+    )
+
+
+def test_dispatch_failure_falls_back_loudly_then_poisons(monkeypatch):
+    store, g = _mixed_graph()
+    store.update("a", ("add", 1), "w")
+    g.propagate(mode="per_edge")  # every edge has run once
+
+    def boom(*_a, **_k):
+        raise RuntimeError("trace exploded")
+
+    monkeypatch.setattr(dplan, "compile_fused", boom)
+    before = _counter_value(
+        "dataflow_plan_fallbacks_total", reason="dispatch"
+    )
+    store.update("a", ("add", 2), "w")
+    with pytest.warns(RuntimeWarning, match="fell back to the per-edge"):
+        g.propagate(mode="auto")
+    assert store.value("m1") == {10, 20}  # the fallback still converged
+    assert (
+        _counter_value("dataflow_plan_fallbacks_total", reason="dispatch")
+        == before + 1
+    )
+    # the same dirty pattern is poisoned now: straight per-edge, no
+    # second warning even with compile_fused still broken
+    store.update("a", ("add", 3), "w")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        g.propagate(mode="auto")
+    assert store.value("m1") == {10, 20, 30}
+
+
+def test_strict_fused_mode_raises_instead_of_falling_back(monkeypatch):
+    store, g = _mixed_graph()
+    store.update("a", ("add", 1), "w")
+
+    def boom(*_a, **_k):
+        raise RuntimeError("trace exploded")
+
+    monkeypatch.setattr(dplan, "compile_fused", boom)
+    with pytest.raises(RuntimeError, match="trace exploded"):
+        g.propagate(mode="fused")
+    # the pattern is poisoned: strict mode refuses the fallback outright
+    with pytest.raises(RuntimeError, match="refuses the fallback"):
+        g.propagate(mode="fused")
+
+
+# -- the shared executable cache ---------------------------------------------
+
+def test_propagate_cache_fifo_bound_and_kinds():
+    cache = dplan.PropagateCache(capacity=2)
+    cache.put(("subset", (0,)), "s0")
+    cache.put(("fused", (0, 1), 3), "f0")
+    assert len(cache) == 2
+    cache.put(("subset", (1,)), "s1")  # evicts the oldest (FIFO)
+    assert len(cache) == 2
+    assert cache.get(("subset", (0,))) is None
+    assert cache.get(("fused", (0, 1), 3)) == "f0"
+    assert cache.get(("subset", (1,))) == "s1"
+
+
+def test_fused_and_subset_executables_share_one_cache():
+    """The PR 3 eligible-subset round fns and the megakernels live in
+    ONE keyed FIFO cache — one bound, one hit/built ledger."""
+    store, g = _mixed_graph()
+    store.update("a", ("add", 1), "w")
+    g.propagate(mode="fused")
+    store.update("a", ("add", 2), "w")
+    g.propagate(mode="per_edge")
+    kinds = {k[0] for k in g._cache._entries}
+    assert kinds == {"fused", "subset"}
+    store.update("a", ("add", 3), "w")
+    g.propagate(mode="fused")  # builds the {a}-dirty megakernel
+    hits0 = _counter_value("dataflow_plan_cache_hits_total", kind="fused")
+    store.update("a", ("add", 4), "w")
+    g.propagate(mode="fused")  # same dirty pattern: a warm cache hit
+    assert (
+        _counter_value("dataflow_plan_cache_hits_total", kind="fused")
+        > hits0
+    )
+    built = _counter_value("dataflow_plan_cache_built_total", kind="fused")
+    assert built >= 1
+
+
+def test_graph_mutation_invalidates_cache():
+    store = Store(n_actors=2)
+    g = Graph(store)
+    a = store.declare(id="a", type="lasp_gset", n_elems=4)
+    g.map(a, lambda x: x, dst="b", dst_elems=4)
+    store.update(a, ("add", 1), "w")
+    g.propagate(mode="fused")
+    assert len(g._cache) >= 1
+    # adding an edge re-means edge indices: _build resets the cache
+    g.map("b", lambda x: x, dst="c", dst_elems=4)
+    store.update(a, ("add", 2), "w")
+    g.propagate(mode="fused")
+    assert store.value("c") == {1, 2}
+
+
+# -- telemetry: the fused window's causal-log summary ------------------------
+
+def test_propagate_event_carries_per_dst_changed_counts():
+    store, g = _mixed_graph()
+    store.update("a", ("add", 1), "w")
+    g.propagate(mode="fused")
+    from lasp_tpu.telemetry import events as tel_events
+
+    rec = [e for e in tel_events.events() if e["etype"] == "propagate"][-1]
+    attrs = rec["attrs"]
+    assert attrs["fused"] is True and attrs["rounds"] >= 1
+    by_dst = attrs["changed_by_dst"]
+    # the a->m1->u chain moved; counts are per-dst changed sweeps
+    assert by_dst["m1"] >= 1 and by_dst["u"] >= 1
+    assert set(by_dst) == {e.dst for e in g.edges}
+
+
+def test_causal_history_includes_fused_propagate_summary():
+    store, g = _mixed_graph()
+    store.update("a", ("add", 1), "w")
+    g.propagate(mode="fused")
+    from lasp_tpu.telemetry.events import causal_history
+
+    hist = causal_history("u", lineage=g.lineage("u"))
+    assert any(r["etype"] == "propagate" for r in hist), (
+        "fused windows must not vanish from `lasp_tpu trace --var` lineage"
+    )
+
+
+def test_fused_ledger_family_records():
+    from lasp_tpu.telemetry import get_ledger
+
+    store, g = _mixed_graph()
+    store.update("a", ("add", 1), "w")
+    before = {
+        e["kernel"]: e["dispatches"] + e["compile_dispatches"]
+        for e in get_ledger().snapshot()
+    }
+    g.propagate(mode="fused")
+    ent = [
+        e for e in get_ledger().snapshot()
+        if e["family"] == "dataflow_fused"
+        and e["dispatches"] + e["compile_dispatches"]
+        > before.get(e["kernel"], 0)
+    ]
+    assert ent, "fused propagate did not feed the kernel ledger"
+    assert ent[0]["bytes"] > 0 and ent[0]["rounds"] >= 1
+
+
+# -- parity corners ----------------------------------------------------------
+
+def test_non_convergence_raises_in_both_modes():
+    for mode in ("fused", "per_edge"):
+        store = Store(n_actors=2)
+        g = Graph(store)
+        a = store.declare(id="a", type="lasp_gset", n_elems=4)
+        b = g.map(a, lambda x: x, dst="b", dst_elems=4)
+        g.map(b, lambda x: x, dst="c", dst_elems=4)
+        store.update(a, ("add", 1), "w")
+        with pytest.raises(RuntimeError, match="did not converge"):
+            g.propagate(max_rounds=1, mode=mode)
+        # the budget raise leaves the graph retryable
+        assert g.propagate(mode=mode) >= 1
+        assert store.value("c") == {1}
+        if mode == "fused":
+            # the round budget is a traced operand, NOT part of the
+            # cache key: the budgeted and default propagates share one
+            # megakernel instead of churning the FIFO bound
+            fused_keys = [k for k in g._cache._entries if k[0] == "fused"]
+            assert len(fused_keys) == 1, fused_keys
+
+
+def test_empty_frontier_is_zero_rounds_both_modes():
+    for mode in ("fused", "per_edge"):
+        store = Store(n_actors=2)
+        g = Graph(store)
+        a = store.declare(id="a", type="lasp_gset", n_elems=4)
+        g.map(a, lambda x: x, dst="b", dst_elems=4)
+        store.update(a, ("add", 1), "w")
+        g.propagate(mode=mode)
+        assert g.propagate(mode=mode) == 0
+
+
+def test_fused_interner_growth_retraces_cleanly():
+    """Interner growth between propagates changes table CONTENTS (shapes
+    are spec-pinned): the cached megakernel must absorb the new tables
+    as traced operands, not bake stale projections in."""
+    store = Store(n_actors=2)
+    g = Graph(store)
+    a = store.declare(id="a", type="lasp_gset", n_elems=8)
+    g.map(a, lambda x: x * 10, dst="b", dst_elems=8)
+    store.update(a, ("add", 1), "w")
+    g.propagate(mode="fused")
+    assert store.value("b") == {10}
+    store.update(a, ("add", 2), "w")  # new term -> table refresh
+    g.propagate(mode="fused")
+    assert store.value("b") == {10, 20}

@@ -27,6 +27,7 @@ def test_scenario_registry_complete():
         "partitioned_gossip",
         "frontier_sparse",
         "many_vars",
+        "dataflow_chain",
         "chaos_heal",
     }
 
@@ -157,6 +158,31 @@ def test_frontier_sparse_small_pallas_arm():
         "dense", "frontier", "pallas_rows"
     }
     _assert_pallas_arm(out)
+
+
+def test_dataflow_chain_small():
+    """CI-scale dataflow-fusion A/B: the fusion contract is asserted
+    INSIDE the scenario (bit-identical states + round counts across
+    schedulers); here we pin the artifact shape the driver embeds —
+    both arms timed, per-arm roofline non-null on every backend."""
+    from lasp_tpu.bench_scenarios import dataflow_chain
+
+    out = dataflow_chain(n_chains=6, depth=2, reps=1)
+    assert out["check"] == (
+        "bit-identical states + round counts across schedulers"
+    )
+    assert out["n_edges"] >= 12 and out["rounds"] >= 2
+    assert set(out["impl_block_seconds"]) == {"per_edge", "fused"}
+    assert out["impl_block_seconds"]["per_edge"] > 0
+    assert out["impl_block_seconds"]["fused"] > 0
+    assert out["fused_speedup"] > 0
+    # the megakernel actually stacked same-signature edges
+    assert out["plan"]["groups"] < out["n_edges"]
+    assert out["plan"]["edges_stacked"] >= 2
+    for arm in ("per_edge", "fused"):
+        roof = out["impl_roofline"][arm]
+        assert roof["achieved_GBps"] is not None
+        assert roof["roofline_frac"] is not None
 
 
 def test_chaos_heal_small():

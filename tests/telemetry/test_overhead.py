@@ -27,3 +27,10 @@ def test_telemetry_overhead_under_5_percent():
     assert out["ledger"]["cost_per_record_s"] >= 0
     assert out["ledger"]["dense_overhead_frac"] < 0.05, out["ledger"]
     assert out["ledger"]["frontier_overhead_frac"] < 0.05, out["ledger"]
+    # fused-propagate arm (the ISSUE-8 hot path): one megakernel
+    # dispatch per propagate, priced against its whole emission path —
+    # span + counters + the summarizing `propagate` event with per-dst
+    # changed counts + the `dataflow_fused` ledger record
+    assert out["dataflow"]["propagate_seconds"] > 0
+    assert out["dataflow"]["emission_cost_per_propagate_s"] >= 0
+    assert out["dataflow"]["overhead_frac"] < 0.05, out["dataflow"]
