@@ -34,7 +34,10 @@ test-slow:
 # winner-ships race contract (docs/PERF.md "Pallas kernels"), a
 # dataflow-fusion smoke guards the propagate megakernel's fused-vs-
 # per-edge bit-identity over a mixed-codec graph with a non-stackable
-# edge plus its live roofline row (docs/PERF.md "Dataflow fusion"),
+# edge plus its live roofline row (docs/PERF.md "Dataflow fusion"), a
+# quorum smoke guards the batched-FSM-vs-sequential-reference
+# bit-identity and the no-acked-write-lost hinted-handoff invariant
+# (docs/RESILIENCE.md "Quorum coordination"),
 # then the non-slow tests run (the tier-1 shape)
 verify:
 	python tools/check_metrics_catalog.py
@@ -44,6 +47,7 @@ verify:
 	python tools/roofline_smoke.py
 	python tools/pallas_smoke.py
 	python tools/dataflow_fusion_smoke.py
+	python tools/quorum_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

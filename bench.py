@@ -499,6 +499,18 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["chaos_heal"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- quorum KV serving arm (~seconds): Dynamo-style get/put FSMs under
+    # every nemesis preset; per-preset quorum p50/p99 latency-in-rounds,
+    # staleness-vs-converged distance, and repair/replication traffic,
+    # with the no-acked-write-lost (hinted handoff) invariant asserted
+    # inside the scenario --------------------------------------------------
+    try:
+        from lasp_tpu.bench_scenarios import quorum_kv
+
+        detail["quorum_kv"] = quorum_kv()
+    except Exception as exc:
+        detail["quorum_kv"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- north-star: 10M-replica engine-path ad counter ---------------------
     ns0 = cfg.bench_northstar_replicas or (
         10 * (1 << 20) if on_tpu else (1 << 13)

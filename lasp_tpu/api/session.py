@@ -270,6 +270,32 @@ class Session:
         )
         return ChaosRuntime(runtime, schedule, checkpoint=checkpoint)
 
+    def quorum(self, runtime, *, n: int = 3, r: int = 2, w: int = 2,
+               hints: "str | None" = None, **kwargs):
+        """Wrap a replicated runtime (from :meth:`replicate`) — or a
+        :class:`~lasp_tpu.chaos.ChaosRuntime` from :meth:`nemesis` — in
+        a :class:`~lasp_tpu.quorum.QuorumRuntime`: the batched
+        request-coordination layer (Dynamo-style N/R/W get/put FSMs,
+        read-repair, hinted handoff — docs/RESILIENCE.md "Quorum
+        coordination"):
+
+        >>> rt = session.replicate(64)
+        >>> chaos = session.nemesis(rt, "rolling-crash")
+        >>> kv = session.quorum(chaos)
+        >>> rid = kv.submit_put("kv", ("add", "x"), "client0")
+        >>> kv.step(); kv.result(rid)
+
+        ``n``/``r``/``w`` default to the reference's N=3, R=W=2;
+        ``hints`` names a durable hint-log path (default in-memory);
+        extra kwargs reach :class:`QuorumRuntime` (``timeout``,
+        ``retries``, ``engine``, ``mode``). The coordination report
+        lands in :meth:`health` under ``quorum``."""
+        from ..quorum import QuorumRuntime
+
+        _count_verb("quorum")
+        return QuorumRuntime(runtime, n=n, r=r, w=w, hints=hints,
+                             **kwargs)
+
     # -- programs (L5, src/lasp_program.erl) ---------------------------------
     def register(self, name: str, program_cls, *args, **kwargs) -> str:
         """``lasp:register/4`` (``src/lasp.erl:84-86``): instantiate a

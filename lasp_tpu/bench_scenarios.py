@@ -1953,6 +1953,118 @@ def chaos_heal(
     }
 
 
+def quorum_kv(
+    n_replicas: int = 64,
+    fanout: int = 3,
+    seed: int = 23,
+    client_rounds: int = 8,
+    puts_per_round: int = 4,
+    gets_per_round: int = 4,
+) -> dict:
+    """Dynamo-style KV serving under EVERY chaos nemesis preset: a
+    quorum coordination batch (N=3, R=W=2 — the reference's defaults)
+    drives an open put/get mix against a population while each preset
+    tears the mesh apart, and the artifact records what serving costs:
+    per-preset quorum p50/p99 latency-in-rounds (get and put),
+    STALENESS-vs-converged distance (how many already-acked writes a
+    completed quorum read missed — 0 on a healthy mesh, the price of
+    R-of-live under partitions), repair/replication wire traffic, and
+    retries/failures. The no-acknowledged-write-lost invariant
+    (hinted handoff) is ASSERTED per preset, and every put/get resolves
+    before the preset's report closes."""
+    from lasp_tpu.chaos import PRESETS, ChaosRuntime, nemesis
+    from lasp_tpu.chaos.invariants import check_no_write_lost
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.store import Store
+    from lasp_tpu.quorum import QuorumRuntime
+
+    nbrs = random_regular(n_replicas, fanout, seed=seed)
+    presets: dict = {}
+    for preset in PRESETS:
+        store = Store(n_actors=64)
+        kv = store.declare(id="kv", type="lasp_gset", n_elems=256)
+        rt = ReplicatedRuntime(store, Graph(store), n_replicas, nbrs)
+        sched = nemesis(preset, n_replicas, nbrs, seed=seed, rounds=10)
+        ch = ChaosRuntime(rt, sched)
+        qr = QuorumRuntime(ch, timeout=4, retries=4)
+        #: get rid -> terms acked BEFORE it was submitted (the
+        #: converged target a fresh read "should" see)
+        target_at_submit: dict = {}
+        rng = np.random.RandomState(seed)
+        put_i = 0
+
+        def tick(n_puts, n_gets):
+            nonlocal put_i
+            live = np.flatnonzero(~ch.crashed)
+            for _ in range(n_puts):
+                coord = int(live[rng.randint(live.size)])
+                qr.submit_put(kv, ("add", f"k{put_i}"), f"c{put_i}",
+                              coordinator=coord)
+                put_i += 1
+            acked_now = frozenset(qr.acked_terms.get(kv, ()))
+            for _ in range(n_gets):
+                coord = int(live[rng.randint(live.size)])
+                rid = qr.submit_get(kv, coordinator=coord, degraded=True)
+                target_at_submit[rid] = acked_now
+            qr.step()
+
+        def run():
+            for i in range(client_rounds):
+                tick(puts_per_round, gets_per_round)
+            while qr.inflight or ch.round <= sched.horizon:
+                if ch.round >= 512:  # the harness/drain discipline: a
+                    raise RuntimeError(  # leaked FSM errors, never hangs
+                        f"quorum_kv[{preset}]: {qr.inflight} request(s) "
+                        "unresolved after 512 rounds"
+                    )
+                tick(0, 0)
+            rt.run_to_convergence(max_rounds=512)
+
+        _, secs = _timed(run)
+        check_no_write_lost(rt, qr.acked_terms)  # hinted-handoff gate
+        staleness = []
+        for rid, target in target_at_submit.items():
+            res = qr.result(rid, raise_on_error=False)
+            if res["status"] == "done" and res["value"] is not None:
+                staleness.append(len(target - res["value"]))
+        rep = qr.report()
+        presets[preset] = {
+            "rounds": ch.round,
+            "seconds": round(secs, 4),
+            "requests": rep["requests"],
+            "completed": rep["completed"],
+            "failed": rep["failed"],
+            "retries": rep["retries"],
+            "get_p50_rounds": rep["get_p50_rounds"],
+            "get_p99_rounds": rep["get_p99_rounds"],
+            "put_p50_rounds": rep["put_p50_rounds"],
+            "put_p99_rounds": rep["put_p99_rounds"],
+            "staleness_mean": (
+                round(float(np.mean(staleness)), 3) if staleness else None
+            ),
+            "staleness_max": int(np.max(staleness)) if staleness else None,
+            "repair_wire_bytes": rep["wire_bytes"],
+            "pushed_rows": rep["pushed_rows"],
+            "repaired_rows": rep["repaired_rows"],
+            "hint_replays": rep["hint_replays"],
+            "no_write_lost": True,
+            "acked_writes": sum(
+                len(ts) for ts in qr.acked_terms.values()
+            ),
+        }
+    return {
+        "scenario": f"quorum_kv_{n_replicas}",
+        "n_replicas": n_replicas,
+        "fanout": fanout,
+        "n_r_w": [3, 2, 2],
+        "presets": presets,
+        "engine": "QuorumRuntime(batched)+ChaosRuntime",
+        "check": "no acked write lost (hinted handoff) under every "
+                 "preset; all requests resolved",
+    }
+
+
 SCENARIOS = {
     "adcounter_6": adcounter_6,
     "gset_1k": gset_1k,
@@ -1966,4 +2078,5 @@ SCENARIOS = {
     "many_vars": many_vars,
     "dataflow_chain": dataflow_chain,
     "chaos_heal": chaos_heal,
+    "quorum_kv": quorum_kv,
 }

@@ -31,6 +31,7 @@ request                                             response
 ``{keys}``                                          ``{ok, [Id...]}``
 ``{metrics}``                                       ``{ok, PromTextBin}`` (telemetry scrape: Prometheus text exposition of the process registry; allowed before ``start``)
 ``{health}``                                        ``{ok, JsonBin}`` (ConvergenceMonitor state + alerts as a JSON object — residual/staleness per var, divergence top-K, quiescence ETA, replica/shard lag probe; allowed before ``start``, see docs/OBSERVABILITY.md)
+``{idem, ReqIdBin, Request}``                       the inner request's response, AT-MOST-ONCE: a repeated ReqId within the dedup window returns the FIRST response without re-executing (how non-idempotent writes retry safely across reconnects — the client attaches a fresh random id per logical op and replays the same frame; durable stores persist the window, so the guarantee survives a server restart)
 ==================================================  =========================
 
 Portable CRDT state encodings (id/elem/actor terms are arbitrary ETF
@@ -82,8 +83,13 @@ _HDR = struct.Struct(">I")
 #: mint unbounded label cardinality in the registry
 _METRIC_VERBS = frozenset({
     "start", "declare", "put", "get", "update", "bind", "merge_batch",
-    "read", "keys", "metrics", "health",
+    "read", "keys", "metrics", "health", "idem",
 })
+
+#: bound on the per-store idem dedup window (FIFO): reply-loss retries
+#: arrive within seconds, so a shallow window suffices — and an
+#: unbounded one would grow with every write forever
+_IDEM_WINDOW = 256
 
 #: declare caps accepted over the wire, per type (mirrors store.ALLOWED_CAPS)
 _CAP_KEYS = ("n_elems", "n_actors", "tokens_per_actor")
@@ -656,10 +662,17 @@ class _Conn:
     gives each partition exactly one owning vnode process)."""
 
     def __init__(self, n_actors: int, data_dir: Optional[str] = None,
-                 locks: Optional[dict] = None):
+                 locks: Optional[dict] = None,
+                 idem: Optional[dict] = None):
         self.n_actors = n_actors
         self.data_dir = data_dir
         self._locks = locks  # BridgeServer-owned {name: lock-holder}
+        #: BridgeServer-owned {scope: OrderedDict[reqid -> etf bytes]}
+        #: — the idem dedup windows (durable stores scope by NAME so a
+        #: reconnect hits the same window; in-memory stores scope
+        #: per-connection, because a reconnect gets a FRESH store and a
+        #: cached response would claim a write the new store never saw)
+        self._idem = idem
         self.store: Optional[Store] = None
         self._hs = None
         self._manifest: Optional[dict] = None
@@ -723,6 +736,27 @@ class _Conn:
         from ..store.checkpoint import loads_manifest
 
         self._manifest = loads_manifest(self._hs.get("manifest"))
+        if self._idem is not None and name not in self._idem:
+            # restore the persisted dedup window: an op acked before a
+            # server restart must stay deduplicated after it. One
+            # `idem:<reqid-hex>` record per cached response (pickled
+            # (seq, etf-bytes) — plain data, no bridge classes), folded
+            # back into insertion order by seq here; writes append one
+            # small record each instead of re-pickling the window
+            import collections
+            import pickle
+
+            recs = []
+            for k in self._hs.keys():
+                if isinstance(k, str) and k.startswith("idem:"):
+                    raw = self._hs.get(k)
+                    if raw is not None:
+                        seq, resp = pickle.loads(raw)
+                        recs.append((int(seq), bytes.fromhex(k[5:]), resp))
+            recs.sort()
+            self._idem[name] = collections.OrderedDict(
+                (rid, (seq, resp)) for seq, rid, resp in recs
+            )
         return (etf.OK, Atom(name))
 
     def _persist(self, var_ids) -> None:
@@ -772,12 +806,92 @@ class _Conn:
             self._hs.compact()
 
     def close(self) -> None:
+        if self._idem is not None:
+            # connection-scoped windows die with the connection (their
+            # store does too); name-scoped windows outlive it on purpose
+            self._idem.pop(("conn", id(self)), None)
         self._release()
+
+    def _idem_scope(self):
+        """Dedup window key: the durable store NAME (a reconnect must
+        hit the same window), else this connection (an in-memory
+        reconnect gets a fresh store, so cross-connection dedup would
+        claim writes the new store never saw)."""
+        if self._hs is not None and self._name is not None:
+            return self._name
+        return ("conn", id(self))
+
+    def _handle_idem(self, req: tuple) -> Any:
+        """``{idem, ReqIdBin, Request}``: at-most-once execution of the
+        inner request. A repeated id inside the window returns the
+        CACHED response without re-dispatching — the mechanism that
+        makes non-idempotent client writes (update/bind) safe to retry
+        through the same reconnect/backoff path as reads. Only
+        successful responses cache: a refused op may be legitimately
+        re-attempted with the same id after the cause is fixed.
+
+        Durability: the window piggybacks on the store's host log
+        (written after the mutation's own persist). The commit point is
+        the MUTATION record — a crash between the two records means the
+        retry re-executes an op whose first execution is also the one
+        the log replays, so CRDT-idempotent ops stay exact and the
+        window of double-execution for non-idempotent ops is the
+        microseconds between the two appends (the reference's backends
+        make the same trade)."""
+        if (
+            len(req) != 3
+            or not isinstance(req[1], (bytes, bytearray))
+            or not isinstance(req[2], tuple)
+            or not req[2]
+        ):
+            return (etf.ERROR, Atom("badarg"),
+                    b"idem takes {idem, ReqIdBinary, RequestTuple}")
+        reqid = bytes(req[1])
+        inner = req[2]
+        if str(inner[0]) == "idem":
+            return (etf.ERROR, Atom("badarg"), b"idem does not nest")
+        window = None
+        if self._idem is not None:
+            import collections
+
+            window = self._idem.setdefault(
+                self._idem_scope(), collections.OrderedDict()
+            )
+            hit = window.get(reqid)
+            if hit is not None:
+                counter(
+                    "bridge_idem_hits_total",
+                    help="idem-wrapped requests answered from the dedup "
+                         "window without re-execution (retried writes)",
+                ).inc()
+                return etf.decode(hit[1])
+        resp = self.handle(inner)
+        is_err = isinstance(resp, tuple) and resp and resp[0] == etf.ERROR
+        if window is not None and not is_err:
+            last = next(reversed(window.values()))[0] + 1 if window else 0
+            window[reqid] = (last, etf.encode(resp))
+            if self._hs is not None:
+                import pickle
+
+                # ONE small append per write (the _persist discipline),
+                # never a whole-window re-pickle; evictions delete their
+                # record so compaction reclaims it
+                self._hs.put(
+                    f"idem:{reqid.hex()}",
+                    pickle.dumps(window[reqid]),
+                )
+            while len(window) > _IDEM_WINDOW:
+                old_rid, _ent = window.popitem(last=False)
+                if self._hs is not None:
+                    self._hs.delete(f"idem:{old_rid.hex()}")
+        return resp
 
     def handle(self, req: Any) -> Any:
         if not isinstance(req, tuple) or not req:
             return (etf.ERROR, Atom("badarg"), b"request must be a tuple")
         verb = req[0]
+        if verb == "idem":
+            return self._handle_idem(req)
         if verb == "start":
             raw_name = req[1] if len(req) > 1 else Atom("store")
             # binaries are the protocol's normal currency for names/ids
@@ -927,6 +1041,7 @@ class BridgeServer:
         #: (the eleveldb per-partition persistence role)
         self.data_dir = data_dir
         self._store_locks: dict = {}
+        self._idem_windows: dict = {}
         self._sock: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._stop = threading.Event()
@@ -962,7 +1077,8 @@ class BridgeServer:
             ).start()
 
     def _serve_conn(self, sock: socket.socket) -> None:
-        state = _Conn(self.n_actors, self.data_dir, self._store_locks)
+        state = _Conn(self.n_actors, self.data_dir, self._store_locks,
+                      self._idem_windows)
         try:
             with sock:
                 while not self._stop.is_set():
@@ -1063,26 +1179,32 @@ class BridgeClient:
     jitter, reconnecting and replaying the session's ``{start, Name}``
     binding first, so a bridge server killed and restarted mid-session
     (a durable store picking its state back up) is invisible to read
-    traffic. NON-idempotent verbs (``update`` / ``bind`` /
-    ``merge_batch`` / ``declare`` / ``put`` / ``start``) fail FAST with
-    a clear error instead: a lost reply leaves the op's outcome unknown,
-    and blind replay could double-apply a non-idempotent op (a counter
-    increment) — exactly the reference's
-    at-most-once-unless-you-know-better FSM discipline. ``retries``
-    bounds the extra attempts, ``backoff`` seeds the exponential delay
-    (jittered ×[1, 2)), and ``timeout`` doubles as the per-call socket
-    deadline (override per call via ``call(..., timeout=...)``)."""
+    traffic. NON-idempotent verbs ``update`` / ``bind`` retry through
+    the SAME path by attaching a client-generated request id
+    (``{idem, ReqId, Request}``): the server's dedup window answers a
+    replayed id from cache instead of re-executing, so a lost reply can
+    no longer double-apply a counter increment — at-most-once, made
+    retryable (pass ``idem_writes=False`` for the old fail-fast
+    behavior). ``merge_batch`` / ``declare`` / ``put`` / ``start``
+    still fail fast: their payloads are large or their replay semantics
+    are the caller's business. ``retries`` bounds the extra attempts,
+    ``backoff`` seeds the exponential delay (jittered ×[1, 2)), and
+    ``timeout`` doubles as the per-call socket deadline (override per
+    call via ``call(..., timeout=...)``)."""
 
     #: verbs whose replay is observationally harmless (pure reads)
     IDEMPOTENT_VERBS = frozenset({"get", "read", "metrics", "health"})
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
-                 retries: int = 2, backoff: float = 0.05):
+                 retries: int = 2, backoff: float = 0.05,
+                 idem_writes: bool = True):
         self._host = host
         self._port = port
         self._timeout = timeout
         self._retries = max(0, int(retries))
         self._backoff = float(backoff)
+        #: wrap update/bind in {idem, ReqId, _} so they retry safely
+        self._idem_writes = bool(idem_writes)
         #: the session's {start, Name} frame, replayed on reconnect so a
         #: restarted durable server re-binds the same store
         self._session_frame: "bytes | None" = None
@@ -1176,11 +1298,24 @@ class BridgeClient:
     def get(self, var_id):
         return self.call((Atom("get"), var_id))
 
+    def _write_call(self, term: tuple):
+        """Non-idempotent write: attach a fresh request id and ride the
+        idempotent retry path — the server's dedup window makes the
+        replay at-most-once (see ``{idem, ...}`` in the protocol
+        table). With ``idem_writes=False``: the legacy fail-fast."""
+        if not self._idem_writes:
+            return self.call(term)
+        import os
+
+        return self.call(
+            (Atom("idem"), os.urandom(16), term), idempotent=True
+        )
+
     def update(self, var_id, op: tuple, actor):
-        return self.call((Atom("update"), var_id, tuple(op), actor))
+        return self._write_call((Atom("update"), var_id, tuple(op), actor))
 
     def bind(self, var_id, state):
-        return self.call((Atom("bind"), var_id, state))
+        return self._write_call((Atom("bind"), var_id, state))
 
     def merge_batch(self, items):
         return self.call((Atom("merge_batch"), list(items)))

@@ -27,8 +27,10 @@ from .invariants import (
     InvariantViolation,
     check_inflation,
     check_no_resurrection,
+    check_no_write_lost,
     fingerprint,
     run_harness,
+    run_quorum_harness,
     snapshot_states,
     states_equal,
 )
@@ -60,9 +62,11 @@ __all__ = [
     "SlowShard",
     "check_inflation",
     "check_no_resurrection",
+    "check_no_write_lost",
     "fingerprint",
     "nemesis",
     "run_harness",
+    "run_quorum_harness",
     "snapshot_states",
     "states_equal",
 ]

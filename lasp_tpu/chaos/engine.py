@@ -383,8 +383,6 @@ class ChaosRuntime:
         exactly the rows read (``src/lasp_update_fsm.erl:189-216``
         finalize), those rows mark frontier-dirty, and the wire cost is
         accounted per row actually changed. Returns the decoded value."""
-        import jax
-
         live = self.live_replicas()
         if live.size == 0:
             raise ReplicaDownError(
@@ -415,22 +413,11 @@ class ChaosRuntime:
             pop = self.rt._population(var_id)
             codec, spec = self.rt._mesh_meta(var_id)
             top = quorum_read(codec, spec, pop, picks)
-            rows_st = jax.tree_util.tree_map(lambda x: x[picks], pop)
-            merged = jax.vmap(
-                lambda r: codec.merge(spec, r, top)
-            )(rows_st)
-            changed = np.asarray(
-                jax.vmap(lambda a, b: ~codec.equal(spec, a, b))(
-                    rows_st, merged
-                )
-            )
-            repaired = int(changed.sum())
+            # the repair IS the quorum layer's masked-partial-join
+            # primitive: join the quorum's top back into exactly the
+            # rows read (changed rows mark frontier-dirty there)
+            repaired = self.rt.join_rows(var_id, picks, top)
             if repaired:
-                idx = picks
-                self.rt.states[var_id] = jax.tree_util.tree_map(
-                    lambda x, m: x.at[idx].set(m), pop, merged
-                )
-                self.rt.mark_dirty(var_id, picks)
                 bytes_ = rows_traffic_bytes(pop, repaired)
                 self.repair_bytes += bytes_
                 self.repaired_rows += repaired

@@ -119,6 +119,140 @@ def check_no_resurrection(rt, var_id: str, removed_terms) -> None:
         )
 
 
+def check_no_write_lost(rt, acked_terms) -> None:
+    """Assert no acknowledged write was lost: every term a client was
+    told is durable (``acked_terms``: ``{var_id: terms}`` — the
+    ``QuorumRuntime.acked_terms`` witness set) must appear in the
+    variable's coverage value. This is the contract hinted handoff
+    upholds across crash→restore: a put acked at W=2 whose ack replicas
+    all crash and reseed from the lattice bottom would otherwise vanish
+    entirely (the rolling-crash nemesis's signature data loss)."""
+    for v, terms in acked_terms.items():
+        value = rt.coverage_value(v)
+        lost = set(terms) - set(value)
+        if lost:
+            raise InvariantViolation(
+                f"acknowledged write(s) lost in {v!r}: "
+                f"{sorted(map(repr, lost))[:4]} were acked at the client "
+                "quorum but are absent from the coverage value after "
+                "heal — hinted handoff failed its contract"
+            )
+
+
+def run_quorum_harness(build, schedule, *, writes, reads=(),
+                       n: int = 3, r: int = 2, w: int = 2,
+                       timeout: int = 4, retries: int = 2,
+                       engine: str = "batched", mode: str = "dense",
+                       hints_path: "str | None" = None,
+                       max_rounds: int = 512, replay: bool = True) -> dict:
+    """The quorum-coordination invariant suite: drive a put/get workload
+    through a fault timeline and assert NO ACKNOWLEDGED WRITE IS LOST.
+
+    ``build()`` constructs a fresh, identically-seeded
+    ``ReplicatedRuntime`` (the ``run_harness`` contract). ``writes`` is
+    a list of ``(round, var_id, op, actor, coordinator)`` — each put is
+    submitted to the quorum engine just before that round executes;
+    ``reads`` likewise ``(round, var_id, coordinator)`` degraded gets.
+    The harness drains the batch past the schedule horizon to
+    quiescence, then checks:
+
+    - every fault healed and every submitted put resolved (an acked put
+      may never be un-acked; a failed put is REPORTED, not lost — only
+      ACKED terms enter the witness set);
+    - :func:`check_no_write_lost` against the engine's acked-terms
+      witness set (the hinted-handoff contract);
+    - with ``replay=True``, a second identical run produces an
+      identical protocol trace and final fingerprint (coordination is
+      as replayable as the chaos underneath it).
+
+    Returns the merged report (engine report + soak counters +
+    ``acked``/``failed_puts`` counts)."""
+    from ..quorum import HintLog, QuorumRuntime
+    from .engine import ChaosRuntime
+
+    def one_run():
+        rt = build()
+        ch = ChaosRuntime(rt, schedule)
+        hints = HintLog(hints_path)
+        # every run starts from an EMPTY log: the replay run must not
+        # inherit the first run's fsync'd records (their handoff joins
+        # would change the trace), nor run 1 a prior process's — the
+        # harness owns the path for the duration of the check
+        hints.prune()
+        qr = QuorumRuntime(ch, n=n, r=r, w=w, timeout=timeout,
+                           retries=retries, engine=engine, hints=hints,
+                           mode=mode)
+        pending = sorted(writes, key=lambda x: (x[0],))
+        pending_reads = sorted(reads, key=lambda x: (x[0],))
+        rids = []
+        while (qr.inflight or pending or pending_reads
+               or ch.round <= schedule.horizon):
+            if ch.round >= max_rounds:
+                raise InvariantViolation(
+                    f"quorum harness did not drain within {max_rounds} "
+                    f"rounds ({qr.inflight} in flight)"
+                )
+            while pending and pending[0][0] <= ch.round:
+                _rnd, var, op, actor, coord = pending.pop(0)
+                rids.append(qr.submit_put(var, op, actor, coord))
+            while pending_reads and pending_reads[0][0] <= ch.round:
+                _rnd, var, coord = pending_reads.pop(0)
+                qr.submit_get(var, coord, degraded=True)
+            qr.step()
+        # post-drain anti-entropy to the fixed point (no faults remain):
+        # the coverage reads below must judge the HEALED population
+        rt.run_to_convergence(max_rounds=max_rounds)
+        return rt, ch, qr, rids
+
+    rt, ch, qr, rids = one_run()
+    if ch.crashed.any():
+        raise InvariantViolation(
+            "quorum harness ended with replicas still down — the "
+            "schedule must heal within its horizon"
+        )
+    unresolved = [
+        rid for rid in rids
+        if qr.result(rid, raise_on_error=False)["status"]
+        not in ("done", "failed")
+    ]
+    if unresolved:
+        raise InvariantViolation(
+            f"puts {unresolved[:4]} never resolved (done/failed) after "
+            "the drain — the FSM leaked an in-flight request"
+        )
+    check_no_write_lost(rt, qr.acked_terms)
+    report = qr.report()
+    report.update({
+        "acked_terms": {
+            str(v): len(ts) for v, ts in qr.acked_terms.items()
+        },
+        "rounds": ch.round,
+        "healed": True,
+        "no_write_lost": True,
+    })
+    if replay:
+        rt2, _ch2, qr2, _ = one_run()
+        if qr.trace != qr2.trace:
+            first = next(
+                (i for i, (a, b) in enumerate(zip(qr.trace, qr2.trace))
+                 if a != b),
+                min(len(qr.trace), len(qr2.trace)),
+            )
+            raise InvariantViolation(
+                f"quorum replay diverged at trace entry {first}: the "
+                "same (seed, schedule, submits) must replay to an "
+                "identical protocol trace"
+            )
+        if fingerprint(snapshot_states(rt)) != fingerprint(
+            snapshot_states(rt2)
+        ):
+            raise InvariantViolation(
+                "quorum replay reached a different final state"
+            )
+        report["replay_identical"] = True
+    return report
+
+
 def run_harness(build, schedule, mode: str = "dense",
                 max_rounds: int = 512, replay: bool = True,
                 removed_terms: "dict | None" = None,

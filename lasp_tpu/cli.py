@@ -133,6 +133,57 @@ def cmd_chaos(args) -> int:
     return 0
 
 
+def cmd_quorum(args) -> int:
+    """Quorum coordination soak: drive a batched put/get quorum
+    workload (N=3, R=W=2 by default) through a nemesis preset and
+    verify the no-acknowledged-write-lost invariant (hinted handoff)
+    plus replay determinism — the coordination-layer twin of the
+    ``chaos`` verb (docs/RESILIENCE.md "Quorum coordination")."""
+    from lasp_tpu.chaos import nemesis
+    from lasp_tpu.chaos.invariants import run_quorum_harness
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import random_regular, ring, scale_free
+    from lasp_tpu.mesh.runtime import ReplicatedRuntime
+    from lasp_tpu.store import Store
+    from lasp_tpu.telemetry import get_monitor
+
+    topo = {"ring": ring, "random": random_regular,
+            "scale_free": scale_free}[args.topology]
+    nbrs = topo(args.replicas, args.fanout)
+
+    def build():
+        store = Store(n_actors=max(64, args.writes))
+        store.declare(id="kv", type="lasp_gset",
+                      n_elems=max(64, 2 * args.writes))
+        return ReplicatedRuntime(store, Graph(store), args.replicas, nbrs)
+
+    schedule = nemesis(
+        args.preset, args.replicas, nbrs, seed=args.seed,
+        rounds=args.rounds,
+    )
+    writes = [
+        (i % max(1, args.rounds), "kv", ("add", f"k{i}"), f"c{i}",
+         (i * 7) % args.replicas)
+        for i in range(args.writes)
+    ]
+    reads = [
+        (1 + i % max(1, args.rounds), "kv", (i * 11) % args.replicas)
+        for i in range(args.reads)
+    ]
+    report = run_quorum_harness(
+        build, schedule, writes=writes, reads=reads,
+        n=args.n, r=args.r, w=args.w, timeout=args.timeout,
+        retries=args.retries, engine=args.engine,
+        replay=not args.no_replay,
+    )
+    report["preset"] = args.preset
+    report["topology"] = args.topology
+    report["replicas"] = args.replicas
+    report["quorum_health"] = get_monitor().health().get("quorum")
+    print(json.dumps(report))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import os
     import runpy
@@ -599,6 +650,39 @@ def main(argv=None) -> int:
     ch.add_argument("--no-replay", action="store_true",
                     help="skip the replay-determinism second run")
 
+    qu = sub.add_parser(
+        "quorum",
+        help="quorum coordination soak: batched get/put FSMs under a "
+             "nemesis preset + the no-acked-write-lost invariant "
+             "(docs/RESILIENCE.md)",
+    )
+    qu.add_argument("--preset", required=True,
+                    choices=["ring-cut", "rolling-crash", "flaky-links",
+                             "slow-shard", "delay-links"])
+    qu.add_argument("--replicas", type=int, default=32)
+    qu.add_argument("--topology", choices=["ring", "random", "scale_free"],
+                    default="ring")
+    qu.add_argument("--fanout", type=int, default=cfg.fanout)
+    qu.add_argument("--writes", type=int, default=12,
+                    help="quorum puts issued across the fault window")
+    qu.add_argument("--reads", type=int, default=8,
+                    help="degraded quorum gets issued alongside")
+    qu.add_argument("--n", type=int, default=3, help="preflist width N")
+    qu.add_argument("--r", type=int, default=2, help="read quorum R")
+    qu.add_argument("--w", type=int, default=2, help="write quorum W")
+    qu.add_argument("--timeout", type=int, default=4,
+                    help="per-attempt wait in rounds")
+    qu.add_argument("--retries", type=int, default=3,
+                    help="coordinator re-picks before a partial-quorum "
+                         "failure")
+    qu.add_argument("--seed", type=int, default=0)
+    qu.add_argument("--rounds", type=int, default=10,
+                    help="fault-window length in gossip rounds")
+    qu.add_argument("--engine", choices=["batched", "sequential"],
+                    default="batched")
+    qu.add_argument("--no-replay", action="store_true",
+                    help="skip the replay-determinism second run")
+
     scen = sub.add_parser("scenario", help="run a BASELINE eval config")
     # literal list (not the SCENARIOS registry): importing bench_scenarios
     # here would pull jax into every CLI invocation including --help;
@@ -609,7 +693,7 @@ def main(argv=None) -> int:
         choices=["adcounter_10m", "adcounter_6", "bridge_throughput",
                  "chaos_heal", "dataflow_chain", "frontier_sparse",
                  "gset_1k", "many_vars", "orset_100k", "packed_vs_dense",
-                 "partitioned_gossip", "pipeline_1m"],
+                 "partitioned_gossip", "pipeline_1m", "quorum_kv"],
     )
     scen.add_argument("--replicas", type=int, default=0,
                       help="override the population for sized scenarios")
@@ -700,6 +784,7 @@ def main(argv=None) -> int:
         "simulate": cmd_simulate,
         "bench": cmd_bench,
         "chaos": cmd_chaos,
+        "quorum": cmd_quorum,
         "scenario": cmd_scenario,
         "metrics": cmd_metrics,
         "top": cmd_top,

@@ -3340,6 +3340,71 @@ class ReplicatedRuntime:
         top = quorum_read(codec, spec, pop, replicas)
         return self.store._decode_value(var, self._to_dense_row(var_id, top))
 
+    def join_rows(self, var_id: str, rows, contribs) -> int:
+        """Masked partial join: merge contribution rows into the named
+        replica rows — the one primitive behind read-repair
+        (``chaos.ChaosRuntime.degraded_read``), quorum put replication,
+        and hinted handoff (``quorum/``). ``rows`` must be UNIQUE
+        replica indices; ``contribs`` is either a sequence of wire-
+        format row trees (one per row) or a single row tree joined into
+        every named row (the read-repair broadcast shape).
+
+        Rows that the join actually changes mark frontier-dirty (exact:
+        an unchanged row inflated nothing to propagate). Returns the
+        number of rows changed. Join idempotence makes re-application
+        a no-op — callers may retry freely."""
+        rows = np.asarray(rows, dtype=np.int64)
+        if rows.size == 0:
+            return 0
+        if rows.min() < 0 or rows.max() >= self.n_replicas:
+            raise IndexError(
+                f"join_rows({var_id!r}): rows {rows.tolist()} out of "
+                f"range for {self.n_replicas} replicas"
+            )
+        if np.unique(rows).size != rows.size:
+            raise ValueError(
+                f"join_rows({var_id!r}): duplicate rows — fold same-row "
+                "contributions with codec.merge first (the scatter would "
+                "race otherwise)"
+            )
+        pop = self._population(var_id)  # before _mesh_meta (packing sync)
+        codec, spec = self._mesh_meta(var_id)
+        # a bare state NamedTuple is ONE row tree (broadcast); only a
+        # plain list/tuple is a per-row sequence (the reseed_row rule)
+        if isinstance(contribs, (list, tuple)) and not hasattr(
+            contribs, "_fields"
+        ):
+            if len(contribs) != rows.size:
+                raise ValueError(
+                    f"join_rows({var_id!r}): {len(contribs)} contribution "
+                    f"rows for {rows.size} target rows"
+                )
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+                *contribs,
+            )
+        else:  # single row tree, broadcast over the targets
+            stacked = jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(
+                    jnp.asarray(x)[None], (rows.size,) + jnp.shape(x)
+                ),
+                contribs,
+            )
+        rows_st = jax.tree_util.tree_map(lambda x: x[rows], pop)
+        merged = jax.vmap(lambda a, b: codec.merge(spec, a, b))(
+            rows_st, stacked
+        )
+        changed = np.asarray(
+            jax.vmap(lambda a, b: ~codec.equal(spec, a, b))(rows_st, merged)
+        )
+        n_changed = int(changed.sum())
+        if n_changed:
+            self.states[var_id] = jax.tree_util.tree_map(
+                lambda x, m: x.at[rows].set(m), pop, merged
+            )
+            self._mark_dirty_rows(var_id, rows[changed])
+        return n_changed
+
     def divergence(self, var_id: str) -> int:
         pop = self._population(var_id)  # before _mesh_meta (packing sync)
         codec, spec = self._mesh_meta(var_id)

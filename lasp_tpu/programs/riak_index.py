@@ -17,8 +17,13 @@ Semantics (reference lines in parentheses):
   ``(add, name, value)`` entry (:75-89);
 - the top-level index auto-registers one parameterized sub-view per index
   spec it observes (:92-98, ``create_views`` :162-176);
-- on ``delete``: remove the key's entries (:102-104); ``handoff`` is a
-  no-op (:105-107 is a TODO in the reference too);
+- on ``delete``: remove the key's entries (:102-104); ``handoff``
+  (:105-107 is a TODO in the reference) RE-INDEXES idempotently — a
+  handoff notification re-describes an object whose entries the
+  receiving instance may never have seen, so a key with NO live entry
+  takes the put path and an already-indexed key is left untouched (a
+  handoff frame carries no ordering authority; the vclock-derived
+  token keeps the replay merge-idempotent);
 - ``execute`` streams the set; ``value`` projects keys only (:117-121).
 
 Where the reference needs a parse_transform + per-vnode recompilation to
@@ -113,14 +118,23 @@ class RiakIndexProgram(Program):
         elif reason == "delete":
             self._remove_entries_for_key(session, obj.key, actor)
         elif reason == "handoff":
-            # deliberate no-op, matching the reference (:105-107 is a
-            # TODO there too): handoff notifications re-describe objects
-            # whose index entries the put path already owns — replaying
-            # them here would mint duplicate tokens under the receiving
-            # vnode's actor. Explicit branch so the notification is
-            # ACKNOWLEDGED rather than silently falling through with
-            # every other unknown reason.
-            pass
+            # ownership moved: the notification RE-DESCRIBES an object
+            # the receiving instance may never have indexed (:105-107
+            # leaves this as a TODO in the reference). Re-index
+            # IDEMPOTENTLY, gated PER KEY: only a key with NO live
+            # entry takes the put path. A key that already has an
+            # opinion — this exact write, or any other version — is
+            # left alone: the put path is the sole authority on
+            # ordering, and a handoff frame carries none (running the
+            # put path for a STALE re-description would remove the
+            # newer live entry, whose tombstoned token then suppresses
+            # every later replay — the entry would be unrecoverable).
+            # Replaying the same handoff is a no-op (the key is now
+            # indexed), and a handoff after a delete of the SAME write
+            # stays deleted: the re-add lands on its own tombstoned
+            # vclock-derived token.
+            if not self._key_indexed(session, obj.key):
+                self.process(session, obj, "put", actor)
         else:
             # an unrecognized reason is a caller bug (a misspelled verb
             # would otherwise drop the notification silently — an index
@@ -143,6 +157,13 @@ class RiakIndexProgram(Program):
         return {key for key, _metadata in output}
 
     # -- internals -----------------------------------------------------------
+    def _key_indexed(self, session, key) -> bool:
+        """Does the view hold ANY live entry for ``key``? The handoff
+        idempotence gate: an indexed key already has an opinion (this
+        version or another), and only the put path — which carries
+        ordering authority — may replace it."""
+        return any(e[0] == key for e in session.value(self.id))
+
     def _remove_entries_for_key(self, session, key, actor) -> None:
         """Remove every (key, *) entry currently in the view (:127-139)."""
         stale = [e for e in session.value(self.id) if e[0] == key]

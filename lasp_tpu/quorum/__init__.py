@@ -1,0 +1,58 @@
+"""Vectorized quorum coordination — the reference's request FSMs as
+batched tensor steps (Lasp L3, ROADMAP open item 4).
+
+The reference coordinates every client request through one of 18
+``gen_fsm`` modules: prepare → execute → waiting(R) → waiting_n(N) →
+finalize/repair with N=3, R=W=2 (``src/lasp_update_fsm.erl:174-216``,
+``src/lasp_read_fsm.erl:125-146``) plus ring-coverage merges
+(``src/lasp_execute_coverage_fsm.erl:50-97``). One Erlang process per
+in-flight request is exactly the shape that does NOT map to an
+accelerator — so this package re-expresses the layer as data-parallel
+tensor steps ("Mapping the Join Calculus to Heterogeneous Hardware",
+PAPERS.md): a request batch is a struct-of-arrays FSM advanced by ONE
+jitted transition kernel per round, drawing reachability from the same
+per-round edge masks the chaos schedule compiles, with the join work
+(get values, read-repair, put replication) dispatched as masked partial
+joins per variable (Tascade's barrier-free reduction discipline:
+coordination never stalls gossip).
+
+Modules:
+
+- :mod:`.fsm` — state vocabulary, deterministic preflists, per-round
+  component labeling over the chaos mask, and the two transition
+  implementations (the batched jit kernel and the per-request scalar
+  reference they are asserted bit-identical against);
+- :mod:`.engine` — :class:`QuorumRuntime`: submit/step/drain over a
+  ``ChaosRuntime`` (or bare ``ReplicatedRuntime``), read-repair as
+  masked partial joins, per-request timeout/retry with coordinator
+  re-pick, and the latency/staleness report the bench scenario lifts;
+- :mod:`.hints` — the durable hint log behind hinted handoff on
+  ``Restore`` (the no-acknowledged-write-lost invariant's mechanism);
+- :mod:`.coverage` — ring-coverage queries: partition-sweep map-merge
+  over all shards, one grouped dispatch per plan group, feeding
+  ``programs/riak_index.py``.
+
+docs/RESILIENCE.md "Quorum coordination" documents semantics vs the
+reference; ``tools/quorum_smoke.py`` (Makefile ``verify``) guards the
+batched-vs-sequential bit-identity contract.
+"""
+
+from .engine import PartialQuorumError, QuorumRuntime
+from .fsm import DONE, FAILED, PREPARE, REPAIR, STATE_NAMES, WAITING_N, WAITING_R
+from .hints import HintLog
+from .coverage import coverage_sweep, ring_coverage_execute
+
+__all__ = [
+    "QuorumRuntime",
+    "PartialQuorumError",
+    "HintLog",
+    "coverage_sweep",
+    "ring_coverage_execute",
+    "PREPARE",
+    "WAITING_R",
+    "WAITING_N",
+    "REPAIR",
+    "DONE",
+    "FAILED",
+    "STATE_NAMES",
+]

@@ -1,0 +1,141 @@
+"""The durable hint log behind hinted handoff on ``Restore``.
+
+Dynamo/riak hinted handoff: a write whose home replica is unreachable
+lands on a fallback node together with a HINT naming the intended home;
+when the home returns, the fallback hands the write off before the home
+rejoins quorums. The TPU rebuild keeps the protocol's guarantee with a
+simpler mechanism suited to the simulation's single host: every
+client-ACKED put appends one record — ``(var, preflist, wire row)`` —
+to this log; when a crashed replica restores
+(``ChaosRuntime._restore`` → the engine's restore hook), every record
+whose preflist names it is JOINED into the restored row before the
+replica serves another quorum. Join idempotence makes replay harmless
+(a row that already absorbed the write is a no-op), and the log is the
+mechanism behind the no-acknowledged-write-lost invariant
+(``chaos.invariants.check_no_write_lost``): a put acked at W=2 whose
+ack replicas BOTH crash and restore from the lattice bottom would
+otherwise be lost entirely — the rolling-crash nemesis's signature
+failure.
+
+Durability: with a ``path``, every append pickles the record to an
+append-only file (flushed per record, the bitcask discipline of the
+bridge's host log) and a fresh :class:`HintLog` over the same path
+replays the survivors — a process restart keeps its acked writes.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from ..telemetry import counter, gauge
+
+
+class HintLog:
+    """Append-only log of client-acked quorum puts; see the module doc.
+
+    Records are host trees (numpy leaves) of ONE replica row in the
+    runtime's MESH wire format, so replay is a plain leafwise join
+    against the live population."""
+
+    def __init__(self, path: "str | None" = None):
+        self.path = path
+        self.records: list = []  # (var_id, picks int64[N], row-tree, rid)
+        #: replica -> record indices naming it (restores scan only their
+        #: own slice, not the whole history)
+        self._by_replica: dict = {}
+        self.replays = 0
+        if path is not None and os.path.exists(path):
+            self._load(path)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def _load(self, path: str) -> None:
+        with open(path, "rb") as fp:
+            while True:
+                try:
+                    self._index(pickle.load(fp))
+                except EOFError:
+                    break
+
+    def _index(self, rec) -> None:
+        idx = len(self.records)
+        self.records.append(rec)
+        for r in rec[1]:
+            self._by_replica.setdefault(int(r), []).append(idx)
+
+    def append(self, var_id: str, picks, row, rid: int) -> None:
+        """Record one acked put. ``row`` is the put's wire-format row
+        (device or host leaves; stored as host copies so the log never
+        pins device buffers)."""
+        import jax
+
+        host_row = jax.tree_util.tree_map(np.asarray, row)
+        rec = (var_id, np.asarray(picks, dtype=np.int64).copy(), host_row,
+               int(rid))
+        self._index(rec)
+        if self.path is not None:
+            with open(self.path, "ab") as fp:
+                pickle.dump(rec, fp)
+                fp.flush()
+                os.fsync(fp.fileno())
+        gauge(
+            "quorum_hints_pending",
+            help="hinted-handoff records held for crashed-replica catch-up",
+        ).set(len(self.records))
+
+    def pending_for(self, replica: int) -> list:
+        """Records whose preflist names ``replica`` — what a restore
+        must hand off before the row rejoins quorums. Indexed per
+        replica, so a restore scans its own slice, not the whole
+        history. Records PERSIST after a replay on purpose: a replica
+        that crashes AGAIN and reseeds from bottom needs them again
+        (re-joins are idempotent no-ops on caught-up rows); reclaim via
+        :meth:`prune` once the population has verifiably converged."""
+        return [
+            self.records[i]
+            for i in self._by_replica.get(int(replica), ())
+        ]
+
+    def replay(self, runtime, replica: int) -> int:
+        """Hand off every pending hint to a restored replica row: each
+        record's row joins into ``states[var][replica]`` (an exact no-op
+        where gossip already caught the row up — idempotence). Returns
+        the number of rows actually changed. The caller (the quorum
+        engine's restore hook) runs this BEFORE the replica serves
+        another quorum — the ordering hinted handoff promises."""
+        changed = 0
+        for var_id, _picks, row, _rid in self.pending_for(replica):
+            if var_id not in runtime.var_ids:
+                continue
+            changed += runtime.join_rows(
+                var_id, np.asarray([int(replica)], dtype=np.int64), [row]
+            )
+        self.replays += 1
+        if changed:
+            counter(
+                "quorum_hint_replays_total",
+                help="hinted-handoff rows handed to restored replicas "
+                     "(rows actually changed by replay)",
+            ).inc(changed)
+        return changed
+
+    def prune(self) -> int:
+        """Drop every record (call once the population has verifiably
+        converged past the log's writes — e.g. after a fault-free
+        ``run_to_convergence``). Returns the number dropped. The durable
+        file is truncated too."""
+        n = len(self.records)
+        self.records.clear()
+        self._by_replica.clear()
+        if self.path is not None and os.path.exists(self.path):
+            with open(self.path, "wb"):
+                pass
+        gauge(
+            "quorum_hints_pending",
+            help="hinted-handoff records held for crashed-replica catch-up",
+        ).set(0)
+        return n

@@ -92,6 +92,10 @@ class ConvergenceMonitor:
         #: latest chaos soak report (rounds_to_heal, degraded reads,
         #: repair bytes — chaos.ChaosRuntime.soak); empty outside soaks
         self.chaos: dict = {}
+        #: latest quorum coordination report (latency percentiles,
+        #: repair/push traffic, hint-log state — quorum.QuorumRuntime.
+        #: report); empty until a quorum engine runs
+        self.quorum: dict = {}
         self._tel: "dict | None" = None
 
     def _check_generation(self) -> None:
@@ -194,6 +198,18 @@ class ConvergenceMonitor:
             self._check_generation()
             self.chaos.update(report)
             self.chaos["round"] = self.round
+
+    def observe_quorum(self, **report) -> None:
+        """Fold a quorum coordination report into the health surface —
+        latency percentiles, completion/failure counts, repair and
+        replication traffic, hint-log state from
+        ``quorum.QuorumRuntime.report`` land under the snapshot's
+        ``quorum`` key (the ``{health}`` verb and ``lasp_tpu top``
+        read it alongside ``chaos``)."""
+        with self._lock:
+            self._check_generation()
+            self.quorum.update(report)
+            self.quorum["round"] = self.round
 
     def observe_membership(self, kind: str, old_n: int, new_n: int) -> None:
         with self._lock:
@@ -463,6 +479,7 @@ class ConvergenceMonitor:
                 "quiescence_eta": self._eta_locked(),
                 "frontier_by_var": dict(self.frontier),
                 "chaos": dict(self.chaos),
+                "quorum": dict(self.quorum),
                 "residual_curve": curve[-64:],
                 "memberships": list(self.memberships),
                 "probe": self.last_probe,

@@ -60,6 +60,7 @@ EVENT_TYPES = frozenset({
     "edge_recompute",  # DEEP: one edge's recompute provenance
     "frontier_skip",   # dirty-set scheduling skipped vars/edges outright
     "chaos",           # fault injected/healed, crash/restore, degraded read
+    "quorum",          # quorum FSM round summary / hinted handoff replay
 })
 
 _lock = threading.Lock()

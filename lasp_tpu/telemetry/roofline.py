@@ -83,6 +83,7 @@ FAMILIES = (
     "chaos_window",
     "boundary_exchange",
     "dataflow_fused",
+    "quorum_step",
 )
 
 
@@ -248,6 +249,24 @@ def kernel_traffic(
         lo = T * int(row_bytes)
         hi = 3 * T * int(row_bytes) + pad
         return TrafficEstimate(moved, lo, hi, T * int(n_vars))
+
+    if family == "quorum_step":
+        # the quorum FSM transition kernel (quorum.fsm.transition_
+        # batched): pure CONTROL-PLANE traffic — per request the
+        # struct-of-arrays slices (state/coord/deadline/need ~16B) plus
+        # the pick/ack/reach lanes (K slots × ~6B: int32 pick + bools),
+        # plus the shared component labeling + liveness planes (R × 5B)
+        # read once per dispatch. ``rows`` is the padded request bucket,
+        # ``fanout`` the preflist width N. Deliberately tiny next to the
+        # state-moving families — the point of the ledger row is showing
+        # that coordination control costs ~nothing next to the joins it
+        # schedules. No calibrated xla bounds (the kernel is a handful
+        # of elementwise ops; cost_analysis noise dominates).
+        F = int(rows or 0)
+        moved = F * (16 + 6 * K) + R * 5
+        lo = F * (8 + 4 * K)
+        hi = 4 * moved + pad
+        return TrafficEstimate(moved, lo, hi, F * K)
 
     # boundary_exchange: the partitioned round's wire+local traffic —
     # local read+write of the population plus the cut rows crossing the

@@ -54,19 +54,111 @@ def test_idempotent_verbs_survive_server_restart(tmp_path):
         server.stop()
 
 
-def test_non_idempotent_verbs_fail_fast():
+def test_unwrapped_non_idempotent_verbs_fail_fast():
     server = BridgeServer(port=0)
     port = server.start()
     c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=3,
-                     backoff=0.01)
+                     backoff=0.01, idem_writes=False)
     assert c.start("s")[0] == Atom("ok")
     c.declare(b"v", "riak_dt_gcounter")
+    # connect BEFORE the kill: constructing a client against a stopped
+    # server fails in the constructor, not in the verb under test
+    c2 = BridgeClient("127.0.0.1", port, timeout=5.0, retries=3,
+                      backoff=0.01)
+    assert c2.start("s2")[0] == Atom("ok")
     server.stop()
     with pytest.raises(ConnectionError, match="never retried"):
-        # a lost increment's outcome is unknown; blind replay could
+        # with idem_writes off there is no request id: a lost
+        # increment's outcome is unknown and blind replay could
         # double-count — the client must fail fast, not retry
         c.update(b"v", (Atom("increment"),), b"w")
+    # merge_batch carries no id either way and stays fail-fast
+    with pytest.raises(ConnectionError):
+        c2.merge_batch([(b"v", [])])
     c.close()
+    c2.close()
+
+
+def test_idem_update_retries_through_kill_restart(tmp_path):
+    """The satellite contract: a mid-update server kill/restart. The
+    client's update carries a request id, retries through the outage on
+    the same backoff path as reads, replays {start, Name}, and applies
+    EXACTLY ONCE on the restarted durable store."""
+    data = str(tmp_path / "bridge_data")
+    server = BridgeServer(port=0, data_dir=data)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=4,
+                         backoff=0.05)
+        assert c.start("soak")[0] == Atom("ok")
+        c.declare(b"v", "riak_dt_gcounter")
+        server.stop()  # the server dies mid-session...
+        server = _restart_on(port, data_dir=data)
+        # ...and the non-idempotent write still lands, once
+        ok, value = c.update(b"v", (Atom("increment"),), b"w")
+        assert ok == Atom("ok")
+        assert value == 1
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_idem_dedup_suppresses_replay_of_applied_write(tmp_path):
+    """The ambiguous-outcome case the dedup window exists for: the op
+    APPLIED but the reply was lost. Replaying the identical idem frame
+    (what the retry path sends) must return the first response without
+    re-executing — including across a durable server restart, where the
+    persisted window is the only memory of the first execution."""
+    import os
+
+    data = str(tmp_path / "bridge_data")
+    server = BridgeServer(port=0, data_dir=data)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=4,
+                         backoff=0.05)
+        assert c.start("soak")[0] == Atom("ok")
+        c.declare(b"v", "riak_dt_gcounter")
+        reqid = os.urandom(16)
+        frame = (Atom("idem"), reqid, (Atom("update"), b"v",
+                                       (Atom("increment"),), b"w"))
+        first = c.call(frame, idempotent=True)
+        assert first == (Atom("ok"), 1)
+        # same-process replay: served from the window, not re-applied
+        assert c.call(frame, idempotent=True) == first
+        server.stop()
+        server = _restart_on(port, data_dir=data)
+        # post-restart replay: the window was persisted with the store
+        assert c.call(frame, idempotent=True) == first
+        assert c.read(b"v") == (Atom("ok"), 1)  # applied exactly once
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_idem_scope_is_per_connection_for_in_memory_stores():
+    """In-memory stores die with their connection: a second connection
+    re-using a request id must NOT be answered from another store's
+    window (the write never happened on ITS store)."""
+    import os
+
+    server = BridgeServer(port=0)
+    port = server.start()
+    reqid = os.urandom(16)
+    frame = (Atom("idem"), reqid, (Atom("update"), b"v",
+                                   (Atom("increment"),), b"w"))
+    c1 = BridgeClient("127.0.0.1", port)
+    assert c1.start("s")[0] == Atom("ok")
+    c1.declare(b"v", "riak_dt_gcounter")
+    assert c1.call(frame) == (Atom("ok"), 1)
+    assert c1.call(frame) == (Atom("ok"), 1)  # deduped
+    c2 = BridgeClient("127.0.0.1", port)
+    assert c2.start("s")[0] == Atom("ok")
+    c2.declare(b"v", "riak_dt_gcounter")
+    # fresh store, fresh window: the id executes here
+    assert c2.call(frame) == (Atom("ok"), 1)
+    c1.close()
+    c2.close()
 
 
 def test_idempotent_retry_exhaustion_raises():

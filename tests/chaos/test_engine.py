@@ -111,6 +111,85 @@ def test_degraded_read_never_crosses_a_partition():
     assert rep["healed"] and rt.coverage_value(v) == {"left", "right"}
 
 
+def test_degraded_read_quorum_larger_than_reachable_clamps():
+    """The partial-quorum surface: a requested k beyond the live
+    reachable set clamps to R-of-live (the first-replies rule) instead
+    of blocking or crossing the cut — and the answer is the join of
+    exactly that smaller quorum."""
+    nbrs = ring(N, 2)
+    rt, v = _build(nbrs)
+    # isolate a 4-replica group (N/8 groups of 8... use 8 groups of 4)
+    sched = ChaosSchedule(N, nbrs, [Partition(0, 8, 8)], seed=3)
+    ch = ChaosRuntime(rt, sched)
+    rt.update_at(1, v, ("add", "near"), "w1")
+    rt.update_at(20, v, ("add", "far"), "w2")
+    for _ in range(4):
+        ch.step()
+    # coordinator 0's component is rows {0..3}: k=12 >> 4 reachable
+    val = ch.degraded_read(v, k=12, coordinator=0)
+    assert val == {"near"}  # clamped quorum, confined to the component
+    # the strict quorum layer surfaces the same situation as an ERROR
+    from lasp_tpu.quorum import PartialQuorumError, QuorumRuntime
+
+    qr = QuorumRuntime(ch, n=3, r=3, timeout=2, retries=0)
+    # a strict R=3 get whose coordinator sits in the 4-row island CAN
+    # assemble (3 <= 4); break it harder: preflist {30, 31, 0} spans the
+    # cut — rows 0 is unreachable from 30's island {28..31}
+    rid = qr.submit_get(v, coordinator=30, r=3)
+    while qr.inflight:
+        qr.step()
+    res = qr.result(rid, raise_on_error=False)
+    assert res["status"] == "failed" and "partial quorum" in res["error"]
+    with pytest.raises(PartialQuorumError, match="partial quorum"):
+        qr.result(rid)
+
+
+def test_degraded_read_repair_false_accounting():
+    """``repair=False`` answers the quorum WITHOUT the read-repair
+    partial join: no state changes, no repair rows, no wire bytes —
+    the read-only accounting contract."""
+    nbrs = ring(N, 2)
+    rt, v = _build(nbrs)
+    sched = ChaosSchedule(N, nbrs, [Partition(0, 8, 2)], seed=5)
+    ch = ChaosRuntime(rt, sched)
+    rt.update_at(0, v, ("add", "x"), "w0")
+    ch.step()
+    before = jax.tree_util.tree_map(np.asarray, rt.states[v])
+    val = ch.degraded_read(v, k=3, repair=False)
+    assert val == {"x"}
+    assert ch.repaired_rows == 0 and ch.repair_bytes == 0
+    assert _tree_eq(before, rt.states[v])  # no repair write happened
+    # with repair on, the same read DOES move rows and count bytes
+    val = ch.degraded_read(v, k=3, repair=True)
+    assert val == {"x"}
+    assert ch.repaired_rows > 0 and ch.repair_bytes > 0
+
+
+def test_degraded_read_confined_under_delay_links_mask():
+    """Quorum confinement holds for EVERY mask source, not just
+    Partition: under a DelayLinks window that buffers every link, a
+    non-flush round's mask isolates each replica — the quorum must
+    collapse to the coordinator's own row."""
+    nbrs = ring(N, 2)
+    rt, v = _build(nbrs)
+    from lasp_tpu.chaos import DelayLinks
+
+    # frac=1.0: every link buffered; flush only every (delay+1)=4 rounds
+    sched = ChaosSchedule(N, nbrs, [DelayLinks(0, 12, frac=1.0, delay=3)],
+                          seed=6)
+    ch = ChaosRuntime(rt, sched)
+    rt.update_at(0, v, ("add", "x"), "w0")
+    rt.update_at(5, v, ("add", "y"), "w5")
+    ch.step()  # round 0: buffered (non-flush), nothing delivered
+    # round 1's mask is still the buffered one: every replica is its own
+    # component, so a k=3 read at coordinator 0 sees ONLY row 0
+    assert ch.degraded_read(v, k=3, coordinator=0) == {"x"}
+    assert ch.degraded_read(v, k=3, coordinator=5) == {"y"}
+    assert ch.degraded_read(v, k=3, coordinator=9) == set()
+    rep = ch.soak()
+    assert rep["healed"] and rt.coverage_value(v) == {"x", "y"}
+
+
 def test_degraded_read_without_live_replicas_raises():
     nbrs = ring(4, 2)
     store = Store(n_actors=4)

@@ -121,22 +121,103 @@ def test_riak_index_program_mesh_views_and_delete():
     assert rt.execute(BASE_NAME) == {"alpha", "gamma"}
 
 
-def test_riak_index_handoff_noop_and_unknown_reason_loud():
+def test_riak_index_handoff_idempotent_and_unknown_reason_loud():
     rt = _rt(n=8, k=2)
     rt.register(BASE_NAME, RiakIndexProgram, n_elems=8, token_space=8,
                 auto_views=False)
     obj = RiakObject(key="k", vclock=("vc", 1), metadata="m")
     rt.process(obj, "put", "a0", replica=0)
     assert rt.execute(BASE_NAME) == {"k"}
-    # handoff is an ACKNOWLEDGED no-op (the reference stubs it too,
-    # src/lasp_vnode.erl:105-107): replaying the object must not mint a
-    # duplicate entry or remove the live one
+    # handoff re-describes the object at a row that never saw the put:
+    # the vclock-derived token makes the re-index IDEMPOTENT — after
+    # convergence there is exactly one entry, never a duplicate
     rt.process(obj, "handoff", "a1", replica=3)
+    assert rt.execute(BASE_NAME) == {"k"}
+    rt.run_to_convergence(max_rounds=64)
+    prog = rt._programs[BASE_NAME]
+    assert rt.divergence(prog.id) == 0
     assert rt.execute(BASE_NAME) == {"k"}
     # an unknown reason must be LOUD, not a silently dropped notification
     with pytest.raises(NotImplementedError, match="unsupported object-event"):
         rt.process(obj, "putt", "a0", replica=0)
     assert rt.execute(BASE_NAME) == {"k"}
+
+
+def test_riak_index_put_handoff_delete_sequence():
+    """The satellite contract: put → handoff → delete. Handoff of an
+    already-indexed object is a no-op (same entry, no token churn);
+    handoff of an UNSEEN object indexes it; a handoff replayed after
+    the delete stays deleted (the re-add lands on its own tombstoned
+    token — delete wins, replay-safe)."""
+    rt = _rt(n=8, k=2)
+    rt.register(BASE_NAME, RiakIndexProgram, n_elems=8, token_space=8,
+                auto_views=False)
+    prog = rt._programs[BASE_NAME]
+    obj = RiakObject(key="k", vclock=("vc", 1), metadata="m")
+
+    rt.process(obj, "put", "a0", replica=0)
+    before = rt.store.variable(prog.id)
+    n_elems_before = len(before.elems)
+    # handoff at the SAME row: the exact entry is live -> no-op (no new
+    # element interned, no remove-then-add churn)
+    rt.process(obj, "handoff", "a0", replica=0)
+    assert rt.execute(BASE_NAME) == {"k"}
+    assert len(rt.store.variable(prog.id).elems) == n_elems_before
+
+    # handoff of an object this index NEVER saw put: ownership moved
+    # mid-stream — the re-description must index it
+    other = RiakObject(key="k2", vclock=("vc", 7), metadata="m2")
+    rt.process(other, "handoff", "a1", replica=5)
+    assert rt.execute(BASE_NAME) == {"k", "k2"}
+
+    rt.process(obj, "delete", "a0", replica=0)
+    assert rt.execute(BASE_NAME) == {"k2"}
+    # a handoff frame replayed after the delete must NOT resurrect the
+    # entry: the re-add's vclock-derived token is tombstoned
+    rt.process(obj, "handoff", "a0", replica=0)
+    assert rt.execute(BASE_NAME) == {"k2"}
+    rt.run_to_convergence(max_rounds=64)
+    assert rt.execute(BASE_NAME) == {"k2"}
+
+
+def test_riak_index_stale_handoff_cannot_erase_newer_entry():
+    """The review-hardening regression: a handoff carrying an OLDER
+    version of an already-indexed key must NOT take the put path —
+    remove-then-re-add would tombstone the newer entry's token while
+    the stale re-add lands on its own tombstoned token, leaving the
+    key unrecoverably unindexed."""
+    rt = _rt(n=8, k=2)
+    rt.register(BASE_NAME, RiakIndexProgram, n_elems=8, token_space=8,
+                auto_views=False)
+    rt.process(RiakObject(key="k", vclock=("vc", 1), metadata="old"),
+               "put", "a0", replica=0)
+    rt.process(RiakObject(key="k", vclock=("vc", 2), metadata="new"),
+               "put", "a0", replica=0)
+    assert rt.execute(BASE_NAME) == {"k"}
+    # a fallback vnode hands off the version IT held — the older one
+    rt.process(RiakObject(key="k", vclock=("vc", 1), metadata="old"),
+               "handoff", "a1", replica=0)
+    out = rt._programs[BASE_NAME].execute(rt._session())
+    assert out == {("k", "new")}  # the newer entry survived, unreplaced
+    # replaying the stale handoff again is still a no-op
+    rt.process(RiakObject(key="k", vclock=("vc", 1), metadata="old"),
+               "handoff", "a1", replica=0)
+    assert rt._programs[BASE_NAME].execute(rt._session()) == {("k", "new")}
+
+
+def test_riak_index_handoff_respects_subset_views():
+    """A handoff re-description flows through view selection like a
+    put: matching subset views index it, non-matching views skip it."""
+    rt = _rt(n=8, k=2)
+    rt.register(BASE_NAME, RiakIndexProgram, n_elems=8, token_space=8)
+    seed = RiakObject(key="seed", vclock=("vc", 0),
+                      index_specs=(("add", "color", "red"),))
+    rt.process(seed, "put", "a0", replica=0)  # auto-creates the red view
+    handed = RiakObject(key="h", vclock=("vc", 1),
+                        index_specs=(("add", "color", "red"),))
+    rt.process(handed, "handoff", "a1", replica=2)
+    assert rt.execute(BASE_NAME) == {"seed", "h"}
+    assert rt.execute(view_name("color", "red")) == {"h"}
 
 
 def test_index_capacity_recovery_converges_then_compacts():

@@ -28,6 +28,7 @@ def test_scenario_registry_complete():
         "frontier_sparse",
         "many_vars",
         "dataflow_chain",
+        "quorum_kv",
         "chaos_heal",
     }
 
@@ -194,3 +195,26 @@ def test_chaos_heal_small():
     )
     assert out["healed"] and out["restores"] == out["crashes"] == 2
     assert out["rounds_to_heal"] >= 0 and out["degraded_reads"] > 0
+
+
+def test_quorum_kv_small():
+    """The quorum_kv artifact shape: per-preset latency percentiles,
+    staleness-vs-converged distance, repair traffic, and the asserted
+    no-acked-write-lost invariant — on every backend."""
+    from lasp_tpu.bench_scenarios import quorum_kv
+    from lasp_tpu.chaos import PRESETS
+
+    out = quorum_kv(n_replicas=16, client_rounds=3,
+                    puts_per_round=2, gets_per_round=2)
+    assert set(out["presets"]) == set(PRESETS)
+    assert out["n_r_w"] == [3, 2, 2]
+    for preset, rep in out["presets"].items():
+        assert rep["no_write_lost"], preset
+        assert rep["completed"] + rep["failed"] == rep["requests"], preset
+        for key in ("get_p50_rounds", "get_p99_rounds",
+                    "put_p50_rounds", "put_p99_rounds"):
+            assert rep[key] is None or rep[key] >= 1, (preset, key)
+        assert rep["staleness_mean"] is None or rep["staleness_mean"] >= 0
+        assert rep["repair_wire_bytes"] >= 0
+    # rolling-crash restores replicas: the hinted-handoff path ran
+    assert out["presets"]["rolling-crash"]["hint_replays"] > 0

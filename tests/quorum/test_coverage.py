@@ -1,0 +1,95 @@
+"""Ring-coverage queries: grouped partition-sweep map-merge equals the
+per-variable coverage value bit-for-bit, groups by plan signature, and
+feeds the 2i index programs in one dispatch per group."""
+
+import numpy as np
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime, random_regular, ring
+from lasp_tpu.programs.riak_index import (
+    BASE_NAME,
+    RiakIndexProgram,
+    RiakObject,
+    view_name,
+)
+from lasp_tpu.quorum import coverage_sweep, ring_coverage_execute
+from lasp_tpu.quorum.coverage import _sweep_cache
+from lasp_tpu.store import Store
+
+
+def _mixed_rt(R=12, topo=ring, k=2):
+    store = Store(n_actors=8)
+    ids = []
+    for i in range(4):
+        ids.append(store.declare(id=f"g{i}", type="lasp_gset", n_elems=16))
+    ids.append(store.declare(id="c0", type="riak_dt_gcounter"))
+    ids.append(store.declare(id="o0", type="riak_dt_orswot",
+                             n_elems=16, n_actors=8))
+    rt = ReplicatedRuntime(store, Graph(store), R, topo(R, k))
+    for i in range(4):
+        rt.update_at((i * 3) % R, f"g{i}", ("add", f"e{i}"), f"w{i}")
+    rt.update_at(5, "c0", ("increment",), "wc")
+    rt.update_at(7, "o0", ("add", "tag"), "wo")
+    return rt, ids
+
+
+def test_sweep_matches_per_var_coverage_value():
+    rt, ids = _mixed_rt()
+    for n_shards in (1, 4):
+        sw = coverage_sweep(rt, n_shards=n_shards)
+        for v in ids:
+            assert sw[v] == rt.coverage_value(v), (v, n_shards)
+
+
+def test_sweep_groups_by_signature():
+    """4 same-spec gsets share ONE compiled sweep (G=4); the counter
+    and orswot are their own groups — the plan-compiler discipline on
+    the query path. (R=14 is unique to this test, so the signature keys
+    are fresh in the module-level sweep cache.)"""
+    rt, _ids = _mixed_rt(R=14)
+    before = set(_sweep_cache)
+    coverage_sweep(rt, n_shards=4)
+    new = [k for k in _sweep_cache if k not in before]
+    gs = [k for k in new if k[2] == 4]  # the G=4 gset group
+    assert len(gs) == 1
+    assert len(new) == 3  # gset x4, gcounter, orswot
+
+
+def test_sweep_after_more_writes_stays_exact():
+    rt, ids = _mixed_rt(R=10, topo=random_regular, k=3)
+    rt.run_to_convergence(max_rounds=64)
+    rt.update_at(0, "g0", ("add", "late"), "w9")
+    sw = coverage_sweep(rt)
+    assert sw["g0"] == rt.coverage_value("g0") >= {"e0", "late"}
+
+
+def test_ring_coverage_execute_feeds_index_views():
+    R = 10
+    store = Store(n_actors=8)
+    rt = ReplicatedRuntime(store, Graph(store), R, ring(R, 2))
+    rt.register(BASE_NAME, RiakIndexProgram, n_elems=32, token_space=32)
+    for i in range(6):
+        rt.process(
+            RiakObject(
+                key=f"k{i}", vclock=("vc", i),
+                index_specs=(("add", "color",
+                              "red" if i % 2 else "blue"),),
+            ),
+            "put", f"a{i}", replica=i % R,
+        )
+    rt.run_to_convergence(max_rounds=64)
+    out = ring_coverage_execute(rt)
+    assert set(out) == set(rt.programs)
+    for name in out:
+        assert out[name] == rt.execute(name), name
+    # the auto-created same-spec views all rode one grouped sweep
+    assert view_name("color", "red") in out
+    assert out[BASE_NAME] == {f"k{i}" for i in range(6)}
+
+
+def test_ring_coverage_execute_unknown_program_is_loud():
+    rt, _ids = _mixed_rt()
+    import pytest
+
+    with pytest.raises(KeyError, match="nope"):
+        ring_coverage_execute(rt, names=["nope"])

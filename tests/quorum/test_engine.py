@@ -1,0 +1,380 @@
+"""QuorumRuntime protocol semantics + the tentpole's acceptance
+contract: the batched tensor engine is BIT-IDENTICAL to the
+per-request sequential reference — results, repair writes, ack
+sequences, final population states — across codecs × topologies ×
+chaos presets."""
+
+import numpy as np
+import pytest
+
+from lasp_tpu.chaos import (
+    ChaosRuntime,
+    ChaosSchedule,
+    Crash,
+    Partition,
+    Restore,
+    nemesis,
+)
+from lasp_tpu.chaos.invariants import (
+    InvariantViolation,
+    check_no_write_lost,
+    fingerprint,
+    run_quorum_harness,
+    snapshot_states,
+)
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime, random_regular, ring
+from lasp_tpu.quorum import HintLog, PartialQuorumError, QuorumRuntime
+from lasp_tpu.store import Store
+
+
+def _build(R, nbrs, type="lasp_gset", packed=False, **caps):
+    store = Store(n_actors=16)
+    caps.setdefault("n_elems", 32)
+    if type == "riak_dt_orswot":
+        caps.setdefault("n_actors", 16)
+    v = store.declare(id="kv", type=type, **caps)
+    rt = ReplicatedRuntime(store, Graph(store), R, nbrs, packed=packed)
+    return rt, v
+
+
+# -- protocol semantics -----------------------------------------------------
+
+def test_put_reaches_w_then_finalizes_all_n():
+    R = 8
+    rt, v = _build(R, ring(R, 2))
+    qr = QuorumRuntime(rt)
+    rid = qr.submit_put(v, ("add", "x"), "w0", coordinator=2)
+    qr.step()
+    res = qr.result(rid)
+    assert res["status"] == "done"
+    assert res["acks"] == [2, 3, 4]  # the ring preflist, all N acked
+    assert res["rounds"] == 1
+    assert rt.replica_value(v, 3) == {"x"}  # replicated, not just local
+    assert qr.acked_terms == {v: {"x"}}
+
+
+def test_get_value_is_quorum_join_and_repairs():
+    R = 8
+    rt, v = _build(R, ring(R, 2))
+    rt.update_at(5, v, ("add", "y"), "w5")
+    qr = QuorumRuntime(rt)
+    rid = qr.submit_get(v, coordinator=5)
+    qr.step()
+    res = qr.result(rid)
+    assert res["value"] == {"y"} and res["status"] == "done"
+    # read-repair pushed the join into the acked quorum rows
+    assert rt.replica_value(v, 6) == {"y"}
+    assert qr.repaired_rows > 0
+
+
+def test_timeout_repick_moves_coordinator_past_crash():
+    """A crashed coordinator mid-wait: the request times out, re-picks
+    the next live replica, and completes there — the preflist routing
+    of the reference, as an FSM transition."""
+    R = 8
+    nbrs = ring(R, 2)
+    rt, v = _build(R, nbrs)
+    rt.update_at(4, v, ("add", "z"), "w4")
+    sched = ChaosSchedule(R, nbrs, [Crash(0, 0), Restore(6, 0)], seed=1)
+    ch = ChaosRuntime(rt, sched)
+    qr = QuorumRuntime(ch, timeout=2, retries=2)
+    rid = qr.submit_get(v, coordinator=0)  # crashed at round 0
+    while qr.inflight:
+        qr.step()
+    res = qr.result(rid)
+    assert res["status"] == "done"
+    assert res["coordinator"] != 0  # re-picked past the crash
+    assert res["retries"] == 0  # routed at PREPARE, no retry consumed
+    assert qr.report()["completed"] == 1
+
+
+def test_strict_get_fails_with_partial_quorum_error():
+    R = 16
+    nbrs = ring(R, 2)
+    rt, v = _build(R, nbrs)
+    # 8-way partition: 2-replica islands; preflist {15, 0, 1} spans cuts
+    sched = ChaosSchedule(R, nbrs, [Partition(0, 10, 8)], seed=2)
+    qr = QuorumRuntime(ChaosRuntime(rt, sched), timeout=2, retries=1)
+    rid = qr.submit_get(v, coordinator=15, r=3)
+    while qr.inflight:
+        qr.step()
+    with pytest.raises(PartialQuorumError, match="partial quorum"):
+        qr.result(rid)
+    assert qr.result(rid, raise_on_error=False)["status"] == "failed"
+    assert qr.report()["failed"] == 1
+
+
+def test_degraded_get_answers_r_of_live():
+    """R-of-live degradation: the same cut that fails a strict get
+    answers a degraded one from the coordinator's island."""
+    R = 16
+    nbrs = ring(R, 2)
+    rt, v = _build(R, nbrs)
+    rt.update_at(15, v, ("add", "edge"), "w15")
+    sched = ChaosSchedule(R, nbrs, [Partition(0, 10, 8)], seed=2)
+    qr = QuorumRuntime(ChaosRuntime(rt, sched), timeout=2, retries=1)
+    rid = qr.submit_get(v, coordinator=15, r=3, degraded=True)
+    qr.step()
+    res = qr.result(rid)
+    # the client has its answer (R-of-live) while the FSM finalizes
+    # toward the unreachable preflist stragglers
+    assert res["status"] == "acked" and res["value"] == {"edge"}
+    assert res["rounds"] == 1
+    while qr.inflight:
+        qr.step()
+    res = qr.result(rid)
+    assert res["status"] == "done" and res["value"] == {"edge"}
+    # island of coordinator 15 under the 8-way cut is {14, 15}
+    assert set(res["acks"]) <= {14, 15, 0, 1}
+
+
+def test_inflight_batch_advances_together():
+    """Thousands-in-flight is the point: a wave of requests advances as
+    ONE batch per round (the kernel sees every active request)."""
+    R = 32
+    rt, v = _build(R, ring(R, 2), n_elems=256)
+    qr = QuorumRuntime(rt)
+    rids = [
+        qr.submit_put(v, ("add", f"e{i}"), f"w{i}", coordinator=i % R)
+        for i in range(64)
+    ]
+    rids += [qr.submit_get(v, coordinator=(i * 7) % R) for i in range(64)]
+    out = qr.step()
+    assert out["fired"] == 128  # every request reached quorum in round 0
+    assert qr.inflight == 0
+    assert all(qr.result(r)["status"] == "done" for r in rids)
+
+
+# -- the acceptance contract: batched == sequential -------------------------
+
+@pytest.mark.parametrize("type_name,packed,topo", [
+    ("lasp_gset", False, "ring"),
+    ("riak_dt_orswot", False, "random"),
+    ("lasp_orset", True, "ring"),  # packed wire format, same FSMs
+])
+@pytest.mark.parametrize("preset", ["flaky-links", "rolling-crash"])
+def test_batched_engine_bit_identical_to_sequential(type_name, packed,
+                                                    topo, preset):
+    # topology is PAIRED with the codec (the full topology x codec x
+    # packed cross runs in tools/quorum_smoke.py, `make verify`)
+    R = 16
+    nbrs = ring(R, 2) if topo == "ring" else random_regular(R, 3, seed=3)
+    outs = []
+    for engine in ("batched", "sequential"):
+        rt, v = _build(R, nbrs, type=type_name, packed=packed)
+        sched = nemesis(preset, R, nbrs, seed=5, rounds=6)
+        ch = ChaosRuntime(rt, sched)
+        qr = QuorumRuntime(ch, engine=engine, timeout=3, retries=3)
+        results = []
+        for i in range(14):
+            if i < 6:
+                coord = (i * 5) % R
+                if not ch.crashed[coord]:
+                    qr.submit_put(v, ("add", f"e{i}"), f"w{i}",
+                                  coordinator=coord)
+                qr.submit_get(v, coordinator=int(
+                    np.flatnonzero(~ch.crashed)[0]
+                ), degraded=True)
+            qr.step()
+        while qr.inflight:
+            qr.step()
+        for rid in range(qr._next_rid):
+            results.append(qr.result(rid, raise_on_error=False))
+        outs.append({
+            "trace": qr.trace,
+            "fp": fingerprint(snapshot_states(rt)),
+            "results": results,
+            "accounting": (qr.repaired_rows, qr.pushed_rows,
+                           qr.wire_bytes, qr.completed, qr.failed,
+                           qr.retries),
+        })
+    assert outs[0]["trace"] == outs[1]["trace"]
+    assert outs[0]["fp"] == outs[1]["fp"]
+    assert outs[0]["results"] == outs[1]["results"]
+    assert outs[0]["accounting"] == outs[1]["accounting"]
+
+
+# -- hinted handoff + no-acknowledged-write-lost ----------------------------
+
+def _adversarial_loss_schedule(R, nbrs):
+    """Isolate exactly the preflist {0,1,2}, crash ALL THREE at once
+    mid-window, restore from bottom still partitioned: without hinted
+    handoff the acked write exists nowhere afterwards."""
+    events = [Partition(0, 8, 3),
+              Crash(2, 0), Crash(2, 1), Crash(2, 2),
+              Restore(4, 0), Restore(4, 1), Restore(4, 2)]
+    return ChaosSchedule(R, nbrs, events, seed=1)
+
+
+def test_acked_write_survives_total_preflist_crash_via_hints():
+    R = 9
+    nbrs = ring(R, 2)
+    rt, v = _build(R, nbrs)
+    ch = ChaosRuntime(rt, _adversarial_loss_schedule(R, nbrs))
+    qr = QuorumRuntime(ch, timeout=3, retries=2)
+    qr.submit_put(v, ("add", "precious"), "w0", coordinator=0)
+    while qr.inflight or ch.round <= ch.schedule.horizon:
+        qr.step()
+    rt.run_to_convergence()
+    check_no_write_lost(rt, qr.acked_terms)
+    assert rt.coverage_value(v) == {"precious"}
+    assert qr.hints.replays == 3  # one handoff per restored replica
+
+
+def test_without_hints_the_acked_write_is_lost():
+    """The control arm: sabotaging the hint log loses the write — the
+    invariant is non-trivially upheld, not vacuous."""
+    R = 9
+    nbrs = ring(R, 2)
+    rt, v = _build(R, nbrs)
+    ch = ChaosRuntime(rt, _adversarial_loss_schedule(R, nbrs))
+    qr = QuorumRuntime(ch, timeout=3, retries=2)
+    qr.submit_put(v, ("add", "precious"), "w0", coordinator=0)
+    while qr.inflight or ch.round <= ch.schedule.horizon:
+        qr.hints.prune()  # drop every hint before it can replay
+        qr.step()
+    rt.run_to_convergence()
+    with pytest.raises(InvariantViolation, match="acknowledged write"):
+        check_no_write_lost(rt, qr.acked_terms)
+
+
+def test_hint_log_durable_roundtrip(tmp_path):
+    path = str(tmp_path / "hints.log")
+    R = 8
+    nbrs = ring(R, 2)
+    rt, v = _build(R, nbrs)
+    qr = QuorumRuntime(rt, hints=path)
+    qr.submit_put(v, ("add", "x"), "w0", coordinator=0)
+    qr.step()
+    assert len(qr.hints) == 1
+    # a fresh HintLog over the same path re-reads the records (the
+    # process-restart story) and hands off against the SAME store's
+    # universe — hint rows are wire-format and interner-relative, so a
+    # foreign store could not decode them
+    log2 = HintLog(path)
+    assert len(log2) == 1
+    rt.reseed_row(1, None)  # wipe the row back to bottom
+    assert rt.replica_value(v, 1) == set()
+    changed = log2.replay(rt, 1)
+    assert changed == 1 and rt.replica_value(v, 1) == {"x"}
+    assert log2.replay(rt, 1) == 0  # idempotent re-handoff
+    assert log2.prune() == 1 and len(HintLog(path)) == 0
+
+
+def test_run_quorum_harness_rolling_crash():
+    """The acceptance criterion end-to-end: puts acked at W=2 survive
+    the rolling-crash nemesis via hinted handoff, checked by the
+    chaos/invariants.py harness (replay determinism included)."""
+    R = 16
+    nbrs = ring(R, 2)
+
+    def build():
+        store = Store(n_actors=16)
+        store.declare(id="kv", type="lasp_gset", n_elems=32)
+        return ReplicatedRuntime(store, Graph(store), R, nbrs)
+
+    sched = nemesis("rolling-crash", R, nbrs, seed=11, rounds=9)
+    report = run_quorum_harness(
+        build, sched,
+        writes=[(rnd, "kv", ("add", f"t{rnd}"), f"w{rnd}", (rnd * 3) % R)
+                for rnd in range(4)],
+        reads=[(3, "kv", 1)],
+        timeout=3, retries=3,
+    )
+    assert report["no_write_lost"] and report["replay_identical"]
+    assert report["failed"] == 0
+    assert report["acked_terms"] == {"kv": 4}
+
+
+def test_repicked_coordinator_receives_the_write():
+    """Review-hardening regression: after a coordinator re-pick, the
+    push exclusion keys on the row the op APPLIED at — the NEW
+    coordinator is an ordinary pick and must receive the delta, or it
+    would count toward W while holding nothing (an R-of-live read
+    coordinated there would then miss an acked write)."""
+    R = 6
+    nbrs = ring(R, 2)
+    rt, v = _build(R, nbrs)
+    # partition {0,1,2} | {3,4,5}; put at 2 -> picks {2,3,4} span the
+    # cut -> timeout -> re-pick to 3 (the other side)
+    sched = ChaosSchedule(R, nbrs, [Partition(0, 20, 2)], seed=0)
+    qr = QuorumRuntime(ChaosRuntime(rt, sched), timeout=2, retries=3)
+    rid = qr.submit_put(v, ("add", "x"), "w", coordinator=2)
+    while qr.inflight:
+        qr.step()
+    res = qr.result(rid)
+    assert res["status"] == "done" and res["coordinator"] == 3
+    for r in res["acks"]:
+        assert rt.quorum_value(v, [r]) == {"x"}, (
+            f"acked row {r} does not hold the write"
+        )
+
+
+def test_quorum_harness_durable_hints_path(tmp_path):
+    """Review-hardening regression: a durable ``hints_path`` must not
+    break replay determinism — each harness run starts from a truncated
+    log (the second run would otherwise inherit the first's fsync'd
+    records and diverge on handoff counts)."""
+    R = 12
+    nbrs = ring(R, 2)
+
+    def build():
+        store = Store(n_actors=16)
+        store.declare(id="kv", type="lasp_gset", n_elems=32)
+        return ReplicatedRuntime(store, Graph(store), R, nbrs)
+
+    sched = nemesis("rolling-crash", R, nbrs, seed=4, rounds=8)
+    path = str(tmp_path / "hints.log")
+    for _ in range(2):  # second call re-enters over the populated file
+        report = run_quorum_harness(
+            build, sched,
+            writes=[(i, "kv", ("add", f"t{i}"), f"w{i}", (i * 5) % R)
+                    for i in range(2)],
+            hints_path=path, timeout=3, retries=3,
+        )
+        assert report["no_write_lost"] and report["replay_identical"]
+
+
+# -- health / telemetry surfaces --------------------------------------------
+
+def test_report_lands_in_health_surface():
+    from lasp_tpu.telemetry import get_monitor
+
+    R = 8
+    rt, v = _build(R, ring(R, 2))
+    qr = QuorumRuntime(rt)
+    qr.submit_put(v, ("add", "x"), "w0", coordinator=0)
+    qr.step()
+    rep = qr.report()
+    health = get_monitor().health()
+    assert health["quorum"]["completed"] == rep["completed"]
+    assert health["quorum"]["put_p50_rounds"] == rep["put_p50_rounds"]
+
+
+def test_quorum_step_lands_in_roofline_ledger():
+    from lasp_tpu.telemetry import get_ledger
+
+    R = 8
+    rt, v = _build(R, ring(R, 2))
+    qr = QuorumRuntime(rt)
+    for i in range(4):  # warm past the compile bucket
+        qr.submit_put(v, ("add", f"x{i}"), f"w{i}", coordinator=i)
+        qr.step()
+    rows = [e for e in get_ledger().snapshot()
+            if e["family"] == "quorum_step"]
+    assert rows and rows[0]["dispatches"] >= 1
+
+
+def test_submit_validation():
+    R = 8
+    rt, v = _build(R, ring(R, 2))
+    qr = QuorumRuntime(rt)
+    with pytest.raises(KeyError):
+        qr.submit_get("nope")
+    with pytest.raises(IndexError):
+        qr.submit_get(v, coordinator=99)
+    with pytest.raises(ValueError, match="quorum"):
+        qr.submit_get(v, r=4)  # r > n
+    with pytest.raises(ValueError, match="engine"):
+        QuorumRuntime(rt, engine="warp")

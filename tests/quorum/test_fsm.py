@@ -1,0 +1,126 @@
+"""Unit semantics of the quorum FSM layer: preflists, component
+labeling over chaos masks, and the batched-vs-sequential transition
+bit-identity on randomized control-plane states."""
+
+import numpy as np
+import pytest
+
+from lasp_tpu.chaos import ChaosSchedule, Crash, DelayLinks, Partition
+from lasp_tpu.mesh.topology import random_regular, ring
+from lasp_tpu.quorum import fsm
+
+
+def test_preflist_is_coordinator_first_ring_walk():
+    assert fsm.preflist(0, 3, 8).tolist() == [0, 1, 2]
+    assert fsm.preflist(6, 3, 8).tolist() == [6, 7, 0]  # wraps
+    with pytest.raises(ValueError):
+        fsm.preflist(0, 9, 8)
+
+
+def test_next_live_coordinator_walks_past_crashes():
+    crashed = np.zeros(6, dtype=bool)
+    crashed[[1, 2]] = True
+    assert fsm.next_live_coordinator(0, crashed) == 3
+    assert fsm.next_live_coordinator(5, crashed) == 0
+    assert fsm.next_live_coordinator(1, np.ones(6, dtype=bool)) is None
+
+
+def test_components_unmasked_is_one_component():
+    nbrs = ring(12, 2)
+    comp = fsm.components(nbrs, None, np.ones(12, dtype=bool))
+    assert (comp == comp[0]).all()
+
+
+def test_components_split_by_partition_mask():
+    R = 16
+    nbrs = ring(R, 2)
+    sched = ChaosSchedule(R, nbrs, [Partition(0, 4, 2)], seed=0)
+    comp = sched_comp = fsm.components(
+        nbrs, sched.mask_at(1), np.ones(R, dtype=bool)
+    )
+    left, right = comp[:8], comp[8:]
+    assert (left == left[0]).all() and (right == right[0]).all()
+    assert left[0] != right[0]
+
+
+def test_components_exclude_crashed_rows():
+    R = 8
+    nbrs = ring(R, 2)
+    sched = ChaosSchedule(R, nbrs, [Crash(0, 3)], seed=0)
+    live = ~sched.crashed_at(0)
+    comp = fsm.components(nbrs, sched.mask_at(0), live)
+    # the crashed row keeps its own label; everyone else connects
+    others = comp[live]
+    assert (others == others[0]).all()
+    assert comp[3] != others[0]
+
+
+def test_components_under_full_delay_links_isolate_everyone():
+    R = 8
+    nbrs = ring(R, 2)
+    sched = ChaosSchedule(
+        R, nbrs, [DelayLinks(0, 8, frac=1.0, delay=3)], seed=0
+    )
+    comp = fsm.components(nbrs, sched.mask_at(0), np.ones(R, dtype=bool))
+    assert len(set(comp.tolist())) == R  # every row its own component
+
+
+def _random_control_plane(rng, b, n, R):
+    state = rng.choice(
+        [fsm.WAITING_R, fsm.WAITING_N, fsm.DONE, fsm.FAILED],
+        size=b, p=[0.5, 0.3, 0.1, 0.1],
+    ).astype(np.int32)
+    coord = rng.randint(0, R, size=b).astype(np.int32)
+    picks = np.stack(
+        [fsm.preflist(c, n, R) for c in coord]
+    ).astype(np.int32)
+    pick_valid = np.ones((b, n), dtype=bool)
+    for i in rng.choice(b, size=b // 4, replace=False):
+        pick_valid[i, rng.randint(1, n):] = False
+    acks = rng.rand(b, n) < 0.3
+    acks &= pick_valid
+    deadline = rng.randint(0, 8, size=b).astype(np.int32)
+    need = rng.randint(1, n + 1, size=b).astype(np.int32)
+    degraded = rng.rand(b) < 0.3
+    return state, coord, picks, pick_valid, acks, deadline, need, degraded
+
+
+@pytest.mark.parametrize("topo", ["ring", "random"])
+def test_transition_batched_matches_sequential_randomized(topo):
+    """The kernel contract: for random control planes × masked
+    reachability, the one-dispatch batched transition equals the
+    per-request scalar loop bit-for-bit on every output."""
+    R, n = 16, 3
+    nbrs = ring(R, 2) if topo == "ring" else random_regular(R, 3, seed=7)
+    sched = ChaosSchedule(
+        R, nbrs,
+        [Partition(0, 3, 2), DelayLinks(3, 6, frac=0.5, delay=1),
+         Crash(1, 5), Crash(2, 11)],
+        seed=9,
+    )
+    rng = np.random.RandomState(42)
+    for rnd in range(6):
+        live = ~sched.crashed_at(rnd)
+        comp = fsm.components(nbrs, sched.mask_at(rnd), live)
+        for b in (1, 5, 17, 64):
+            plane = _random_control_plane(rng, b, n, R)
+            out_b = fsm.transition_batched(*plane, comp, live, rnd)
+            out_s = fsm.transition_sequential(*plane, comp, live, rnd)
+            for ob, os_ in zip(out_b, out_s):
+                assert np.array_equal(ob, os_), (rnd, b)
+
+
+def test_bucket_padding_reuses_kernels():
+    R, n = 8, 3
+    comp = np.zeros(R, dtype=np.int32)
+    live = np.ones(R, dtype=bool)
+    rng = np.random.RandomState(0)
+    plane = _random_control_plane(rng, 3, n, R)
+    fsm.transition_batched(*plane, comp, live, 0)  # ensures (8, 3)
+    assert (8, 3) in fsm._kernel_cache
+    snapshot = set(fsm._kernel_cache)
+    for b in (5, 7, 8):  # all pad to the same bucket (8)
+        plane = _random_control_plane(rng, b, n, R)
+        fsm.transition_batched(*plane, comp, live, 0)
+    # one executable served every size: no new compiles
+    assert set(fsm._kernel_cache) == snapshot

@@ -51,8 +51,10 @@ def test_two_replica_workload_covers_all_layers(fresh_registry, capsys):
     rec = _value(snap, "dataflow_edge_recomputes_total", kind="map")
     assert rec["value"] > 0
 
-    # bridge verb latencies from the loopback exchange
-    for verb in ("start", "declare", "update", "read", "metrics"):
+    # bridge verb latencies from the loopback exchange (the client's
+    # update ships idem-wrapped — the write-retry dedup path — so the
+    # frame counts under the wrapper verb)
+    for verb in ("start", "declare", "idem", "read", "metrics"):
         assert _value(snap, "bridge_requests_total", verb=verb)["value"] == 1
         assert _value(snap, "bridge_request_seconds", verb=verb)["count"] == 1
     assert "bridge_errors_total" not in snap  # a clean run errors nowhere

@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Quorum-coordination smoke for the lint tier (Makefile ``verify``):
+a sub-minute guard on the tentpole's two contracts
+(docs/RESILIENCE.md "Quorum coordination"):
+
+1. **batched == sequential, bit-for-bit** — the vectorized FSM batch
+   (one jitted transition kernel per round + grouped partial joins)
+   produces IDENTICAL results, repair/replication writes, ack-sequence
+   traces, and final population states as the per-request sequential
+   reference, across ring/random topologies × a nemesis preset ×
+   dense/packed codecs;
+2. **no acknowledged write lost** — a put acked at W=2 survives the
+   rolling-crash nemesis via hinted handoff, with replay determinism
+   (the ``run_quorum_harness`` invariant suite);
+
+plus a ring-coverage cross-check (grouped partition-sweep values equal
+per-var coverage values) and a metric-liveness probe for the
+``quorum_*`` family. Exits 0 on agreement, 1 with the divergence."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from lasp_tpu.chaos import ChaosRuntime, InvariantViolation, nemesis
+    from lasp_tpu.chaos.invariants import (
+        fingerprint,
+        run_quorum_harness,
+        snapshot_states,
+    )
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular, ring
+    from lasp_tpu.quorum import QuorumRuntime, coverage_sweep
+    from lasp_tpu.store import Store
+
+    R = 24
+
+    def build(nbrs, packed=False):
+        store = Store(n_actors=32)
+        store.declare(id="kv", type="lasp_orset", n_elems=64,
+                      tokens_per_actor=8)
+        store.declare(id="g", type="lasp_gset", n_elems=64)
+        return ReplicatedRuntime(store, Graph(store), R, nbrs,
+                                 packed=packed)
+
+    # -- 1. batched vs sequential bit-identity ------------------------------
+    for topo_name, nbrs in (
+        ("ring", ring(R, 2)),
+        ("random", random_regular(R, 3, seed=7)),
+    ):
+        for packed in (False, True):
+            outs = []
+            for engine in ("batched", "sequential"):
+                rt = build(nbrs, packed=packed)
+                sched = nemesis("flaky-links", R, nbrs, seed=3, rounds=6)
+                ch = ChaosRuntime(rt, sched)
+                qr = QuorumRuntime(ch, engine=engine, timeout=3,
+                                   retries=3)
+                for i in range(12):
+                    if i < 5:
+                        qr.submit_put("kv", ("add", f"e{i}"), f"w{i}",
+                                      coordinator=(i * 5) % R)
+                        qr.submit_put("g", ("add", f"t{i}"), f"u{i}",
+                                      coordinator=(i * 3 + 1) % R)
+                        qr.submit_get("kv", coordinator=(i * 7) % R,
+                                      degraded=True)
+                    qr.step()
+                while qr.inflight:
+                    qr.step()
+                outs.append({
+                    "trace": qr.trace,
+                    "fp": fingerprint(snapshot_states(rt)),
+                    "results": [
+                        qr.result(rid, raise_on_error=False)
+                        for rid in range(qr._next_rid)
+                    ],
+                    "accounting": (qr.repaired_rows, qr.pushed_rows,
+                                   qr.wire_bytes, qr.completed,
+                                   qr.failed, qr.retries),
+                })
+            for key in ("trace", "fp", "results", "accounting"):
+                if outs[0][key] != outs[1][key]:
+                    print(
+                        f"quorum_smoke: batched != sequential on {key} "
+                        f"(topology={topo_name}, packed={packed})",
+                        file=sys.stderr,
+                    )
+                    return 1
+            print(
+                f"quorum smoke [{topo_name}, packed={packed}]: batched "
+                "== sequential (trace, results, repair writes, states)"
+            )
+
+    # -- 2. no-acked-write-lost under rolling-crash (hinted handoff) --------
+    nbrs = ring(R, 2)
+
+    def build_one():
+        store = Store(n_actors=32)
+        store.declare(id="kv", type="lasp_gset", n_elems=64)
+        return ReplicatedRuntime(store, Graph(store), R, nbrs)
+
+    sched = nemesis("rolling-crash", R, nbrs, seed=11, rounds=9)
+    try:
+        report = run_quorum_harness(
+            build_one, sched,
+            writes=[(rnd, "kv", ("add", f"k{rnd}"), f"c{rnd}",
+                     (rnd * 5) % R) for rnd in range(6)],
+            reads=[(3, "kv", 1)],
+            timeout=3, retries=3,
+        )
+    except InvariantViolation as exc:
+        print(f"quorum_smoke: INVARIANT VIOLATED: {exc}", file=sys.stderr)
+        return 1
+    print(
+        f"quorum smoke [invariants]: {report['acked_terms']['kv']} acked "
+        f"writes survived rolling-crash (hint replays: "
+        f"{report['hint_replays']}), replay deterministic"
+    )
+
+    # -- 3. ring coverage: grouped sweep == per-var coverage ----------------
+    rt = build_one()
+    rng = np.random.RandomState(2)
+    for i in range(6):
+        rt.update_at(int(rng.randint(R)), "kv", ("add", f"c{i}"), f"x{i}")
+    sweep = coverage_sweep(rt, n_shards=4)
+    for v in rt.var_ids:
+        if sweep[v] != rt.coverage_value(v):
+            print(f"quorum_smoke: coverage sweep drift on {v!r}",
+                  file=sys.stderr)
+            return 1
+    print("quorum smoke [coverage]: grouped partition-sweep == coverage")
+
+    # -- 4. the quorum_* metric family is live ------------------------------
+    from lasp_tpu.telemetry import render_prometheus
+
+    text = render_prometheus()
+    for needle in ("quorum_requests_total", "quorum_completions_total",
+                   "quorum_latency_rounds"):
+        if needle not in text:
+            print(f"quorum_smoke: metric {needle} not exported",
+                  file=sys.stderr)
+            return 1
+    print("quorum smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
