@@ -37,7 +37,10 @@ test-slow:
 # edge plus its live roofline row (docs/PERF.md "Dataflow fusion"), a
 # quorum smoke guards the batched-FSM-vs-sequential-reference
 # bit-identity and the no-acked-write-lost hinted-handoff invariant
-# (docs/RESILIENCE.md "Quorum coordination"),
+# (docs/RESILIENCE.md "Quorum coordination"), a serve smoke guards the
+# serving front-end's coalesced-vs-sequential bit-identity, vectorized
+# watch fan-out parity, and typed shed accounting under forced
+# overload (docs/SERVING.md),
 # then the non-slow tests run (the tier-1 shape)
 verify:
 	python tools/check_metrics_catalog.py
@@ -48,6 +51,7 @@ verify:
 	python tools/pallas_smoke.py
 	python tools/dataflow_fusion_smoke.py
 	python tools/quorum_smoke.py
+	python tools/serve_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

@@ -511,6 +511,21 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["quorum_kv"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- serving front-end arm (~a minute): 10k-client open-loop load
+    # (Zipf-hot write+read+watch mix) through the coalescing ingest +
+    # vectorized threshold fan-out, composite nemesis + 5x overload
+    # burst concurrent; records offered vs admitted vs completed rates,
+    # the typed shed/retry-after breakdown, queue high-water marks, the
+    # degradation-ladder transition log, and per-class p50/p99 latency,
+    # with no-acked-write-lost AND 100k-threshold vectorized-vs-
+    # per-watch parity asserted inside the scenario --------------------------
+    try:
+        from lasp_tpu.bench_scenarios import serve_load
+
+        detail["serve_load"] = serve_load()
+    except Exception as exc:
+        detail["serve_load"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- north-star: 10M-replica engine-path ad counter ---------------------
     ns0 = cfg.bench_northstar_replicas or (
         10 * (1 << 20) if on_tpu else (1 << 13)

@@ -10,6 +10,8 @@ Layer map (mirrors SURVEY.md §1, redesigned per §7):
 - ``lasp_tpu.mesh``    — replication/gossip/quorum over device meshes (L2/L3)
 - ``lasp_tpu.quorum``  — batched request-coordination FSMs, hinted
   handoff, ring-coverage queries (the reference's 18 gen_fsm layer, L3)
+- ``lasp_tpu.serve``   — overload-hardened serving front-end: coalescing
+  ingest, vectorized threshold fan-out, admission + backpressure
 - ``lasp_tpu.api``     — the public Lasp verb set (L4)
 - ``lasp_tpu.programs``— distributed incremental programs (L5)
 - ``lasp_tpu.ops``     — Pallas/packed kernels for the hot merge path
@@ -27,7 +29,7 @@ __version__ = "0.1.0"
 # without paying jax's import cost or risking any backend touch.
 _SUBMODULES = frozenset({
     "api", "bridge", "chaos", "config", "dataflow", "lattice", "mesh",
-    "ops", "programs", "quorum", "store", "telemetry", "utils",
+    "ops", "programs", "quorum", "serve", "store", "telemetry", "utils",
 })
 _ATTRS = {
     "Session": ("api", "Session"),
@@ -64,6 +66,7 @@ __all__ = [
     "ops",
     "programs",
     "quorum",
+    "serve",
     "store",
     "telemetry",
     "__version__",

@@ -296,6 +296,30 @@ class Session:
         return QuorumRuntime(runtime, n=n, r=r, w=w, hints=hints,
                              **kwargs)
 
+    def serve(self, runtime, **kwargs):
+        """Wrap a replicated runtime (from :meth:`replicate`) — or a
+        :class:`~lasp_tpu.chaos.ChaosRuntime` from :meth:`nemesis` — in
+        a :class:`~lasp_tpu.serve.ServeFrontend`: the overload-hardened
+        ingestion front-end (bounded admission queues, coalesced
+        ``update_batch`` megabatches, vectorized threshold fan-out,
+        deadline propagation, the degradation ladder —
+        docs/SERVING.md):
+
+        >>> rt = session.replicate(64)
+        >>> fe = session.serve(rt)
+        >>> t = fe.submit_write("kv", ("add", "x"), "client0")
+        >>> fe.cycle(); t.status
+        'done'
+
+        Extra kwargs reach :class:`ServeFrontend` (``admission``,
+        ``gossip_block``, ``coalesce_max``, ``clock``,
+        ``write_backup``). The serving report lands in :meth:`health`
+        under ``serve``."""
+        from ..serve import ServeFrontend
+
+        _count_verb("serve")
+        return ServeFrontend(runtime, **kwargs)
+
     # -- programs (L5, src/lasp_program.erl) ---------------------------------
     def register(self, name: str, program_cls, *args, **kwargs) -> str:
         """``lasp:register/4`` (``src/lasp.erl:84-86``): instantiate a

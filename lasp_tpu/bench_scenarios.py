@@ -2065,6 +2065,57 @@ def quorum_kv(
     }
 
 
+def serve_load(
+    n_replicas: int = 64,
+    n_clients: int = 10_000,
+    ticks: int = 40,
+    arrivals_per_tick: int = 1200,
+    burst_factor: int = 5,
+    seed_watches: int = 10_000,
+    parity_thresholds: int = 100_000,
+    seed: int = 7,
+) -> dict:
+    """Open-loop serving benchmark: ``n_clients`` simulated clients
+    drive a sustained Zipf-hot write+read+watch mix through the
+    serving front-end while gossip runs concurrently UNDER a composite
+    nemesis (partition + flaky links + staggered crash/restores), with
+    a mid-run ``burst_factor``x overload burst. The artifact records
+    what overload costs and proves it stays correct: offered vs
+    admitted vs completed rates, the typed shed/retry-after breakdown,
+    deadline-expired cancellations, queue high-water marks, the
+    degradation-ladder transition log, p50/p99 latency per request
+    class — and TWO in-scenario assertions: the PR-9
+    no-acked-write-lost invariant over the front-end's witness set
+    after heal+convergence, and vectorized-vs-per-watch THRESHOLD
+    PARITY at ``parity_thresholds`` registered thresholds
+    (docs/SERVING.md)."""
+    from lasp_tpu.serve.harness import run_load
+
+    report, secs = _timed(lambda: run_load(
+        n_replicas=n_replicas,
+        n_clients=n_clients,
+        ticks=ticks,
+        arrivals_per_tick=arrivals_per_tick,
+        chaos=True,
+        burst_at=max(2, ticks // 2),
+        burst_ticks=max(2, ticks // 8),
+        burst_factor=burst_factor,
+        seed_watches=seed_watches,
+        parity_thresholds=parity_thresholds,
+        seed=seed,
+    ))
+    report.update({
+        "scenario": f"serve_load_{n_replicas}",
+        "seconds": round(secs, 4),
+        "engine": "ServeFrontend(coalescing+vectorized fan-out)"
+                  "+ChaosRuntime",
+        "check": "no acked write lost after heal; vectorized threshold "
+                 f"fan-out parity at {parity_thresholds} watches; "
+                 "typed sheds only (never silent drop)",
+    })
+    return report
+
+
 SCENARIOS = {
     "adcounter_6": adcounter_6,
     "gset_1k": gset_1k,
@@ -2079,4 +2130,5 @@ SCENARIOS = {
     "dataflow_chain": dataflow_chain,
     "chaos_heal": chaos_heal,
     "quorum_kv": quorum_kv,
+    "serve_load": serve_load,
 }

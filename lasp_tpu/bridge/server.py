@@ -663,9 +663,17 @@ class _Conn:
 
     def __init__(self, n_actors: int, data_dir: Optional[str] = None,
                  locks: Optional[dict] = None,
-                 idem: Optional[dict] = None):
+                 idem: Optional[dict] = None,
+                 admission=None):
         self.n_actors = n_actors
         self.data_dir = data_dir
+        #: overload probe: callable(kind: "write"|"read") -> None
+        #: (admitted) | retry_after_ms (shed). The serve layer's
+        #: AdmissionController.probe fits directly — the socket layer
+        #: then refuses with {busy, RetryAfterMs} BEFORE dispatching,
+        #: so bridge clients and in-process submitters see one coherent
+        #: overload picture (docs/SERVING.md)
+        self._admission = admission
         self._locks = locks  # BridgeServer-owned {name: lock-holder}
         #: BridgeServer-owned {scope: OrderedDict[reqid -> etf bytes]}
         #: — the idem dedup windows (durable stores scope by NAME so a
@@ -890,6 +898,28 @@ class _Conn:
         if not isinstance(req, tuple) or not req:
             return (etf.ERROR, Atom("badarg"), b"request must be a tuple")
         verb = req[0]
+        if (
+            self._admission is not None
+            and str(verb) not in ("start", "metrics", "health")
+        ):
+            # typed load shedding at the socket door: {busy, RetryAfterMs}
+            # — never a silent drop, never a half-executed request.
+            # Control verbs (start/metrics/health) always pass: an
+            # operator must be able to scrape an overloaded server.
+            kind = (
+                "write"
+                if str(verb) in _MUTATORS or str(verb) == "idem"
+                else "read"
+            )
+            retry_ms = self._admission(kind)
+            if retry_ms is not None:
+                counter(
+                    "bridge_busy_total",
+                    help="bridge requests refused with {busy, "
+                         "retry_after_ms} by admission control, by kind",
+                    kind=kind,
+                ).inc()
+                return (Atom("busy"), int(retry_ms))
         if verb == "idem":
             return self._handle_idem(req)
         if verb == "start":
@@ -1033,13 +1063,16 @@ class BridgeServer:
     a free port (read it from :attr:`port` after :meth:`start`)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 n_actors: int = 16, data_dir: Optional[str] = None):
+                 n_actors: int = 16, data_dir: Optional[str] = None,
+                 admission=None):
         self.host = host
         self.port = port
         self.n_actors = n_actors
         #: with a data_dir, {start, Name} opens a durable per-name store
         #: (the eleveldb per-partition persistence role)
         self.data_dir = data_dir
+        #: overload probe shared by every connection (see _Conn)
+        self.admission = admission
         self._store_locks: dict = {}
         self._idem_windows: dict = {}
         self._sock: Optional[socket.socket] = None
@@ -1078,7 +1111,7 @@ class BridgeServer:
 
     def _serve_conn(self, sock: socket.socket) -> None:
         state = _Conn(self.n_actors, self.data_dir, self._store_locks,
-                      self._idem_windows)
+                      self._idem_windows, admission=self.admission)
         try:
             with sock:
                 while not self._stop.is_set():
@@ -1190,24 +1223,45 @@ class BridgeClient:
     are the caller's business. ``retries`` bounds the extra attempts,
     ``backoff`` seeds the exponential delay (jittered ×[1, 2)), and
     ``timeout`` doubles as the per-call socket deadline (override per
-    call via ``call(..., timeout=...)``)."""
+    call via ``call(..., timeout=...)``).
+
+    Overload: a server running admission control answers ``{busy,
+    RetryAfterMs}`` instead of executing. Idempotent verbs (and
+    idem-wrapped writes, which are at-most-once by the dedup window)
+    honor the hint with CAPPED, JITTERED backoff — sleep
+    ``min(RetryAfterMs/1000, busy_cap) × [1, 2)`` and retry within the
+    same attempt budget. Verbs that cannot safely retry surface a typed
+    :class:`~lasp_tpu.serve.OverloadError` carrying the retry-after
+    hint — the caller decides, nothing is silently dropped or blindly
+    replayed.
+
+    Thread safety: one request/response exchange owns the socket
+    end-to-end under a per-connection lock — two threads sharing a
+    client can no longer interleave their frames mid-verb and corrupt
+    the wire stream (tests/bridge/test_retry.py)."""
 
     #: verbs whose replay is observationally harmless (pure reads)
     IDEMPOTENT_VERBS = frozenset({"get", "read", "metrics", "health"})
 
     def __init__(self, host: str, port: int, timeout: float = 10.0,
                  retries: int = 2, backoff: float = 0.05,
-                 idem_writes: bool = True):
+                 idem_writes: bool = True, busy_cap: float = 1.0):
         self._host = host
         self._port = port
         self._timeout = timeout
         self._retries = max(0, int(retries))
         self._backoff = float(backoff)
+        #: ceiling (seconds) on one busy-reply backoff sleep
+        self._busy_cap = float(busy_cap)
         #: wrap update/bind in {idem, ReqId, _} so they retry safely
         self._idem_writes = bool(idem_writes)
         #: the session's {start, Name} frame, replayed on reconnect so a
         #: restarted durable server re-binds the same store
         self._session_frame: "bytes | None" = None
+        #: one exchange (send + matching recv) at a time: the single ETF
+        #: socket is a serial channel, and interleaved concurrent
+        #: callers would corrupt the stream mid-verb
+        self._io_lock = threading.Lock()
         self._sock = socket.create_connection((host, port), timeout=timeout)
 
     def _reconnect(self) -> None:
@@ -1224,6 +1278,15 @@ class BridgeClient:
             _send_frame(self._sock, self._session_frame)
             _recv_frame(self._sock)
 
+    @staticmethod
+    def _is_busy(resp: Any) -> bool:
+        return (
+            isinstance(resp, tuple)
+            and len(resp) == 2
+            and resp[0] == Atom("busy")
+            and isinstance(resp[1], int)
+        )
+
     def call(self, term: Any, *, idempotent: "bool | None" = None,
              timeout: "float | None" = None) -> Any:
         """One request/response exchange. ``idempotent=None`` (default)
@@ -1235,35 +1298,66 @@ class BridgeClient:
             idempotent = verb in self.IDEMPOTENT_VERBS
         attempts = 1 + (self._retries if idempotent else 0)
         last_exc: "Exception | None" = None
-        for attempt in range(attempts):
-            try:
-                if attempt:
-                    self._reconnect()
-                self._sock.settimeout(
-                    self._timeout if timeout is None else timeout
-                )
-                _send_frame(self._sock, etf.encode(term))
-                frame = _recv_frame(self._sock)
-                if frame is None:
-                    raise ConnectionError(
-                        "bridge server closed the connection"
+        with self._io_lock:
+            reconnect = False
+            for attempt in range(attempts):
+                try:
+                    if reconnect:
+                        self._reconnect()
+                        reconnect = False
+                    self._sock.settimeout(
+                        self._timeout if timeout is None else timeout
                     )
-                return etf.decode(frame)
-            except (ConnectionError, OSError) as exc:
-                last_exc = exc
-                if not idempotent:
-                    raise ConnectionError(
-                        f"bridge call {verb!r} failed ({exc}); "
-                        "non-idempotent verbs are never retried — the "
-                        "op's outcome is unknown, check server state "
-                        "and re-issue explicitly"
-                    ) from exc
-                if attempt + 1 < attempts:
-                    import random
-                    import time
+                    _send_frame(self._sock, etf.encode(term))
+                    frame = _recv_frame(self._sock)
+                    if frame is None:
+                        raise ConnectionError(
+                            "bridge server closed the connection"
+                        )
+                    resp = etf.decode(frame)
+                except (ConnectionError, OSError) as exc:
+                    last_exc = exc
+                    reconnect = True
+                    if not idempotent:
+                        raise ConnectionError(
+                            f"bridge call {verb!r} failed ({exc}); "
+                            "non-idempotent verbs are never retried — "
+                            "the op's outcome is unknown, check server "
+                            "state and re-issue explicitly"
+                        ) from exc
+                    if attempt + 1 < attempts:
+                        import random
+                        import time
 
-                    delay = self._backoff * (2 ** attempt)
-                    time.sleep(delay * (1.0 + random.random()))
+                        delay = self._backoff * (2 ** attempt)
+                        time.sleep(delay * (1.0 + random.random()))
+                    continue
+                if self._is_busy(resp):
+                    retry_ms = int(resp[1])
+                    if idempotent and attempt + 1 < attempts:
+                        # capped jittered backoff honoring the server's
+                        # hint; the connection itself is healthy — no
+                        # reconnect, no session replay
+                        import random
+                        import time
+
+                        delay = min(retry_ms / 1000.0, self._busy_cap)
+                        time.sleep(
+                            max(delay, self._backoff)
+                            * (1.0 + random.random())
+                        )
+                        continue
+                    from ..serve.requests import OverloadError
+
+                    raise OverloadError(
+                        f"bridge call {verb!r} shed by server admission "
+                        f"control (retry after {retry_ms}ms)"
+                        + ("" if idempotent else
+                           " — non-idempotent verbs are never blindly "
+                           "retried; honor retry_after_ms and re-issue"),
+                        retry_after_ms=retry_ms,
+                    )
+                return resp
         raise ConnectionError(
             f"bridge call {verb!r} failed after {attempts} attempts "
             f"({last_exc})"

@@ -338,15 +338,23 @@ class ChaosRuntime:
     def live_replicas(self) -> np.ndarray:
         return np.flatnonzero(~self.crashed)
 
-    def _reachable_live(self, coordinator: int) -> np.ndarray:
+    def _reachable_live(self, coordinator: int,
+                        rnd: "int | None" = None) -> np.ndarray:
         """``bool[R]``: live replicas the coordinator can actually REACH
-        over links alive under the CURRENT round's mask (chaos masks are
-        pair-symmetric, so this is undirected connectivity over the
-        neighbor table's live pairs). A quorum must come from here — a
-        host-side read spanning a partition would be a side channel that
-        'heals' through the very cut the nemesis installed."""
+        over links alive under the round-``rnd`` mask (default: the
+        CURRENT round; chaos masks are pair-symmetric, so this is
+        undirected connectivity over the neighbor table's live pairs).
+        A quorum must come from here — a host-side read spanning a
+        partition would be a side channel that 'heals' through the very
+        cut the nemesis installed. Callers acting BETWEEN rounds (the
+        serving front-end's write-ack replication) pass the last
+        EXECUTED round: the upcoming round's mask already isolates a
+        replica whose crash has not happened yet, which is one round in
+        the future of everything ``self.crashed`` reports."""
         live = ~self.crashed
-        mask = self.schedule.mask_at(self.round)
+        mask = self.schedule.mask_at(
+            self.round if rnd is None else int(rnd)
+        )
         nbrs = self.rt._host_neighbors
         if mask is None:
             return live

@@ -184,6 +184,32 @@ def cmd_quorum(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Serving-front-end soak: an open-loop simulated client fleet
+    (write+read+watch mix, Zipf-hot keys, optional composite nemesis +
+    overload burst) against the coalescing/vectorized front-end, with
+    the no-acked-write-lost invariant and threshold fan-out parity
+    asserted in-run (docs/SERVING.md)."""
+    from lasp_tpu.serve.harness import run_load
+    from lasp_tpu.telemetry import get_monitor
+
+    report = run_load(
+        n_replicas=args.replicas,
+        n_clients=args.clients,
+        ticks=args.ticks,
+        arrivals_per_tick=args.arrivals,
+        chaos=not args.no_chaos,
+        burst_at=args.ticks // 2 if args.burst > 1 else None,
+        burst_factor=args.burst,
+        seed=args.seed,
+        seed_watches=args.watches,
+        parity_thresholds=args.parity,
+    )
+    report["serve_health"] = get_monitor().health().get("serve")
+    print(json.dumps(report))
+    return 0
+
+
 def cmd_bench(args) -> int:
     import os
     import runpy
@@ -683,6 +709,32 @@ def main(argv=None) -> int:
     qu.add_argument("--no-replay", action="store_true",
                     help="skip the replay-determinism second run")
 
+    sv = sub.add_parser(
+        "serve",
+        help="serving-front-end soak: open-loop simulated clients "
+             "(Zipf keys, write+read+watch mix) through the coalescing "
+             "ingest + vectorized threshold fan-out, with admission "
+             "control, a composite nemesis, and the no-acked-write-lost "
+             "check (docs/SERVING.md)",
+    )
+    sv.add_argument("--replicas", type=int, default=32)
+    sv.add_argument("--clients", type=int, default=2000,
+                    help="simulated client fleet size")
+    sv.add_argument("--ticks", type=int, default=24,
+                    help="run length in serving cycles")
+    sv.add_argument("--arrivals", type=int, default=400,
+                    help="open-loop request arrivals per tick")
+    sv.add_argument("--burst", type=int, default=5,
+                    help="mid-run overload multiplier (1 = no burst)")
+    sv.add_argument("--watches", type=int, default=1000,
+                    help="standing threshold watches registered up front")
+    sv.add_argument("--parity", type=int, default=4096,
+                    help="post-run vectorized-vs-per-watch threshold "
+                         "parity size (0 = skip)")
+    sv.add_argument("--no-chaos", action="store_true",
+                    help="skip the composite nemesis")
+    sv.add_argument("--seed", type=int, default=7)
+
     scen = sub.add_parser("scenario", help="run a BASELINE eval config")
     # literal list (not the SCENARIOS registry): importing bench_scenarios
     # here would pull jax into every CLI invocation including --help;
@@ -693,7 +745,8 @@ def main(argv=None) -> int:
         choices=["adcounter_10m", "adcounter_6", "bridge_throughput",
                  "chaos_heal", "dataflow_chain", "frontier_sparse",
                  "gset_1k", "many_vars", "orset_100k", "packed_vs_dense",
-                 "partitioned_gossip", "pipeline_1m", "quorum_kv"],
+                 "partitioned_gossip", "pipeline_1m", "quorum_kv",
+                 "serve_load"],
     )
     scen.add_argument("--replicas", type=int, default=0,
                       help="override the population for sized scenarios")
@@ -785,6 +838,7 @@ def main(argv=None) -> int:
         "bench": cmd_bench,
         "chaos": cmd_chaos,
         "quorum": cmd_quorum,
+        "serve": cmd_serve,
         "scenario": cmd_scenario,
         "metrics": cmd_metrics,
         "top": cmd_top,

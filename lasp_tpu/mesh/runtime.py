@@ -123,6 +123,65 @@ class _CapacityWalk:
         return None
 
 
+class FusedBlockHandle:
+    """A dispatched-but-unsynced fused block (``begin_fused_steps``):
+    :meth:`finish` blocks on the device result and performs the round
+    bookkeeping ``fused_steps`` would have done inline. ``finish`` is
+    idempotent — the first call resolves, later calls replay the
+    result. The handle must be finished on the thread that began it
+    (spans nest thread-locally)."""
+
+    __slots__ = ("_rt", "_block", "_first_zero", "_timer", "_span",
+                 "_result", "_states_in")
+
+    def __init__(self, rt, block, first_zero, timer, sp, states_in):
+        self._rt = rt
+        self._block = block
+        self._first_zero = first_zero
+        self._timer = timer
+        self._span = sp
+        #: the pre-window states, held until the sync succeeds: with
+        #: donation OFF the documented contract is "keep pre-step state
+        #: across failures", and the window's output was already bound
+        #: to rt.states at dispatch — a failed sync must restore this
+        self._states_in = states_in
+        self._result: "int | None" = None
+
+    @property
+    def pending(self) -> bool:
+        return self._result is None
+
+    def finish(self) -> int:
+        if self._result is not None:
+            return self._result
+        rt = self._rt
+        try:
+            # device sync: block-side failures (OOM mid-window) land here
+            first_zero = int(np.asarray(self._first_zero))
+        except Exception as exc:
+            if not rt._donate_argnums():
+                # undonated inputs are intact: rebind them (the
+                # donate_steps=False recovery guarantee)
+                rt.states = self._states_in
+            else:
+                rt._poison_if_donated(exc)
+            raise
+        finally:
+            self._states_in = None
+            self._timer.__exit__()
+            self._span.__exit__(None, None, None)
+        t = self._timer
+        block = self._block
+        rt._frontier_after_opaque(first_zero >= 0)
+        rt.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
+        rt._record_rounds(block)  # fori always executes the whole block
+        rt._ledger_record_store("fused_block", t.elapsed, block,
+                                block=block)
+        rt._observe_opaque_block(block, first_zero >= 0, t.elapsed)
+        self._result = first_zero
+        return first_zero
+
+
 class ReplicatedRuntime:
     """Simulates ``n_replicas`` copies of a store + dataflow graph under a
     gossip topology, bulk-synchronously.
@@ -1895,12 +1954,7 @@ class ReplicatedRuntime:
             # device sync: errors land here
             return new_states, np.asarray(scalar)
         except Exception as exc:
-            if self._donate_argnums() and any(
-                getattr(leaf, "is_deleted", lambda: False)()
-                for state in self._states.values()
-                for leaf in jax.tree_util.tree_leaves(state)
-            ):
-                self._poisoned = f"{type(exc).__name__}: {str(exc)[:200]}"
+            self._poison_if_donated(exc)
             raise
 
     def _ensure_step(self) -> tuple:
@@ -2176,6 +2230,19 @@ class ReplicatedRuntime:
         step function (join idempotence + the triggers' inflation gate),
         rounds after the first zero are no-ops — running the remainder of
         the block is harmless."""
+        return self.begin_fused_steps(block, edge_mask).finish()
+
+    def begin_fused_steps(self, block: int, edge_mask=None):
+        """Dispatch a fused block WITHOUT blocking on its result: the
+        returned :class:`FusedBlockHandle`'s :meth:`~FusedBlockHandle.
+        finish` performs the device sync and all round bookkeeping.
+        Because jax dispatch is asynchronous, host work done between
+        ``begin`` and ``finish`` (the serving front-end's ingest drain —
+        dequeue, admission, interning, op grouping) OVERLAPS the
+        device-resident gossip window instead of alternating with it
+        (docs/SERVING.md). ``self.states`` is rebound to the block's
+        output futures immediately — device ops issued against them
+        simply queue behind the window."""
         tables = self._ensure_step()
         self._frontier_sync_mask(edge_mask)
         fn = self._fused_steps_cache.get(block)
@@ -2198,21 +2265,34 @@ class ReplicatedRuntime:
 
             fn = jax.jit(fused, donate_argnums=self._donate_argnums())
             self._fused_steps_cache[block] = fn
-        with span("gossip.round", annotate=True, block=block):
-            with Timer() as t:
-                # _run_step_fn syncs on first_zero, closing the timing
-                # window
-                self.states, first_zero = self._run_step_fn(
-                    fn, edge_mask, tables
-                )
-        first_zero = int(first_zero)
-        self._frontier_after_opaque(first_zero >= 0)
-        self.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
-        self._record_rounds(block)  # fori always executes the whole block
-        self._ledger_record_store("fused_block", t.elapsed, block,
-                                  block=block)
-        self._observe_opaque_block(block, first_zero >= 0, t.elapsed)
-        return first_zero
+        sp = span("gossip.round", annotate=True, block=block)
+        sp.__enter__()
+        t = Timer()
+        t.__enter__()
+        states_in = self.states  # property read: raises if poisoned
+        try:
+            new_states, first_zero = fn(
+                states_in, self.neighbors, edge_mask, tables
+            )
+        except Exception as exc:
+            t.__exit__()
+            sp.__exit__(None, None, None)
+            self._poison_if_donated(exc)
+            raise
+        self.states = new_states
+        return FusedBlockHandle(self, block, first_zero, t, sp, states_in)
+
+    def _poison_if_donated(self, exc: Exception) -> None:
+        """Shared failure rule of every donating dispatch (sync or
+        deferred): the runtime is poisoned only if donation actually
+        consumed the input buffers — trace/compile-time errors leave
+        state intact and recoverable."""
+        if self._donate_argnums() and any(
+            getattr(leaf, "is_deleted", lambda: False)()
+            for state in self._states.values()
+            for leaf in jax.tree_util.tree_leaves(state)
+        ):
+            self._poisoned = f"{type(exc).__name__}: {str(exc)[:200]}"
 
     def _observe_opaque_block(self, rounds: int, quiescent: "bool | None",
                               elapsed: float) -> None:

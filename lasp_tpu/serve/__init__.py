@@ -1,0 +1,57 @@
+"""Serving front-end: overload-hardened ingestion for a replicated
+population (ROADMAP open item 3).
+
+- :mod:`.requests` — typed request tickets; every outcome is a typed
+  terminal status (``done`` / ``error`` / ``shed`` / ``expired``),
+  never a silent drop; :class:`OverloadError` for callers that cannot
+  retry.
+- :mod:`.admission` — bounded per-class queues, ``{busy,
+  retry_after_ms}`` load shedding, and the degradation ladder (shed
+  low-priority reads → widen coalescing → reject writes).
+- :mod:`.subscriptions` — registered threshold-reads / ``wait_needed``
+  watches evaluated as ONE vectorized pass over a subscription tensor
+  (per-codec kernels, fire-exactly-once).
+- :mod:`.engine` — :class:`ServeFrontend`: coalescing ingest into
+  ``update_batch`` megabatches (bit-identical to sequential
+  application), deadline propagation, W=2 ack replication, and the
+  async cycle overlapping device gossip windows with host ingest;
+  :class:`ServeLoop` for a live background driver.
+- :mod:`.harness` — the open-loop load harness behind
+  ``tools/load_harness.py`` and the ``serve_load`` bench scenario.
+
+See docs/SERVING.md for the admission/backpressure contract, deadline
+semantics, and the degradation ladder.
+"""
+
+from .admission import AdmissionController, BoundedQueue, LADDER
+from .engine import ServeFrontend, ServeLoop
+from .requests import (
+    KINDS,
+    OverloadError,
+    PRIO_HIGH,
+    PRIO_LOW,
+    PRIO_NORMAL,
+    Ticket,
+    READ,
+    WATCH,
+    WRITE,
+)
+from .subscriptions import SubscriptionTable
+
+__all__ = [
+    "AdmissionController",
+    "BoundedQueue",
+    "KINDS",
+    "LADDER",
+    "OverloadError",
+    "PRIO_HIGH",
+    "PRIO_LOW",
+    "PRIO_NORMAL",
+    "READ",
+    "ServeFrontend",
+    "ServeLoop",
+    "SubscriptionTable",
+    "Ticket",
+    "WATCH",
+    "WRITE",
+]

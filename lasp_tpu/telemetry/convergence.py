@@ -96,6 +96,10 @@ class ConvergenceMonitor:
         #: repair/push traffic, hint-log state — quorum.QuorumRuntime.
         #: report); empty until a quorum engine runs
         self.quorum: dict = {}
+        #: latest serving front-end report (offered/completed/shed
+        #: counts, parked watches, degradation-ladder level —
+        #: serve.ServeFrontend.report); empty until a front-end reports
+        self.serve: dict = {}
         self._tel: "dict | None" = None
 
     def _check_generation(self) -> None:
@@ -210,6 +214,18 @@ class ConvergenceMonitor:
             self._check_generation()
             self.quorum.update(report)
             self.quorum["round"] = self.round
+
+    def observe_serve(self, **report) -> None:
+        """Fold a serving front-end's accounting into the health
+        surface — offered/completed/shed/expired counts, parked
+        watches, and the degradation-ladder level from
+        ``serve.ServeFrontend.report`` land under the snapshot's
+        ``serve`` key (the ``{health}`` verb and ``lasp_tpu top`` read
+        it alongside ``chaos`` and ``quorum``)."""
+        with self._lock:
+            self._check_generation()
+            self.serve.update(report)
+            self.serve["round"] = self.round
 
     def observe_membership(self, kind: str, old_n: int, new_n: int) -> None:
         with self._lock:
@@ -480,6 +496,7 @@ class ConvergenceMonitor:
                 "frontier_by_var": dict(self.frontier),
                 "chaos": dict(self.chaos),
                 "quorum": dict(self.quorum),
+                "serve": dict(self.serve),
                 "residual_curve": curve[-64:],
                 "memberships": list(self.memberships),
                 "probe": self.last_probe,

@@ -61,6 +61,7 @@ EVENT_TYPES = frozenset({
     "frontier_skip",   # dirty-set scheduling skipped vars/edges outright
     "chaos",           # fault injected/healed, crash/restore, degraded read
     "quorum",          # quorum FSM round summary / hinted handoff replay
+    "serve",           # one serving-cycle summary (writes/reads/fires/shed)
 })
 
 _lock = threading.Lock()

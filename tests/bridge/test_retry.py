@@ -196,6 +196,147 @@ def test_explicit_idempotent_override_retries_update(tmp_path):
         server.stop()
 
 
+class _ShedFirst:
+    """Admission probe shedding the first ``n`` requests with a
+    retry-after hint, then admitting everything."""
+
+    def __init__(self, n, retry_ms=25):
+        self.n = n
+        self.retry_ms = retry_ms
+        self.seen = 0
+
+    def __call__(self, kind):
+        self.seen += 1
+        return self.retry_ms if self.seen <= self.n else None
+
+
+def test_busy_reply_backs_off_and_retries_idempotent_reads():
+    """The server's {busy, RetryAfterMs} on an overloaded read: the
+    client honors the hint (capped jittered backoff), retries on the
+    SAME healthy connection, and succeeds once admission clears."""
+    shed = _ShedFirst(0)
+    server = BridgeServer(port=0, admission=shed)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=4,
+                         backoff=0.01)
+        assert c.start("s")[0] == Atom("ok")
+        c.declare(b"v", "lasp_gset", n_elems=8)
+        shed.n, shed.seen = 2, 0  # now shed the next two requests
+        t0 = time.time()
+        resp = c.get(b"v")
+        assert resp[0] == Atom("ok")
+        assert shed.seen == 3  # 2 sheds + the admitted retry
+        assert time.time() - t0 >= 0.02  # it actually backed off
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_idem_wrapped_write_retries_through_busy_exactly_once():
+    """update/bind ride the idempotent path via {idem, ReqId, _}: a
+    busy reply backs off and retries, and the dedup window keeps the
+    eventually-admitted write at-most-once."""
+    shed = _ShedFirst(0)
+    server = BridgeServer(port=0, admission=shed)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=4,
+                         backoff=0.01)
+        assert c.start("s")[0] == Atom("ok")
+        c.declare(b"v", "riak_dt_gcounter")
+        shed.n, shed.seen = 1, 0  # shed the next write once
+        ok, value = c.update(b"v", (Atom("increment"),), b"w")
+        assert ok == Atom("ok") and value == 1
+        assert c.read(b"v") == (Atom("ok"), 1)  # applied exactly once
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_non_idempotent_busy_surfaces_typed_overload_error():
+    """With idem_writes off there is no safe replay: a shed write must
+    surface a typed OverloadError carrying the retry-after hint, never
+    blind-retry and never silently drop."""
+    from lasp_tpu.serve import OverloadError
+
+    server = BridgeServer(port=0, admission=lambda kind: 150)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=3,
+                         backoff=0.01, idem_writes=False)
+        assert c.start("s")[0] == Atom("ok")  # control verbs always pass
+        with pytest.raises(OverloadError) as exc:
+            c.update(b"v", (Atom("increment"),), b"w")
+        assert exc.value.retry_after_ms == 150
+        # merge_batch is fail-fast too (its replay is the caller's call)
+        with pytest.raises(OverloadError):
+            c.merge_batch([(b"v", [])])
+        # an idempotent read that stays shed through every attempt also
+        # ends in the typed error, not a silent give-up
+        with pytest.raises(OverloadError):
+            c.call((Atom("keys"),))
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_metrics_and_health_bypass_admission():
+    server = BridgeServer(port=0, admission=lambda kind: 500)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=5.0, retries=0)
+        ok, payload = c.metrics()
+        assert ok == Atom("ok")
+        ok, _health = c.health()
+        assert ok == Atom("ok")
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_concurrent_callers_share_one_socket_without_corruption():
+    """The satellite bugfix: two threads sharing one BridgeClient used
+    to interleave their frames mid-verb and corrupt the wire stream.
+    The per-connection lock serializes exchanges; every caller gets
+    its own well-formed answer."""
+    import threading
+
+    server = BridgeServer(port=0)
+    port = server.start()
+    try:
+        c = BridgeClient("127.0.0.1", port, timeout=10.0)
+        assert c.start("s")[0] == Atom("ok")
+        c.declare(b"v", "riak_dt_gcounter", n_actors=32)
+        errors: list = []
+
+        def worker(w):
+            try:
+                for i in range(40):
+                    ok, _val = c.update(
+                        b"v", (Atom("increment"),), f"w{w}".encode()
+                    )
+                    assert ok == Atom("ok")
+                    ok, total = c.read(b"v")
+                    assert ok == Atom("ok")
+                    assert isinstance(total, int) and total >= i + 1
+            except Exception as exc:  # surfaced after join
+                errors.append(f"{type(exc).__name__}: {exc}")
+
+        threads = [
+            threading.Thread(target=worker, args=(w,)) for w in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert c.read(b"v") == (Atom("ok"), 160)
+        c.close()
+    finally:
+        server.stop()
+
+
 def test_per_call_timeout_applies():
     """The per-call timeout reaches the socket: a server that accepts
     but never answers trips the deadline instead of hanging."""

@@ -30,6 +30,7 @@ def test_scenario_registry_complete():
         "dataflow_chain",
         "quorum_kv",
         "chaos_heal",
+        "serve_load",
     }
 
 
@@ -218,3 +219,34 @@ def test_quorum_kv_small():
         assert rep["repair_wire_bytes"] >= 0
     # rolling-crash restores replicas: the hinted-handoff path ran
     assert out["presets"]["rolling-crash"]["hint_replays"] > 0
+
+
+def test_serve_load_small():
+    """The serve_load artifact shape: offered/admitted/completed rates,
+    the typed shed breakdown, queue high-water marks, ladder
+    transitions, per-class latency percentiles, and the two in-scenario
+    assertions (no-acked-write-lost + threshold fan-out parity) — on
+    every backend."""
+    from lasp_tpu.bench_scenarios import serve_load
+
+    out = serve_load(n_replicas=16, n_clients=300, ticks=10,
+                     arrivals_per_tick=60, seed_watches=80,
+                     parity_thresholds=1024)
+    assert out["scenario"] == "serve_load_16"
+    assert out["no_write_lost"] is True
+    assert out["threshold_parity"]["parity"] is True
+    assert out["chaos"]["healed"]
+    for key in ("offered_per_tick", "admitted_per_tick",
+                "completed_per_tick", "admit_frac", "complete_frac"):
+        assert out["rates"][key] >= 0
+    assert set(out["queue_high_water"]) == {"write", "read", "watch"}
+    assert out["latency_ticks"]["write"]["p99"] is not None
+    assert out["max_inflight"] >= 80  # the standing-watch floor
+    # the shed breakdown is typed kind:reason pairs (may be empty at
+    # this scale); accounting never loses a request
+    offered = sum(out["offered"].values())
+    terminal = (
+        sum(out["completed"].values()) + sum(out["errors"].values())
+        + sum(out["expired"].values()) + sum(out["shed"].values())
+    )
+    assert offered == terminal + out["watch_parked_final"]

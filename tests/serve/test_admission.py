@@ -1,0 +1,136 @@
+"""Admission control: bounded queues, typed shedding, and the
+degradation ladder's transitions (docs/SERVING.md)."""
+
+import pytest
+
+from lasp_tpu.serve import AdmissionController, BoundedQueue, LADDER
+from lasp_tpu.serve import requests as rq
+
+
+def _ticket(kind, priority=rq.PRIO_NORMAL):
+    return rq.Ticket(kind, "v", priority=priority)
+
+
+def test_bounded_queue_refuses_at_capacity_and_tracks_high_water():
+    q = BoundedQueue(3)
+    assert all(q.offer(i) for i in range(3))
+    assert not q.offer(99)  # full: refuse, never block or drop silently
+    assert q.depth == 3 and q.high_water == 3
+    assert q.drain(2) == [0, 1]
+    assert q.drain(None) == [2]
+    assert q.depth == 0 and q.high_water == 3  # high water sticks
+
+
+def test_window_high_survives_a_full_drain():
+    """The ladder's pressure signal is the intra-cycle high-water mark:
+    a burst fully absorbed by the drain must still read as pressure."""
+    q = BoundedQueue(4)
+    for i in range(4):
+        q.offer(i)
+    q.drain(None)
+    assert q.take_window() == 4  # saw a full queue since last window
+    assert q.take_window() == 0  # reset to the (empty) current depth
+
+
+def test_admit_and_queue_full_shed():
+    ac = AdmissionController(capacity={"write": 2, "read": 2, "watch": 2})
+    assert ac.admit(_ticket(rq.WRITE)) is None
+    assert ac.admit(_ticket(rq.WRITE)) is None
+    reason, retry_ms = ac.admit(_ticket(rq.WRITE))
+    assert reason == "queue_full" and retry_ms >= ac.min_retry_ms
+    # other classes are independently bounded
+    assert ac.admit(_ticket(rq.READ)) is None
+
+
+def test_ladder_climbs_immediately_and_descends_with_hysteresis():
+    ac = AdmissionController(
+        capacity={"write": 10, "read": 10, "watch": 10},
+        enter=(0.5, 0.75, 0.9), exit=(0.3, 0.5, 0.7),
+        hysteresis_cycles=2,
+    )
+    for _ in range(10):
+        ac.queues["write"].offer(object())
+    assert ac.observe_cycle(0.01, 0) == 3  # straight to reject_writes
+    assert LADDER[ac.level] == "reject_writes"
+    ac.queues["write"].drain(None)
+    # descent is one rung at a time, only after sustained calm: the
+    # window residue of the full cycle still reads as pressure once,
+    # then two calm cycles per rung
+    assert ac.observe_cycle(0.01, 10) == 3
+    assert ac.observe_cycle(0.01, 0) == 3
+    assert ac.observe_cycle(0.01, 0) == 2
+    assert ac.observe_cycle(0.01, 0) == 2
+    assert ac.observe_cycle(0.01, 0) == 1
+    # the transition log records every move
+    levels = [(old, new) for _c, old, new, _p in ac.transitions]
+    assert levels == [(0, 3), (3, 2), (2, 1)]
+
+
+def test_rung1_sheds_low_priority_reads_only():
+    ac = AdmissionController(capacity={"write": 10, "read": 10, "watch": 10})
+    for _ in range(6):
+        ac.queues["read"].offer(object())
+    assert ac.observe_cycle(0.01, 0) == 1
+    refusal = ac.admit(_ticket(rq.READ, priority=rq.PRIO_LOW))
+    assert refusal is not None and refusal[0] == "shed_low_priority"
+    assert ac.admit(_ticket(rq.READ, priority=rq.PRIO_NORMAL)) is None
+    assert ac.admit(_ticket(rq.WRITE)) is None  # writes unaffected
+
+
+def test_rung3_rejects_writes_but_serves_reads():
+    ac = AdmissionController(capacity={"write": 4, "read": 10, "watch": 10})
+    for _ in range(4):
+        ac.queues["write"].offer(object())
+    assert ac.observe_cycle(0.01, 0) == 3
+    ac.queues["write"].drain(None)
+    refusal = ac.admit(_ticket(rq.WRITE))
+    assert refusal is not None and refusal[0] == "writes_rejected"
+    assert ac.admit(_ticket(rq.READ)) is None  # readers still served
+
+
+def test_coalesce_multiplier_widens_at_rung2():
+    ac = AdmissionController(capacity={"write": 10, "read": 10, "watch": 10},
+                             widen_factor=8)
+    assert ac.coalesce_multiplier() == 1
+    for _ in range(8):
+        ac.queues["write"].offer(object())
+    ac.observe_cycle(0.01, 0)
+    assert ac.level >= 2
+    assert ac.coalesce_multiplier() == 8
+
+
+def test_retry_after_tracks_backlog_and_drain_rate():
+    ac = AdmissionController(capacity={"write": 100, "read": 10, "watch": 10},
+                             min_retry_ms=5, max_retry_ms=2000)
+    # no drain rate yet: worst-case hint
+    assert ac.retry_after_ms("write") == 2000
+    # 50 drained in 0.1s => 500/s; 20 queued => ~40ms
+    ac.observe_cycle(0.1, 50)
+    for _ in range(20):
+        ac.queues["write"].offer(object())
+    est = ac.retry_after_ms("write")
+    assert 5 <= est <= 2000
+    assert 20 <= est <= 100  # ballpark of depth/rate
+
+
+def test_probe_is_the_bridge_door():
+    ac = AdmissionController(capacity={"write": 1, "read": 1, "watch": 1})
+    assert ac.probe("write") is None
+    ac.queues["write"].offer(object())
+    assert isinstance(ac.probe("write"), int)
+    assert ac.probe("read") is None
+
+
+def test_config_validation_is_loud():
+    with pytest.raises(TypeError):
+        AdmissionController(capacity={"writes": 1})  # typo'd class
+    with pytest.raises(ValueError):
+        AdmissionController(enter=(0.5, 0.7, 0.9), exit=(0.6, 0.5, 0.7))
+
+
+def test_ticket_terminal_transitions_are_exactly_once():
+    t = _ticket(rq.WRITE)
+    assert t.complete("r", 1.0)
+    assert not t.fail("nope", 2.0)  # first terminal wins
+    assert t.status == "done" and t.result == "r"
+    assert t.latency() == 1.0

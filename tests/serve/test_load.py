@@ -1,0 +1,110 @@
+"""Open-loop load harness: overload sheds typed, deadlines cancel,
+chaos + burst stay correct (no acked write lost), and the serve_load
+artifact keeps its interpretable shape."""
+
+import pytest
+
+from lasp_tpu.serve.harness import composite_nemesis, run_load
+
+
+def test_small_run_steady_state():
+    rep = run_load(n_replicas=12, n_clients=200, ticks=6, n_vars=3,
+                   arrivals_per_tick=50, seed=3, seed_watches=40)
+    assert rep["no_write_lost"] is True
+    offered = sum(rep["offered"].values())
+    terminal = (
+        sum(rep["completed"].values()) + sum(rep["errors"].values())
+        + sum(rep["expired"].values()) + sum(rep["shed"].values())
+    )
+    # never a silent drop: every offered request reaches a typed
+    # terminal outcome (standing watches may stay parked past the run)
+    assert offered == terminal + rep["watch_parked_final"]
+    assert rep["rates"]["offered_per_tick"] == pytest.approx(
+        offered / 6, abs=0.01
+    )
+    assert rep["latency_ticks"]["write"]["p50"] is not None
+    assert rep["acked_writes"] > 0
+    assert rep["max_inflight"] >= 40  # the standing watch floor
+
+
+def test_burst_sheds_typed_and_ladder_climbs():
+    rep = run_load(
+        n_replicas=12, n_clients=200, ticks=9, n_vars=3,
+        arrivals_per_tick=70,
+        capacity={"write": 128, "read": 128, "watch": 128},
+        burst_at=3, burst_ticks=3, burst_factor=6, seed=5,
+    )
+    assert sum(rep["shed"].values()) > 0  # overload shed something
+    assert all(":" in k for k in rep["shed"])  # typed (kind:reason)
+    assert rep["ladder"]["max_level"] >= 1
+    assert rep["client_retries"] > 0  # clients honored retry_after_ms
+    # bounded queues: the high-water marks never exceed capacity
+    assert all(hw <= 128 for hw in rep["queue_high_water"].values())
+    assert rep["no_write_lost"] is True
+
+
+def test_chaos_run_keeps_acked_writes_and_heals():
+    rep = run_load(n_replicas=16, n_clients=150, ticks=13, n_vars=3,
+                   arrivals_per_tick=40, chaos=True, seed=9,
+                   parity_thresholds=512)
+    assert rep["no_write_lost"] is True
+    assert rep["chaos"]["healed"] and rep["chaos"]["crashes"] == 2
+    assert rep["threshold_parity"]["parity"] is True
+
+
+def test_composite_nemesis_shape():
+    from lasp_tpu.chaos import Crash, Restore
+    from lasp_tpu.mesh.topology import random_regular
+
+    nbrs = random_regular(24, 3, seed=2)
+    sched = composite_nemesis(24, nbrs, seed=2, rounds=12)
+    crashes = [e for e in sched.events if isinstance(e, Crash)]
+    restores = [e for e in sched.events if isinstance(e, Restore)]
+    assert len(crashes) == 2 and len(restores) == 2
+    # victims non-adjacent (the W=2 durability precondition)
+    v = sorted(c.replica for c in crashes)
+    gap = (v[1] - v[0]) % 24
+    assert gap not in (1, 23)
+    # staggered: each restore lands before the next crash
+    assert crashes[1].at > restores[0].at
+    # crashes land in link-clean rounds (after the fault windows close)
+    link_stop = max(e.stop for e in sched.events
+                    if hasattr(e, "stop"))
+    assert all(c.at >= link_stop + 2 for c in crashes)
+
+
+def test_deadlines_expire_under_pressure():
+    rep = run_load(
+        n_replicas=12, n_clients=100, ticks=8, n_vars=3,
+        arrivals_per_tick=60,
+        capacity={"write": 64, "read": 64, "watch": 64},
+        burst_at=3, burst_ticks=4, burst_factor=8,
+        deadline_ticks=2, seed=11,
+    )
+    # with 2-tick deadlines under an 8x burst, some queued work expired
+    # and was cancelled instead of executed
+    assert sum(rep["expired"].values()) > 0
+    assert rep["no_write_lost"] is True
+
+
+@pytest.mark.slow
+def test_acceptance_scale_10k_clients_burst_chaos():
+    """The acceptance gate at full scale: >= 10k concurrent simulated
+    clients (write+read+watch mix, gossip concurrent), composite
+    nemesis + 5x overload burst — typed sheds with retry-after
+    accounting, bounded queues, p50/p99 reported, zero acked writes
+    lost, and 100k-threshold vectorized parity."""
+    rep = run_load(
+        n_replicas=64, n_clients=10_000, ticks=40,
+        arrivals_per_tick=1200, chaos=True,
+        burst_at=20, burst_ticks=5, burst_factor=5,
+        seed_watches=10_000, parity_thresholds=100_000, seed=7,
+    )
+    assert rep["max_inflight"] >= 10_000
+    assert rep["no_write_lost"] is True
+    assert rep["threshold_parity"]["parity"] is True
+    assert rep["threshold_parity"]["n_thresholds"] >= 100_000
+    assert sum(rep["shed"].values()) > 0
+    assert rep["latency_ticks"]["write"]["p99"] is not None
+    caps = rep["queue_high_water"]
+    assert all(hw <= 8192 for hw in caps.values())

@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Open-loop serving load harness (CLI wrapper over
+``lasp_tpu.serve.harness.run_load`` — see docs/SERVING.md "Load
+harness").
+
+Drives an open-loop simulated client fleet (sustained write+read+watch
+mix, Zipf-hot keys, shed-honoring retry clients) through the serving
+front-end while gossip runs concurrently — optionally under a
+composite chaos nemesis and a mid-run overload burst — and prints the
+JSON report: offered vs admitted vs completed rates, typed
+shed/retry-after accounting, deadline-expired cancellations, queue
+high-water marks, degradation-ladder transitions, p50/p99 latency per
+request class, the no-acked-write-lost verdict, and (with --parity)
+vectorized-vs-per-watch threshold parity.
+
+The acceptance-scale run (10k concurrent clients, 5x burst, composite
+nemesis, 100k-threshold parity — the serve_load bench scenario's
+shape):
+
+    python tools/load_harness.py --clients 10000 --ticks 40 \\
+        --arrivals 1200 --burst 5 --chaos --watches 10000 \\
+        --parity 100000
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    p.add_argument("--replicas", type=int, default=64)
+    p.add_argument("--fanout", type=int, default=3)
+    p.add_argument("--vars", type=int, default=6)
+    p.add_argument("--clients", type=int, default=10_000,
+                   help="simulated client fleet size")
+    p.add_argument("--ticks", type=int, default=40,
+                   help="run length in serving cycles")
+    p.add_argument("--arrivals", type=int, default=1200,
+                   help="open-loop arrivals per tick (before burst)")
+    p.add_argument("--zipf", type=float, default=1.1,
+                   help="Zipf skew of the key distribution")
+    p.add_argument("--burst", type=int, default=1,
+                   help="mid-run overload multiplier (1 = none)")
+    p.add_argument("--burst-ticks", type=int, default=6)
+    p.add_argument("--chaos", action="store_true",
+                   help="run the composite nemesis concurrently")
+    p.add_argument("--watches", type=int, default=0,
+                   help="standing threshold watches registered up front")
+    p.add_argument("--parity", type=int, default=0,
+                   help="post-run threshold-parity size (0 = skip)")
+    p.add_argument("--deadline", type=int, default=30,
+                   help="read/watch deadline in ticks")
+    p.add_argument("--gossip-block", type=int, default=4)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--write-cap", type=int, default=8192)
+    p.add_argument("--read-cap", type=int, default=8192)
+    p.add_argument("--watch-cap", type=int, default=8192)
+    args = p.parse_args(argv)
+
+    from lasp_tpu.serve.harness import run_load
+
+    report = run_load(
+        n_replicas=args.replicas,
+        fanout=args.fanout,
+        n_vars=args.vars,
+        n_clients=args.clients,
+        ticks=args.ticks,
+        arrivals_per_tick=args.arrivals,
+        zipf_s=args.zipf,
+        seed=args.seed,
+        chaos=args.chaos,
+        burst_at=args.ticks // 2 if args.burst > 1 else None,
+        burst_ticks=args.burst_ticks,
+        burst_factor=args.burst,
+        deadline_ticks=args.deadline,
+        capacity={"write": args.write_cap, "read": args.read_cap,
+                  "watch": args.watch_cap},
+        gossip_block=args.gossip_block,
+        parity_thresholds=args.parity,
+        seed_watches=args.watches,
+    )
+    print(json.dumps(report, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
