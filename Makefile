@@ -40,7 +40,10 @@ test-slow:
 # (docs/RESILIENCE.md "Quorum coordination"), a serve smoke guards the
 # serving front-end's coalesced-vs-sequential bit-identity, vectorized
 # watch fan-out parity, and typed shed accounting under forced
-# overload (docs/SERVING.md),
+# overload (docs/SERVING.md), an AAE smoke guards the corruption
+# drill end-to-end — inject -> detect -> localize -> repair ->
+# bit-equal across three codecs x both corruption presets plus
+# aae_* metric liveness (docs/RESILIENCE.md "Active anti-entropy"),
 # then the non-slow tests run (the tier-1 shape)
 verify:
 	python tools/check_metrics_catalog.py
@@ -52,6 +55,7 @@ verify:
 	python tools/dataflow_fusion_smoke.py
 	python tools/quorum_smoke.py
 	python tools/serve_smoke.py
+	python tools/aae_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

@@ -511,6 +511,19 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["quorum_kv"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- active anti-entropy arm (~seconds): silent corruption (bit-rot
+    # + CorruptRows overlays on every nemesis preset) against the
+    # Merkle-hash-forest scrubber; records detection latency in rounds,
+    # repair wire bytes vs a full-state resync, and the incremental-vs-
+    # full rehash cost, with detection/localization/repair and twin
+    # bit-equality asserted inside the scenario ----------------------------
+    try:
+        from lasp_tpu.bench_scenarios import aae_scrub
+
+        detail["aae_scrub"] = aae_scrub()
+    except Exception as exc:
+        detail["aae_scrub"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- serving front-end arm (~a minute): 10k-client open-loop load
     # (Zipf-hot write+read+watch mix) through the coalescing ingest +
     # vectorized threshold fan-out, composite nemesis + 5x overload

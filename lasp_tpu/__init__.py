@@ -10,6 +10,9 @@ Layer map (mirrors SURVEY.md §1, redesigned per §7):
 - ``lasp_tpu.mesh``    — replication/gossip/quorum over device meshes (L2/L3)
 - ``lasp_tpu.quorum``  — batched request-coordination FSMs, hinted
   handoff, ring-coverage queries (the reference's 18 gen_fsm layer, L3)
+- ``lasp_tpu.aae``     — active anti-entropy: vectorized Merkle
+  hashtrees, pairwise tree exchange, targeted quorum repair (riak_kv
+  AAE's role)
 - ``lasp_tpu.serve``   — overload-hardened serving front-end: coalescing
   ingest, vectorized threshold fan-out, admission + backpressure
 - ``lasp_tpu.api``     — the public Lasp verb set (L4)
@@ -28,8 +31,9 @@ __version__ = "0.1.0"
 # server parent, bench.py's never-import-jax parent) need the namespace
 # without paying jax's import cost or risking any backend touch.
 _SUBMODULES = frozenset({
-    "api", "bridge", "chaos", "config", "dataflow", "lattice", "mesh",
-    "ops", "programs", "quorum", "serve", "store", "telemetry", "utils",
+    "aae", "api", "bridge", "chaos", "config", "dataflow", "lattice",
+    "mesh", "ops", "programs", "quorum", "serve", "store", "telemetry",
+    "utils",
 })
 _ATTRS = {
     "Session": ("api", "Session"),
@@ -55,6 +59,7 @@ def __dir__():
 __all__ = [
     "LaspConfig",
     "Session",
+    "aae",
     "api",
     "bridge",
     "chaos",

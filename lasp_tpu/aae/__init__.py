@@ -1,0 +1,42 @@
+"""Active anti-entropy: vectorized Merkle hashtrees, pairwise tree
+exchange, and targeted quorum repair — the last robustness layer of the
+reference's Dynamo lineage (riak_kv AAE) reproduced on the tensor mesh.
+
+Three pieces (docs/RESILIENCE.md "Active anti-entropy"):
+
+- :mod:`.hashtree` — per-replica Merkle trees over each codec's wire
+  leaves: one vmapped hash kernel per dispatch-plan group with a
+  log-depth on-device reduction, incrementally rehashed from the
+  runtime's dirty bookkeeping (quiescent vars and clean segments cost
+  nothing);
+- :mod:`.exchange` — pairwise root -> segment -> leaf tree walks,
+  hypercube-paired within the chaos mask's reachable components,
+  yielding exact divergent (var, row) sets;
+- :mod:`.repair` — divergence repairs by bidirectional partial joins;
+  non-inflationary corruption (self-hash mismatch, or a join "fixed
+  point" that still diverges) escalates to a quorum-read with
+  authoritative overwrite and an incident record. :class:`AAEScrubber`
+  is the driver.
+
+Surfaces: ``Session.aae``, the ``lasp_tpu aae`` CLI verb, the
+``aae_scrub`` bench scenario, ``tools/aae_smoke.py`` in ``make
+verify``, a background scrub hook in the serving front-end
+(``ServeFrontend(aae=...)``), and the
+``check_corruption_detected_and_repaired`` chaos invariant
+(``chaos.invariants.run_aae_harness``).
+"""
+
+from .exchange import exchange_pair, sweep
+from .hashtree import HashForest, group_row_hashes, row_hashes, subset_row_hashes
+from .repair import AAEScrubber, overwrite_row
+
+__all__ = [
+    "AAEScrubber",
+    "HashForest",
+    "exchange_pair",
+    "group_row_hashes",
+    "overwrite_row",
+    "row_hashes",
+    "subset_row_hashes",
+    "sweep",
+]

@@ -1,0 +1,141 @@
+"""Pairwise replica tree exchange — divergence detection and
+localization in O(log R · segments) hash comparisons.
+
+riak_kv's AAE exchange (``riak_kv_exchange_fsm``) walks two replicas'
+hashtrees top-down: compare roots, descend into differing buckets,
+yield the exact diverging keys — never reading whole objects. The
+tensorized twin:
+
+- :func:`exchange_pair` walks ONE replica pair's trees (columns of the
+  forest's leaf/segment/root matrices): root -> divergent segments ->
+  divergent leaves, returning the exact divergent variable set. Cost is
+  counted in hash COMPARISONS — the wire unit an out-of-process
+  deployment would pay (roots first, then only the differing segments'
+  children).
+- :func:`sweep` runs one anti-entropy sweep over the whole population:
+  replicas pair hypercube-style (stride 1, 2, 4, ... within their
+  component's member ring), so a component of m replicas needs
+  ceil(log2 m) pairing rounds to transitively cover every member — and
+  the stride-1 round alone proves component-wide agreement when no
+  pair diverges (adjacent equality around a ring is transitive), which
+  is the early exit that makes a converged population's sweep cost one
+  root comparison per replica.
+
+Confinement: pairing never crosses the chaos edge mask — pairs draw
+from the connected components of the live-link graph
+(``quorum.fsm.components``, the PR-9 labeling shared by the quorum
+layer), because an exchange through a partition would be a host-side
+side channel healing the very cut the nemesis installed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..telemetry import counter, span
+
+
+def exchange_pair(forest, a: int, b: int) -> dict:
+    """Walk replicas ``a`` and ``b``'s trees; returns ``{"divergent":
+    [var_id, ...], "comparisons": int}`` (empty divergent list when the
+    roots agree — the 1-comparison fast path)."""
+    comparisons = 1
+    if forest.roots[a] == forest.roots[b]:
+        return {"divergent": [], "comparisons": comparisons}
+    seg = forest.segmat
+    diff_segs = np.flatnonzero(seg[:, a] != seg[:, b])
+    comparisons += int(seg.shape[0])
+    leaf = forest.leaf_matrix()
+    order = forest.var_order
+    divergent: list = []
+    for s in diff_segs:
+        lo = int(s) * forest.seg
+        hi = min(lo + forest.seg, leaf.shape[0])
+        comparisons += hi - lo
+        for vi in range(lo, hi):
+            if leaf[vi, a] != leaf[vi, b]:
+                divergent.append(order[vi])
+    return {"divergent": divergent, "comparisons": comparisons}
+
+
+def _component_members(components: "np.ndarray | None",
+                       live: np.ndarray) -> list:
+    """Sorted member lists of every live component with >= 2 members."""
+    n = live.shape[0]
+    if components is None:
+        members = np.flatnonzero(live)
+        return [members.tolist()] if members.size >= 2 else []
+    out: dict = {}
+    for r in np.flatnonzero(live):
+        out.setdefault(int(components[r]), []).append(int(r))
+    return [m for m in out.values() if len(m) >= 2]
+
+
+def sweep(forest, components: "np.ndarray | None" = None,
+          live: "np.ndarray | None" = None) -> dict:
+    """One anti-entropy sweep (see the module doc). Returns::
+
+        {"divergent": {var_id: sorted row list},
+         "pairs": [(a, b, [vars...]), ...],
+         "rounds": int, "comparisons": int, "components": int}
+
+    ``components`` is an ``int[R]`` labeling (None = fully connected);
+    ``live`` masks crashed rows out of the pairing entirely (a frozen
+    row neither exchanges nor repairs until it restores)."""
+    n = forest.leaf_matrix().shape[1]
+    if live is None:
+        live = np.ones(n, dtype=bool)
+    live = np.asarray(live, dtype=bool)
+    divergent: dict = {}
+    pairs: list = []
+    rounds = 0
+    comparisons = 0
+    with span("aae.exchange"):
+        groups = _component_members(components, live)
+        for members in groups:
+            m = len(members)
+            stride = 1
+            sweep_rounds = 0
+            while stride < m:
+                sweep_rounds += 1
+                found = False
+                seen_pairs = set()
+                for i in range(m):
+                    j = (i + stride) % m
+                    key = (min(i, j), max(i, j))
+                    if key in seen_pairs:
+                        continue
+                    seen_pairs.add(key)
+                    a, b = members[i], members[j]
+                    out = exchange_pair(forest, a, b)
+                    comparisons += out["comparisons"]
+                    if out["divergent"]:
+                        found = True
+                        pairs.append((a, b, out["divergent"]))
+                        for v in out["divergent"]:
+                            rows = divergent.setdefault(v, set())
+                            rows.add(a)
+                            rows.add(b)
+                if stride == 1 and not found:
+                    # adjacent equality around the member ring is
+                    # transitive: the whole component agrees
+                    break
+                stride *= 2
+            rounds = max(rounds, sweep_rounds)
+    counter(
+        "aae_exchange_rounds_total",
+        help="hypercube pairing rounds executed by AAE sweeps",
+    ).inc(rounds)
+    if divergent:
+        counter(
+            "aae_divergent_rows_total",
+            help="(var, row) divergences localized by AAE tree "
+                 "exchanges",
+        ).inc(sum(len(rs) for rs in divergent.values()))
+    return {
+        "divergent": {v: sorted(rs) for v, rs in divergent.items()},
+        "pairs": pairs,
+        "rounds": rounds,
+        "comparisons": comparisons,
+        "components": len(groups),
+    }

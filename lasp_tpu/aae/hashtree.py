@@ -1,0 +1,486 @@
+"""Vectorized per-replica Merkle hashtrees over each codec's wire leaves.
+
+The reference's second anti-entropy defense (riak_kv active anti-entropy,
+``riak_kv_index_hashtree`` / ``hashtree.erl``): every partition replica
+keeps a persistent Merkle tree over its keyspace so that two replicas
+can *detect and localize* divergence by exchanging O(log) hashes instead
+of reading whole objects. Here the keyspace of one simulated replica row
+is the store's variable census, and the tree is TENSORIZED:
+
+- **row hashes** — ``hash(states[v][r])`` for every variable ``v`` and
+  replica row ``r``, computed on device as one vmapped hash kernel per
+  dispatch-plan group (same-codec variables stack leafwise, exactly the
+  PR-5 grouping) with a log-depth on-device XOR reduction over the
+  row's position-mixed words (the Tascade reduction-tree discipline:
+  the whole population's hashes are one dispatch, never a per-row host
+  loop). The word mixer is a bijection (murmur3 fmix32), so any
+  SINGLE-WORD corruption changes the row hash with certainty; multi-word
+  corruption escapes with probability ~2^-32.
+- **per-replica trees** — the ``uint32[V, R]`` leaf matrix (one column
+  per replica) condenses into segment hashes (``seg_size`` leaves per
+  segment) and one root per replica, vectorized across the whole
+  population in two numpy passes. Exchange (:mod:`.exchange`) walks
+  root -> divergent segments -> divergent leaves.
+- **incremental rehash** — the runtime accumulates every
+  legitimately-changed (var, row) into the forest's dirty masks (the
+  same bookkeeping that feeds the frontier scheduler; see
+  ``ReplicatedRuntime._aae_mark``), so a refresh rehashes ONLY dirty
+  rows: quiescent variables and clean segments cost nothing. A
+  ``verify`` refresh additionally rehashes the CLEAN rows and compares
+  them against their last-committed hashes — a mismatch there is
+  SILENT corruption (no tracked mutation explains the change), the
+  fault class nothing else in the stack can see.
+
+Tree lifetime follows the dispatch plan's: every event that invalidates
+the plan for structural reasons (resize / shard / restore / late
+declares / map growth — ``ReplicatedRuntime._invalidate_plan``) bumps
+``_aae_state_epoch`` and forces a forest resync; a chaos mask flip
+bumps ``_aae_tree_epoch`` and rebuilds the segment/root levels (row
+hashes are a pure function of state and survive mask changes — only
+the exchange pairing they feed is mask-relative).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..telemetry import counter, span
+
+#: murmur3 fmix32 constants — the word mixer is a bijection on uint32
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+#: golden-ratio position/leaf salts
+_GOLD = np.uint32(0x9E3779B1)
+_FNV = np.uint32(0x811C9DC5)
+
+
+def _mix32(x):
+    """murmur3 finalizer — works on numpy AND jax.numpy uint32 arrays
+    (only ^, >>, * are used; both namespaces wrap uint32 silently)."""
+    x = x ^ (x >> np.uint32(16))
+    x = x * _M1
+    x = x ^ (x >> np.uint32(13))
+    x = x * _M2
+    x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def _leaf_words(leaf):
+    """``[R, ...]`` state leaf -> ``uint32[R, W]`` word view (traced).
+    bool/8/16-bit widen, 32-bit bitcast, 64-bit splits into two words —
+    every state BIT lands in some word, so no corruption hides in a
+    truncated view."""
+    import jax
+    import jax.numpy as jnp
+
+    r = leaf.shape[0]
+    flat = leaf.reshape((r, -1))
+    dt = flat.dtype
+    if dt == jnp.bool_ or dt.itemsize < 4:
+        return flat.astype(jnp.uint32)
+    if dt.itemsize == 4:
+        return jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    # itemsize 8: bitcast adds a trailing word axis [R, n, 2]
+    w = jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    return w.reshape((r, -1))
+
+
+def _row_hash_impl(states):
+    """``uint32[R]`` — one hash per replica row over every wire leaf.
+    Position-mixed Zobrist XOR per leaf (log-depth reduction under XLA),
+    leaves chained through the bijective mixer."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(states)
+    r = leaves[0].shape[0]
+    acc = jnp.full((r,), _FNV, dtype=jnp.uint32)
+    for li, leaf in enumerate(leaves):
+        w = _leaf_words(leaf)
+        n = w.shape[1]
+        salt = np.uint32((li + 1) * int(_GOLD) & 0xFFFFFFFF)
+        pos = _mix32(
+            jnp.arange(n, dtype=jnp.uint32) * _GOLD + salt
+        )
+        mixed = _mix32(w ^ pos[None, :])
+        h = jax.lax.reduce(
+            mixed, np.uint32(0), jax.lax.bitwise_xor, (1,)
+        )
+        acc = _mix32(acc ^ _mix32(h + salt))
+    return _mix32(acc)
+
+
+_jit_cache: dict = {}
+
+
+def _jitted(name, fn):
+    got = _jit_cache.get(name)
+    if got is None:
+        import jax
+
+        got = _jit_cache[name] = jax.jit(fn)
+    return got
+
+
+def row_hashes(states) -> np.ndarray:
+    """Host ``uint32[R]`` row hashes of one variable's population (jit
+    caches per leaf-shape signature)."""
+    return np.asarray(_jitted("rows", _row_hash_impl)(states))
+
+
+def group_row_hashes(stacked) -> np.ndarray:
+    """Host ``uint32[G, R]`` for a plan group's ``[G, R, ...]`` stacked
+    populations — ONE vmapped hash kernel per group per refresh."""
+    import jax
+
+    return np.asarray(
+        _jitted("grouped", jax.vmap(_row_hash_impl))(stacked)
+    )
+
+
+def subset_row_hashes(states, rows: np.ndarray) -> np.ndarray:
+    """``uint32[F]`` for the named replica rows only — the incremental
+    arm (gather + hash scales with dirty rows, not the population).
+    Rows are bucket-padded to powers of two (pad slots duplicate row 0;
+    their hashes are discarded) so shifting dirty counts reuse
+    executables — the frontier engine's bucket discipline."""
+    import jax.numpy as jnp
+
+    f = int(rows.size)
+    bucket = 8
+    while bucket < f:
+        bucket *= 2
+    padded = np.zeros(bucket, dtype=np.int64)
+    padded[:f] = rows
+    padded[f:] = rows[0]
+
+    def impl(states_, idx):
+        import jax
+
+        sub = jax.tree_util.tree_map(lambda x: x[idx], states_)
+        return _row_hash_impl(sub)
+
+    out = _jitted("subset", impl)(states, jnp.asarray(padded))
+    return np.asarray(out)[:f]
+
+
+def _np_mix_levels(leafmat: np.ndarray, seg: int):
+    """(segmat uint32[NS, R], roots uint32[R]) from the leaf matrix —
+    the per-replica tree levels, vectorized across every replica column
+    (host numpy; V is the small axis)."""
+    v, r = leafmat.shape
+    ns = max(1, -(-v // seg))
+    padded = np.zeros((ns * seg, r), dtype=np.uint32)
+    pos = _mix32(
+        np.arange(ns * seg, dtype=np.uint32) * _GOLD + np.uint32(1)
+    )
+    padded[:v] = leafmat
+    mixed = _mix32(padded ^ pos[:, None])
+    segmat = _mix32(
+        np.bitwise_xor.reduce(mixed.reshape(ns, seg, r), axis=1)
+    )
+    spos = _mix32(np.arange(ns, dtype=np.uint32) * _GOLD + np.uint32(2))
+    roots = _mix32(np.bitwise_xor.reduce(_mix32(segmat ^ spos[:, None]),
+                                         axis=0))
+    return segmat, roots
+
+
+class HashForest:
+    """The per-runtime tree set: committed row hashes per variable, the
+    leaf/segment/root matrices, and the dirty accumulator the runtime
+    feeds. One forest per runtime (attaching registers the accumulator
+    via ``runtime._aae_dirty``); see the module doc."""
+
+    def __init__(self, runtime, seg_size: int = 8,
+                 subset_crossover: float = 0.25):
+        self.rt = runtime
+        self.seg = int(seg_size)
+        if self.seg < 1:
+            raise ValueError("seg_size must be >= 1")
+        #: incremental arm crossover (fraction of rows dirty above which
+        #: the full vmapped rehash beats gather+scatter — the frontier
+        #: crossover rule)
+        self.subset_crossover = float(subset_crossover)
+        #: var -> bool[R] rows changed by TRACKED mutations since the
+        #: last refresh (the runtime ORs into this; see _aae_mark)
+        self.dirty: dict = {}
+        #: var -> uint32[R] last-committed row hashes
+        self.committed: dict = {}
+        self._var_order: tuple = ()
+        self._leafmat = np.zeros((0, 0), dtype=np.uint32)
+        self.segmat = np.zeros((0, 0), dtype=np.uint32)
+        self.roots = np.zeros((0,), dtype=np.uint32)
+        self._state_epoch = -1
+        self._tree_epoch = -1
+        self.rows_hashed = {"incremental": 0, "verify": 0, "full": 0}
+        self.segments_rehashed = 0
+        self.segments_total = 0
+        runtime._aae_dirty = self.dirty
+        self._resync()
+
+    # -- structure ------------------------------------------------------------
+    def _resync(self) -> None:
+        """Full structural rebuild: committed hashes are dropped (their
+        shapes/semantics may have changed), every row goes dirty, and
+        the next refresh recommits from live state. Verification has no
+        baseline for exactly one refresh after this — corruption
+        concurrent with a resize/restore surfaces as divergence in the
+        next exchange instead."""
+        rt = self.rt
+        self._var_order = tuple(rt.var_ids)
+        n = rt.n_replicas
+        self.committed = {}
+        self.dirty.clear()
+        for v in self._var_order:
+            self.dirty[v] = np.ones(n, dtype=bool)
+        self._leafmat = np.zeros(
+            (len(self._var_order), n), dtype=np.uint32
+        )
+        self._state_epoch = getattr(rt, "_aae_state_epoch", 0)
+        self._tree_epoch = getattr(rt, "_aae_tree_epoch", 0)
+
+    def _check_epochs(self) -> None:
+        rt = self.rt
+        if (
+            getattr(rt, "_aae_state_epoch", 0) != self._state_epoch
+            or self._var_order != tuple(rt.var_ids)
+            or (self._leafmat.shape[1] != rt.n_replicas)
+        ):
+            self._resync()
+        elif getattr(rt, "_aae_tree_epoch", 0) != self._tree_epoch:
+            # mask flip: row hashes are state-pure and stay committed;
+            # only the levels rebuild (and the exchange re-pairs)
+            self._tree_epoch = rt._aae_tree_epoch
+            self._rebuild_levels(range(len(self._var_order)))
+
+    @property
+    def var_order(self) -> tuple:
+        return self._var_order
+
+    # -- refresh --------------------------------------------------------------
+    def _ledger(self, codec_name: str, seconds: float, rows: int,
+                row_bytes: int, g_active: int = 1) -> None:
+        """One hash dispatch into the ``aae_hash`` roofline family."""
+        from ..telemetry import get_ledger, registry as _reg
+
+        if not _reg.enabled():
+            return
+        get_ledger().record(
+            "aae_hash", codec_name,
+            n_replicas=self.rt.n_replicas, fanout=1, seconds=seconds,
+            row_bytes=row_bytes, rows=rows, g_active=g_active,
+        )
+
+    def _hash_var(self, v: str, rows: "np.ndarray | None") -> np.ndarray:
+        """Recompute one variable's row hashes — all rows (``rows``
+        None) or the named subset — and return them (host uint32)."""
+        from ..utils.metrics import Timer
+
+        pop = self.rt._population(v)
+        codec, _spec = self.rt._mesh_meta(v)
+        with Timer() as t:
+            if rows is None:
+                out = row_hashes(pop)
+            else:
+                out = subset_row_hashes(pop, rows)
+        self._ledger(
+            codec.__name__, t.elapsed,
+            self.rt.n_replicas if rows is None else int(rows.size),
+            self.rt._row_bytes(v),
+        )
+        return out
+
+    def _hash_group(self, var_ids: list) -> dict:
+        """Full row hashes for a same-signature variable group — ONE
+        vmapped hash kernel over the ``[G, R, ...]`` stack (the PR-5
+        plan grouping applied to hashing). Returns {var: uint32[R]}."""
+        from ..mesh.plan import stack_group
+        from ..utils.metrics import Timer
+
+        rt = self.rt
+        codec, _spec = rt._mesh_meta(var_ids[0])
+        with Timer() as t:
+            stacked = stack_group([rt._population(v) for v in var_ids])
+            mat = group_row_hashes(stacked)
+        self._ledger(
+            codec.__name__, t.elapsed, rt.n_replicas,
+            rt._row_bytes(var_ids[0]), g_active=len(var_ids),
+        )
+        return {v: mat[i] for i, v in enumerate(var_ids)}
+
+    def refresh(self, verify: bool = False) -> dict:
+        """One tree refresh. Rehashes every DIRTY row (committing the
+        result — those changes are tracked, hence legitimate) and, with
+        ``verify=True``, also rehashes the CLEAN rows and flags every
+        committed-hash mismatch as silent corruption. Returns
+        ``{"corrupt": [(var, row), ...], "rows_hashed": int,
+        "vars_touched": int, "verified_rows": int}``. Quiescent
+        variables with an empty dirty mask cost nothing outside a
+        verify pass."""
+        self._check_epochs()
+        rt = self.rt
+        n = rt.n_replicas
+        corrupt: list = []
+        rows_hashed = 0
+        verified = 0
+        touched: list = []
+        # classify first, so the full-rehash vars group into stacked
+        # vmapped dispatches (one hash kernel per plan group) while the
+        # sparsely-dirty vars take the gather+hash incremental arm
+        full_vars: list = []
+        subset_vars: list = []
+        for v in self._var_order:
+            d = self.dirty.get(v)
+            has_dirty = d is not None and d.any()
+            if not has_dirty and not verify:
+                continue  # quiescent var: zero work
+            if verify or self.committed.get(v) is None or (
+                has_dirty and int(d.sum()) > self.subset_crossover * n
+            ):
+                full_vars.append(v)
+            else:
+                subset_vars.append(v)
+        with span("aae.hash", verify=verify):
+            fresh_of: dict = {}
+            if full_vars:
+                from ..mesh.plan import signature_of
+
+                groups: dict = {}
+                order: list = []
+                for v in full_vars:
+                    sig = signature_of(rt, v)
+                    key = sig if sig is not None else ("solo", v)
+                    if key not in groups:
+                        groups[key] = []
+                        order.append(key)
+                    groups[key].append(v)
+                for key in order:
+                    members = groups[key]
+                    if len(members) == 1:
+                        fresh_of[members[0]] = self._hash_var(
+                            members[0], None
+                        )
+                    else:
+                        fresh_of.update(self._hash_group(members))
+            for v in subset_vars:
+                rows = np.flatnonzero(self.dirty[v])
+                sub = self._hash_var(v, rows)
+                rows_hashed += int(rows.size)
+                self.rows_hashed["incremental"] += int(rows.size)
+                committed = self.committed[v].copy()
+                committed[rows] = sub
+                self.committed[v] = committed
+                self.dirty[v].fill(False)
+            for v in full_vars:
+                fresh = fresh_of[v]
+                rows_hashed += n
+                self.rows_hashed["verify" if verify else "full"] += n
+                committed = self.committed.get(v)
+                d = self.dirty.get(v)
+                has_dirty = d is not None and d.any()
+                if verify and committed is not None:
+                    clean = ~d if has_dirty else np.ones(n, dtype=bool)
+                    bad = np.flatnonzero(clean & (fresh != committed))
+                    verified += int(clean.sum())
+                    corrupt.extend((v, int(r)) for r in bad)
+                self.committed[v] = fresh
+                if has_dirty:
+                    d.fill(False)
+            for vi, v in enumerate(self._var_order):
+                if v not in fresh_of and v not in subset_vars:
+                    continue
+                if not np.array_equal(
+                    self._leafmat[vi], self.committed[v]
+                ):
+                    self._leafmat[vi] = self.committed[v]
+                    touched.append(vi)
+        if touched:
+            self._rebuild_levels(touched)
+        if rows_hashed:
+            counter(
+                "aae_rows_hashed_total",
+                help="replica rows rehashed by the AAE forest, by mode "
+                     "(incremental dirty-row refresh vs full/verify "
+                     "passes)",
+                mode="verify" if verify else "refresh",
+            ).inc(rows_hashed)
+        return {
+            "corrupt": corrupt,
+            "rows_hashed": rows_hashed,
+            "vars_touched": len(touched),
+            "verified_rows": verified,
+        }
+
+    def rehash_rows(self, var_id: str, rows) -> np.ndarray:
+        """Recompute + commit the named rows of one variable (the
+        post-repair commit path). Returns their fresh hashes."""
+        self._check_epochs()
+        rows = np.asarray(rows, dtype=np.int64)
+        fresh = self._hash_var(var_id, rows)
+        committed = self.committed.get(var_id)
+        if committed is None:
+            committed = self._hash_var(var_id, None)
+        else:
+            committed = committed.copy()
+            committed[rows] = fresh
+        self.committed[var_id] = committed
+        d = self.dirty.get(var_id)
+        if d is not None:
+            d[rows] = False
+        vi = self._var_order.index(var_id)
+        self._leafmat[vi] = committed
+        self._rebuild_levels([vi])
+        return fresh
+
+    # -- tree levels -----------------------------------------------------------
+    def _rebuild_levels(self, touched_vars) -> None:
+        """Recompute segment hashes for the segments containing the
+        touched leaf rows, then the roots — clean segments keep their
+        hashes (cost nothing)."""
+        v, r = self._leafmat.shape
+        ns = max(1, -(-max(v, 1) // self.seg))
+        self.segments_total = ns
+        if self.segmat.shape != (ns, r):
+            # shape changed (resync): compute everything
+            self.segmat, self.roots = _np_mix_levels(
+                self._leafmat, self.seg
+            )
+            self.segments_rehashed += ns
+            return
+        segs = sorted({int(vi) // self.seg for vi in touched_vars})
+        if not segs:
+            return
+        padded = np.zeros((ns * self.seg, r), dtype=np.uint32)
+        padded[:v] = self._leafmat
+        pos = _mix32(
+            np.arange(ns * self.seg, dtype=np.uint32) * _GOLD
+            + np.uint32(1)
+        )
+        for s in segs:
+            lo, hi = s * self.seg, (s + 1) * self.seg
+            mixed = _mix32(padded[lo:hi] ^ pos[lo:hi, None])
+            self.segmat[s] = _mix32(np.bitwise_xor.reduce(mixed, axis=0))
+        self.segments_rehashed += len(segs)
+        spos = _mix32(
+            np.arange(ns, dtype=np.uint32) * _GOLD + np.uint32(2)
+        )
+        self.roots = _mix32(
+            np.bitwise_xor.reduce(_mix32(self.segmat ^ spos[:, None]),
+                                  axis=0)
+        )
+
+    # -- read views ------------------------------------------------------------
+    def leaf_matrix(self) -> np.ndarray:
+        """uint32[V, R] — row ``vi`` is variable ``var_order[vi]``'s
+        committed hashes across replicas."""
+        return self._leafmat
+
+    def describe(self) -> dict:
+        return {
+            "vars": len(self._var_order),
+            "n_replicas": int(self._leafmat.shape[1]),
+            "seg_size": self.seg,
+            "segments": int(self.segmat.shape[0]),
+            "rows_hashed": dict(self.rows_hashed),
+            "segments_rehashed": self.segments_rehashed,
+        }

@@ -1,0 +1,403 @@
+"""Targeted repair: divergence joins, corruption quarantine, quorum
+overwrite — and the :class:`AAEScrubber` driver that ties detection to
+repair.
+
+Two divergence classes, two repairs (the detection/repair contract,
+docs/RESILIENCE.md "Active anti-entropy"):
+
+1. **Inflationary divergence** (a row is simply BEHIND — delayed links,
+   healed partitions, restored replicas): the exchange's divergent
+   (var, row) pairs repair by a bidirectional partial join
+   (``ReplicatedRuntime.join_rows`` both ways — both rows land on the
+   pair's least upper bound). Join idempotence makes re-repair free;
+   wire cost is two row frames per pair, accounted against the
+   full-state resync it replaces.
+2. **Non-inflationary corruption** (bit-rot, a bad kernel, a botched
+   restore): detected when a row's recomputed hash disagrees with its
+   own LAST-COMMITTED hash (no tracked mutation explains the change —
+   the verify pass), or when a pair's post-join rehash still diverges
+   (a lattice join reaching a "fixed point" that isn't one — only a
+   broken state can do that). A corrupt row's content cannot be
+   trusted, so repair escalates to a QUORUM-READ of healthy peers
+   (live, reachable in the corrupt row's chaos component, not
+   themselves flagged) with AUTHORITATIVE OVERWRITE, plus an incident
+   record. A row with no reachable healthy peer parks as a PENDING
+   repair and retries every scrub until its partition heals.
+
+Recovery limits (the riak_kv AAE fault model, stated honestly): a
+write that existed ONLY on the corrupted row at corruption time is
+unrecoverable — anti-entropy restores a replica FROM its peers. One
+gossip round between a write and the corruption window guarantees a
+second holder; the chaos presets are built to that contract.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..mesh.gossip import quorum_read, rows_traffic_bytes
+from ..telemetry import counter, events as tel_events, gauge, span
+from ..telemetry.convergence import get_monitor
+from . import exchange as _exchange
+from .hashtree import HashForest
+
+
+def overwrite_row(rt, var_id: str, row: int, picks: np.ndarray) -> int:
+    """Authoritatively overwrite one replica row with the join of the
+    ``picks`` quorum rows (wire format). The overwritten row marks
+    frontier-dirty and AAE-dirty (a tracked, legitimate mutation).
+    Returns the estimated wire bytes (quorum reads + the write-back)."""
+    import jax
+    import jax.numpy as jnp
+
+    pop = rt._population(var_id)
+    codec, spec = rt._mesh_meta(var_id)
+    top = quorum_read(codec, spec, pop, np.asarray(picks, dtype=np.int64))
+    rt.states[var_id] = jax.tree_util.tree_map(
+        lambda x, t: x.at[int(row)].set(jnp.asarray(t)), pop, top
+    )
+    rt._mark_dirty_rows(var_id, [int(row)])
+    rt._aae_mark(var_id, [int(row)])
+    return rows_traffic_bytes(pop, int(len(picks)) + 1)
+
+
+class AAEScrubber:
+    """Active anti-entropy over one population: hash forest + exchange
+    + repair, driven per chaos round or on demand.
+
+    ``runtime`` is a :class:`~lasp_tpu.chaos.ChaosRuntime` (the
+    scrubber attaches itself as the engine's per-round AAE hook unless
+    ``auto_attach=False``) or a bare
+    :class:`~lasp_tpu.mesh.runtime.ReplicatedRuntime` (fault-free
+    serving: call :meth:`scrub` yourself, e.g. from the serving
+    front-end's cycle). ``scrub_every`` sets the verify/exchange
+    cadence in rounds — detection latency is bounded by it; the
+    per-round incremental tree refresh always runs (that is the <5%
+    hot path the overhead guard prices)."""
+
+    def __init__(self, runtime, *, seg_size: int = 8,
+                 scrub_every: int = 1, quorum: int = 3,
+                 auto_attach: bool = True):
+        from ..chaos.engine import ChaosRuntime
+
+        if isinstance(runtime, ChaosRuntime):
+            self.ch = runtime
+            self.rt = runtime.rt
+        else:
+            self.ch = None
+            self.rt = runtime
+        self.scrub_every = max(1, int(scrub_every))
+        self.quorum = max(1, int(quorum))
+        self.forest = HashForest(self.rt, seg_size=seg_size)
+        #: detection ledger: {"round", "var", "row", "source"} — the
+        #: invariant harness matches this against the injected set
+        self.detected: list = []
+        #: incident records for corruption escalations (the operator
+        #: surface: what was overwritten, from which quorum, when)
+        self.incidents: list = []
+        #: (var, row) -> {"round", "source", "attempts"} awaiting a
+        #: reachable healthy quorum
+        self.pending: dict = {}
+        self.scrubs = 0
+        self.repaired_joins = 0
+        self.repaired_overwrites = 0
+        self.repair_bytes = 0
+        self.exchange_rounds = 0
+        self.comparisons = 0
+        self.divergent_rows = 0
+        if self.ch is not None and auto_attach:
+            self.ch.aae = self
+
+    # -- chaos-engine hooks ---------------------------------------------------
+    def on_round_start(self, rnd: int) -> None:
+        """Called by ``ChaosRuntime.step`` after the round's actions
+        (including corruption injection) and BEFORE the gossip
+        dispatch: a corrupt row detected here never gossips outward."""
+        if rnd % self.scrub_every == 0:
+            self.scrub(rnd)
+
+    def on_round_end(self, rnd: int) -> None:
+        """Post-dispatch incremental tree refresh: commit the hashes of
+        every row this round legitimately changed, so the NEXT round's
+        verify has a clean baseline. Quiescent rounds cost nothing."""
+        self.forest.refresh()
+
+    # -- topology views --------------------------------------------------------
+    def _mask_and_live(self, rnd: "int | None"):
+        if self.ch is None:
+            return None, np.ones(self.rt.n_replicas, dtype=bool)
+        r = self.ch.round if rnd is None else int(rnd)
+        return self.ch.schedule.mask_at(r), ~self.ch.crashed
+
+    def _components(self, mask, live):
+        if mask is None and live.all():
+            return None
+        from ..quorum import fsm
+
+        return fsm.components(self.rt._host_neighbors, mask, live)
+
+    # -- the scrub -------------------------------------------------------------
+    def scrub(self, rnd: "int | None" = None) -> dict:
+        """One full scrub: verify (self-hash corruption check) ->
+        corruption repair -> exchange sweep -> divergence repair (with
+        join-fixed-point escalation) -> commit. Returns the scrub
+        stats."""
+        if rnd is None:
+            rnd = self.ch.round if self.ch is not None else self.scrubs
+        mask, live = self._mask_and_live(rnd)
+        comp = self._components(mask, live)
+        stats: dict
+        with span("aae.scrub", round=int(rnd)):
+            ver = self.forest.refresh(verify=True)
+            fresh_corrupt = []
+            for v, r in ver["corrupt"]:
+                self._record_detection(rnd, v, r, "self_hash")
+                fresh_corrupt.append((v, r))
+            repaired, still_pending = self._repair_corrupt(
+                rnd, fresh_corrupt, comp, live
+            )
+            sw = _exchange.sweep(self.forest, comp, live)
+            self.exchange_rounds += sw["rounds"]
+            self.comparisons += sw["comparisons"]
+            self.divergent_rows += sum(
+                len(rs) for rs in sw["divergent"].values()
+            )
+            joined, escalated = self._repair_divergence(rnd, sw, comp,
+                                                        live)
+            stats = {
+                "round": int(rnd),
+                "corrupt_detected": len(fresh_corrupt),
+                "corrupt_repaired": repaired,
+                "pending": still_pending,
+                "divergent_vars": len(sw["divergent"]),
+                "divergent_rows": sum(
+                    len(rs) for rs in sw["divergent"].values()
+                ),
+                "joins": joined,
+                "escalated": escalated,
+                "exchange_rounds": sw["rounds"],
+                "comparisons": sw["comparisons"],
+                "rows_hashed": ver["rows_hashed"],
+            }
+        self.scrubs += 1
+        counter(
+            "aae_scrubs_total",
+            help="AAE scrubs executed (verify + exchange + repair)",
+        ).inc()
+        gauge(
+            "aae_pending_repairs",
+            help="corrupt rows detected but awaiting a reachable "
+                 "healthy quorum",
+        ).set(len(self.pending))
+        if stats["corrupt_detected"] or stats["divergent_rows"]:
+            tel_events.emit(
+                "aae", action="scrub", round=int(rnd),
+                corrupt=stats["corrupt_detected"],
+                divergent=stats["divergent_rows"],
+                repaired=stats["corrupt_repaired"] + stats["joins"],
+            )
+        return stats
+
+    def _record_detection(self, rnd, var, row, source,
+                          pair: "int | None" = None) -> None:
+        rec = {
+            "round": int(rnd), "var": var, "row": int(row),
+            "source": source,
+        }
+        if pair is not None:
+            # join_fixed_point detections localize to a PAIR: the
+            # protocol cannot know which endpoint carries the broken
+            # state, so both repair (riak overwrites both too) and the
+            # invariant's exactness check accepts either endpoint
+            # matching the injection
+            rec["pair"] = int(pair)
+        self.detected.append(rec)
+        counter(
+            "aae_corruption_detected_total",
+            help="silent-corruption detections, by source (self_hash: "
+                 "committed-hash mismatch on a clean row; "
+                 "join_fixed_point: a pair still diverging after its "
+                 "repair join)",
+            source=source,
+        ).inc()
+        tel_events.emit(
+            "aae", action="detect", var=var, replica=int(row),
+            round=int(rnd), source=source,
+        )
+
+    # -- repairs ---------------------------------------------------------------
+    def _healthy_quorum(self, var, row, comp, live,
+                        exclude) -> "np.ndarray | None":
+        """The first ``quorum`` healthy peers of ``row`` in ring order:
+        live, in ``row``'s component, and not themselves flagged this
+        scrub. None when no peer is reachable (the pending case)."""
+        n = self.rt.n_replicas
+        picks = []
+        for step in range(1, n):
+            cand = (int(row) + step) % n
+            if not live[cand]:
+                continue
+            if comp is not None and comp[cand] != comp[int(row)]:
+                continue
+            if (var, cand) in exclude:
+                continue
+            picks.append(cand)
+            if len(picks) >= self.quorum:
+                break
+        return np.asarray(picks, dtype=np.int64) if picks else None
+
+    def _repair_corrupt(self, rnd, fresh, comp, live):
+        """Quorum-overwrite every fresh detection plus every parked
+        pending repair; rows with no reachable healthy peer (or crashed
+        rows — frozen until restore) stay pending."""
+        work = {(v, int(r)): {"round": int(rnd), "source": "self_hash",
+                              "attempts": 0}
+                for v, r in fresh}
+        for key, info in self.pending.items():
+            work.setdefault(key, info)
+        exclude = set(work)
+        repaired = 0
+        self.pending = {}
+        with span("aae.repair"):
+            for (v, r), info in work.items():
+                info["attempts"] += 1
+                if not live[r]:
+                    self.pending[(v, r)] = info  # frozen: wait for
+                    continue                     # restore/reseed
+                picks = self._healthy_quorum(v, r, comp, live, exclude)
+                if picks is None:
+                    self.pending[(v, r)] = info
+                    continue
+                bytes_ = overwrite_row(self.rt, v, r, picks)
+                self.forest.rehash_rows(v, [r])
+                self.repair_bytes += bytes_
+                self.repaired_overwrites += 1
+                repaired += 1
+                counter(
+                    "aae_repairs_total",
+                    help="AAE repairs applied, by kind (join: "
+                         "divergence partial joins; overwrite: "
+                         "corruption quorum overwrites)",
+                    kind="overwrite",
+                ).inc()
+                counter(
+                    "aae_repair_bytes_total",
+                    help="estimated wire bytes moved by AAE repairs, "
+                         "by kind",
+                    kind="overwrite",
+                ).inc(bytes_)
+                self.incidents.append({
+                    "round": int(rnd), "var": v, "row": int(r),
+                    "source": info["source"],
+                    "quorum": [int(p) for p in picks],
+                    "attempts": info["attempts"],
+                })
+                tel_events.emit(
+                    "aae", action="incident", var=v, replica=int(r),
+                    round=int(rnd), source=info["source"],
+                    quorum=[int(p) for p in picks],
+                )
+        return repaired, len(self.pending)
+
+    def _repair_divergence(self, rnd, sw, comp, live):
+        """Bidirectional partial joins over the exchange's divergent
+        pairs; a pair whose rows STILL hash differently after the join
+        escalates both rows to corruption repair (a correct lattice
+        cannot re-diverge at its own join).
+
+        Gating: a variable whose FRONTIER is still active is divergent
+        because gossip is mid-flight — joining it here would just race
+        the anti-entropy the mesh is already running (and the repair
+        bytes would dwarf what they replace). AAE repairs only the
+        divergence gossip does NOT know about: a quiet frontier with
+        unequal rows (lost knowledge after mask flips, trees attached
+        over pre-existing damage, broken lattice states)."""
+        import jax
+
+        joined = 0
+        escalated = []
+        with span("aae.repair"):
+            for a, b, var_ids in sw["pairs"]:
+                for v in var_ids:
+                    f = self.rt._frontier.get(v)
+                    if f is not None and f.any():
+                        continue  # gossip already owns this divergence
+                    pop = self.rt._population(v)
+                    codec, spec = self.rt._mesh_meta(v)
+                    ra = jax.tree_util.tree_map(lambda x: x[a], pop)
+                    rb = jax.tree_util.tree_map(lambda x: x[b], pop)
+                    lub = codec.merge(spec, ra, rb)
+                    self.rt.join_rows(
+                        v, np.asarray([a, b], dtype=np.int64), lub
+                    )
+                    self.rt._aae_mark(v, [a, b])
+                    bytes_ = rows_traffic_bytes(pop, 2)
+                    self.repair_bytes += bytes_
+                    self.repaired_joins += 1
+                    joined += 1
+                    counter(
+                        "aae_repairs_total",
+                        help="AAE repairs applied, by kind (join: "
+                             "divergence partial joins; overwrite: "
+                             "corruption quorum overwrites)",
+                        kind="join",
+                    ).inc()
+                    counter(
+                        "aae_repair_bytes_total",
+                        help="estimated wire bytes moved by AAE "
+                             "repairs, by kind",
+                        kind="join",
+                    ).inc(bytes_)
+                    ha, hb = self.forest.rehash_rows(v, [a, b])
+                    if ha != hb:
+                        for r, other in ((a, b), (b, a)):
+                            if (v, int(r)) not in self.pending:
+                                self._record_detection(
+                                    rnd, v, r, "join_fixed_point",
+                                    pair=other,
+                                )
+                                self.pending[(v, int(r))] = {
+                                    "round": int(rnd),
+                                    "source": "join_fixed_point",
+                                    "attempts": 0,
+                                }
+                                escalated.append((v, int(r)))
+        if escalated:
+            # escalations repair immediately (same scrub): the parked
+            # entries run through the corruption path now
+            repaired, _pending = self._repair_corrupt(
+                rnd, [], comp, live
+            )
+            return joined, len(escalated)
+        return joined, 0
+
+    # -- reporting -------------------------------------------------------------
+    def full_resync_bytes(self) -> int:
+        """What a full-state resync of the population would move — the
+        denominator of the "repair bytes << resync" claim."""
+        total = 0
+        for v in self.rt.var_ids:
+            total += rows_traffic_bytes(
+                self.rt._population(v), self.rt.n_replicas
+            )
+        return total
+
+    def report(self) -> dict:
+        """The AAE accounting (also folded into ``health()['aae']``)."""
+        rep = {
+            "scrubs": self.scrubs,
+            "detected": len(self.detected),
+            "incidents": len(self.incidents),
+            "pending": len(self.pending),
+            "repaired_joins": self.repaired_joins,
+            "repaired_overwrites": self.repaired_overwrites,
+            "repair_bytes": self.repair_bytes,
+            "full_resync_bytes": self.full_resync_bytes(),
+            "exchange_rounds": self.exchange_rounds,
+            "comparisons": self.comparisons,
+            "divergent_rows": self.divergent_rows,
+            "rows_hashed": dict(self.forest.rows_hashed),
+            "segments_rehashed": self.forest.segments_rehashed,
+        }
+        get_monitor().observe_aae(**rep)
+        return rep

@@ -296,6 +296,31 @@ class Session:
         return QuorumRuntime(runtime, n=n, r=r, w=w, hints=hints,
                              **kwargs)
 
+    def aae(self, runtime, **kwargs):
+        """Wrap a replicated runtime (from :meth:`replicate`) — or a
+        :class:`~lasp_tpu.chaos.ChaosRuntime` from :meth:`nemesis` — in
+        an :class:`~lasp_tpu.aae.AAEScrubber`: active anti-entropy via
+        vectorized Merkle hashtrees, pairwise tree exchange, and
+        targeted quorum repair (docs/RESILIENCE.md "Active
+        anti-entropy"):
+
+        >>> rt = session.replicate(64)
+        >>> chaos = session.nemesis(rt, "rolling-crash")
+        >>> scrub = session.aae(chaos)      # attaches per-round hooks
+        >>> chaos.soak(); scrub.report()    # detections, repairs
+
+        On a chaos runtime the scrubber attaches itself to the engine's
+        per-round hooks (detect/repair before each gossip dispatch,
+        commit after); on a bare runtime call ``scrub()`` yourself (or
+        hand it to ``ServeFrontend(aae=...)`` for background scrubs).
+        Extra kwargs reach :class:`AAEScrubber` (``seg_size``,
+        ``scrub_every``, ``quorum``, ``auto_attach``). The AAE report
+        lands in :meth:`health` under ``aae``."""
+        from ..aae import AAEScrubber
+
+        _count_verb("aae")
+        return AAEScrubber(runtime, **kwargs)
+
     def serve(self, runtime, **kwargs):
         """Wrap a replicated runtime (from :meth:`replicate`) — or a
         :class:`~lasp_tpu.chaos.ChaosRuntime` from :meth:`nemesis` — in

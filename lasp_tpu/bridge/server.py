@@ -741,6 +741,24 @@ class _Conn:
             self._locks[name] = self
         self._name = name
         self._hs = HostStore(path)
+        # open-time compaction on the bitcask waste_pct cue: the
+        # periodic in-session compaction counter (_COMPACT_EVERY)
+        # resets with every connection, so a restart-heavy workload
+        # whose sessions each stay under the threshold would otherwise
+        # grow the log WITHOUT BOUND — superseded varmeta/leaf records
+        # plus evicted idem:<reqid> tombstones pile up while the live
+        # key set stays constant. Folding them here keeps the file
+        # proportional to live data across any restart cadence.
+        try:
+            _size = os.path.getsize(path)
+        except OSError:
+            _size = 0
+        _stats = self._hs.stats()
+        if (
+            _stats["wasted_bytes"] > (1 << 16)
+            and 2 * _stats["wasted_bytes"] > _size
+        ):
+            self._hs.compact()
         from ..store.checkpoint import loads_manifest
 
         self._manifest = loads_manifest(self._hs.get("manifest"))

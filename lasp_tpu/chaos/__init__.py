@@ -25,18 +25,24 @@ the ``chaos_heal`` bench scenario, and ``tools/chaos_smoke.py`` in
 from .engine import ChaosRuntime, ReplicaDownError
 from .invariants import (
     InvariantViolation,
+    check_corruption_detected_and_repaired,
     check_inflation,
     check_no_resurrection,
     check_no_write_lost,
     fingerprint,
+    run_aae_harness,
     run_harness,
     run_quorum_harness,
     snapshot_states,
     states_equal,
 )
 from .schedule import (
+    CORRUPTION_KINDS,
+    CORRUPTION_PRESETS,
     PRESETS,
+    BitRot,
     ChaosSchedule,
+    CorruptRows,
     Crash,
     DelayLinks,
     DuplicateLinks,
@@ -48,9 +54,13 @@ from .schedule import (
 )
 
 __all__ = [
+    "CORRUPTION_KINDS",
+    "CORRUPTION_PRESETS",
     "PRESETS",
+    "BitRot",
     "ChaosRuntime",
     "ChaosSchedule",
+    "CorruptRows",
     "Crash",
     "DelayLinks",
     "DuplicateLinks",
@@ -60,11 +70,13 @@ __all__ = [
     "ReplicaDownError",
     "Restore",
     "SlowShard",
+    "check_corruption_detected_and_repaired",
     "check_inflation",
     "check_no_resurrection",
     "check_no_write_lost",
     "fingerprint",
     "nemesis",
+    "run_aae_harness",
     "run_harness",
     "run_quorum_harness",
     "snapshot_states",

@@ -87,6 +87,19 @@ class ChaosRuntime:
         #: rows restored at the LAST step — the invariant harness's
         #: monotonicity exemption (a reseed is deliberately non-monotone)
         self.last_restored: list = []
+        #: (var, row) pairs corrupted at the LAST step (silent state
+        #: mutation — CorruptRows/BitRot events); the AAE harness's
+        #: ground-truth for detection/localization latency
+        self.last_corrupted: list = []
+        #: full injection ledger: {"round", "var", "row", "kind"}
+        self.injected_corruptions: list = []
+        #: attached AAE scrubber (``lasp_tpu.aae.AAEScrubber`` sets
+        #: itself here): ``on_round_start`` runs after the round's
+        #: actions/injections and BEFORE the gossip dispatch (a corrupt
+        #: row detected there never gossips outward),
+        #: ``on_round_end`` commits the round's tracked changes into
+        #: the hash forest
+        self.aae = None
         self.degraded_reads = 0
         self.repair_bytes = 0
         self.repaired_rows = 0
@@ -149,11 +162,132 @@ class ChaosRuntime:
         from .schedule import Crash
 
         self.last_restored = []
+        self.last_corrupted = []
         for ev in self.schedule.actions_at(rnd):
             if isinstance(ev, Crash):
                 self._crash(ev.replica)
             else:
                 self._restore(ev.replica, ev.source)
+        for idx, ev, shot in self.schedule.corruptions_at(rnd):
+            self._inject_corruption(ev, idx, shot, rnd)
+
+    # -- silent corruption (CorruptRows / BitRot) -----------------------------
+    def _inject_corruption(self, ev, idx: int, shot: int,
+                           rnd: int) -> None:
+        """Apply one corruption event: mutate ``ev.n_rows`` seeded LIVE
+        replica rows directly in device state, bypassing every
+        dirty-tracking path (the point: nothing legitimate explains the
+        change). Pure function of ``(seed, schedule, round, state)`` —
+        replays bit-identically."""
+        from .schedule import _mix
+
+        live = np.flatnonzero(~self.crashed)
+        if live.size == 0:
+            return
+        base = (
+            (self.schedule.seed * 1_000_003 + idx * 7919)
+            ^ ((rnd + 1) * 2_654_435)
+        ) + shot * 65_537
+        var_ids = (
+            [ev.var] if ev.var is not None else list(self.rt.var_ids)
+        )
+        if not var_ids:
+            return
+        for j in range(int(ev.n_rows)):
+            draw = _mix(
+                np.asarray([j * 3 + 1, j * 3 + 2, j * 3 + 3],
+                           dtype=np.uint64),
+                base,
+            )
+            row = int(live[int(draw[0] * live.size) % live.size])
+            var = var_ids[int(draw[1] * len(var_ids)) % len(var_ids)]
+            salt = int(draw[2] * (1 << 31))
+            if not self._mutate_row(var, row, ev.kind, salt):
+                continue  # target held nothing to corrupt this way
+            rec = {"round": int(rnd), "var": var, "row": row,
+                   "kind": ev.kind}
+            self.injected_corruptions.append(rec)
+            self.last_corrupted.append((var, row))
+            counter(
+                "chaos_faults_injected_total",
+                help="chaos fault events activated, by kind",
+                kind="corrupt",
+            ).inc()
+            tel_events.emit(
+                "chaos", var=var, replica=row, action="corrupt",
+                kind=ev.kind, round=int(rnd),
+            )
+
+    def _mutate_row(self, var: str, row: int, kind: str,
+                    salt: int) -> bool:
+        """One row mutation by kind; returns False when the target row
+        carried nothing this kind can corrupt (a rollback of an empty
+        counter, a truncate of an empty plane — the injection is then
+        skipped, never silently recorded as a no-op)."""
+        import jax
+        import jax.numpy as jnp
+
+        rt = self.rt
+        pop = rt._population(var)
+        leaves = jax.tree_util.tree_leaves(pop)
+        treedef = jax.tree_util.tree_structure(pop)
+        host = [np.array(np.asarray(leaf[row])) for leaf in leaves]
+        changed = False
+        if kind == "bitflip":
+            for off in range(len(host)):
+                li = (salt + off) % len(host)
+                flat = host[li].reshape(-1)
+                if flat.size == 0:
+                    continue
+                pos = (salt // 7) % flat.size
+                if flat.dtype == np.bool_:
+                    flat[pos] = ~flat[pos]
+                else:
+                    # bits-1: np.int32(1 << 31) would overflow the
+                    # scalar conversion for signed dtypes
+                    bits = flat.dtype.itemsize * 8 - 1
+                    flat[pos] = flat[pos] ^ flat.dtype.type(
+                        1 << ((salt // 11) % bits)
+                    )
+                changed = True
+                break
+        elif kind == "rollback":
+            # halve a positive integer lane (counter/clock rollback);
+            # prefer the FIRST int leaf (gcounter counts, orswot clock)
+            for off in range(len(host)):
+                flat = host[off].reshape(-1)
+                if flat.dtype == np.bool_ or flat.size == 0:
+                    continue
+                positive = np.flatnonzero(flat.astype(np.int64) > 0)
+                if positive.size == 0:
+                    continue
+                pos = int(positive[(salt // 7) % positive.size])
+                flat[pos] = flat[pos] // 2
+                changed = True
+                break
+        elif kind == "truncate":
+            # zero the tail half of the LAST wire plane (truncated dot
+            # planes / token planes)
+            flat = host[-1].reshape(-1)
+            tail = flat[flat.size // 2:]
+            if tail.size and np.any(tail != 0):
+                tail[:] = 0
+                changed = True
+        else:
+            raise ValueError(f"unknown corruption kind {kind!r}")
+        if not changed:
+            return False
+        new_leaves = [
+            leaf.at[row].set(jnp.asarray(h.reshape(leaf.shape[1:])))
+            for leaf, h in zip(leaves, host)
+        ]
+        # direct state write ON PURPOSE: no mark_dirty, no _aae_mark —
+        # the corruption is silent, which is exactly what the AAE
+        # verify pass exists to catch
+        rt.states[var] = jax.tree_util.tree_unflatten(
+            treedef, new_leaves
+        )
+        return True
 
     def _needs_freeze(self) -> bool:
         """Gossip alone cannot move a crashed row (its every edge is
@@ -196,6 +330,11 @@ class ChaosRuntime:
         contract). Deterministic in ``(seed, schedule, state)``."""
         rnd = self.round
         self._apply_actions(rnd)
+        if self.aae is not None:
+            # detect/repair BEFORE the dispatch: a corrupt row caught
+            # here never gossips outward (docs/RESILIENCE.md "Active
+            # anti-entropy" — the detection-before-spread ordering)
+            self.aae.on_round_start(rnd)
         mask = self.schedule.mask_at(rnd)
         self._account_duplicates(rnd, alive=mask)
         import jax
@@ -228,6 +367,11 @@ class ChaosRuntime:
                 )
         self._emit_round_gauges(mask)
         self.round += 1
+        if self.aae is not None:
+            # commit this round's TRACKED changes into the hash forest
+            # so the next verify has a clean baseline (incremental: a
+            # quiescent round costs nothing)
+            self.aae.on_round_end(rnd)
         return residual
 
     def _device_mask(self, mask):
@@ -332,6 +476,10 @@ class ChaosRuntime:
             self._account_duplicates(self.round, alive=masks[t])
             self.round += 1
         self._emit_round_gauges(masks[-1])
+        if self.aae is not None:
+            # the opaque block degraded every var to all-dirty: one
+            # commit refresh keeps the forest's baseline current
+            self.aae.on_round_end(self.round - 1)
         return res.tolist()
 
     # -- degraded reads + read-repair -----------------------------------------
@@ -486,8 +634,10 @@ class ChaosRuntime:
                 if can_fuse and (nxt is None or nxt > rnd):
                     width = block if nxt is None else min(block, nxt - rnd)
                     # actions take effect at round start: a window may
-                    # not even BEGIN on an action round
-                    if not self.schedule.actions_at(rnd):
+                    # not even BEGIN on an action or injection round
+                    if not self.schedule.actions_at(rnd) and not (
+                        self.schedule.corruptions_at(rnd)
+                    ):
                         res = self.fused_steps(width)
                         residual = res[-1]
                         if residual == 0 and self.round > horizon:

@@ -253,6 +253,192 @@ def run_quorum_harness(build, schedule, *, writes, reads=(),
     return report
 
 
+def check_corruption_detected_and_repaired(rt, chaos, scrubber,
+                                           free_states: dict,
+                                           detect_within: int = 1) -> dict:
+    """The active-anti-entropy invariant (docs/RESILIENCE.md "Active
+    anti-entropy"): judged over a FINISHED corruption soak,
+
+    1. **detected** — every injected corruption (the engine's
+       ``injected_corruptions`` ground truth) has a detection in the
+       scrubber's ledger within ``detect_within`` rounds of injection;
+    2. **localized exactly** — every detection names an injected
+       (var, row): zero false positives (a detector that cried wolf on
+       healthy rows would make repair itself the corruption vector);
+    3. **repaired** — no repair left pending, and every detected
+       (var, row) has an incident record (the quorum overwrite ran);
+    4. **bit-equal** — the healed population equals the fault-free
+       twin's fixed point, leaf for leaf.
+
+    Returns the per-injection detection latencies (rounds)."""
+    injected = chaos.injected_corruptions
+    detected = scrubber.detected
+    latencies = []
+    for rec in injected:
+        hits = [
+            d for d in detected
+            if d["var"] == rec["var"] and d["row"] == rec["row"]
+            and rec["round"] <= d["round"]
+            <= rec["round"] + detect_within
+        ]
+        if not hits:
+            raise InvariantViolation(
+                f"corruption UNDETECTED: {rec['kind']} at "
+                f"({rec['var']!r}, row {rec['row']}) round "
+                f"{rec['round']} has no detection within "
+                f"{detect_within} round(s) — if the scrub cadence is "
+                "wider than 1, a legit change to the row between "
+                "scrubs commits (launders) the corruption into the "
+                "hash baseline; see docs/RESILIENCE.md 'Active "
+                "anti-entropy'"
+            )
+        latencies.append(
+            min(d["round"] for d in hits) - rec["round"]
+        )
+    injected_keys = {(r["var"], r["row"]) for r in injected}
+    for d in detected:
+        if (d["var"], d["row"]) in injected_keys:
+            continue
+        if (
+            d["source"] == "join_fixed_point"
+            and (d["var"], d.get("pair")) in injected_keys
+        ):
+            # a still-diverging-after-join PAIR flags both endpoints
+            # (which one is broken is unknowable from hashes alone);
+            # localization is exact at pair granularity when the
+            # partner row was the injected one
+            continue
+        raise InvariantViolation(
+            f"corruption detector FALSE POSITIVE: flagged "
+            f"({d['var']!r}, row {d['row']}) at round "
+            f"{d['round']} ({d['source']}) but nothing was "
+            "injected there — localization must be exact"
+        )
+    if scrubber.pending:
+        raise InvariantViolation(
+            f"corruption repair left pending: {sorted(scrubber.pending)}"
+        )
+    incident_keys = {(i["var"], i["row"]) for i in scrubber.incidents}
+    missing = {(d["var"], d["row"]) for d in detected} - incident_keys
+    if missing:
+        raise InvariantViolation(
+            f"detections never repaired (no incident record): "
+            f"{sorted(missing)[:4]}"
+        )
+    if not states_equal(snapshot_states(rt), free_states):
+        raise InvariantViolation(
+            "post-repair fixed point differs from the fault-free "
+            "twin's: a corruption survived detection/repair (or the "
+            "repair destroyed state only the corrupt row held — see "
+            "the fault-model note on sole-copy writes)"
+        )
+    return {"detection_latency_rounds": latencies}
+
+
+def run_aae_harness(build, schedule, *, scrub_every: int = 1,
+                    detect_within: "int | None" = None,
+                    seg_size: int = 8, quorum: int = 3,
+                    mode: str = "dense", max_rounds: int = 512,
+                    replay: bool = True) -> dict:
+    """The corruption-drill harness: drive a workload through a
+    corruption-carrying fault timeline with an
+    :class:`~lasp_tpu.aae.AAEScrubber` attached, then assert
+    :func:`check_corruption_detected_and_repaired` (detection within
+    ``detect_within`` rounds — default the scrub cadence — exact
+    localization, full repair, twin bit-equality) and, with
+    ``replay=True``, that a second identical run reproduces the
+    detection ledger and final fingerprint bit-for-bit.
+
+    ``build()`` is the usual fresh-identically-seeded-runtime builder
+    (the ``run_harness`` contract). Returns the merged report:
+    detection latencies, repair traffic vs a full-state resync, hash
+    work by mode, incident count."""
+    from ..aae import AAEScrubber
+
+    if scrub_every > 1 and mode != "frontier":
+        # dense stepping marks EVERY row dirty each active round (the
+        # conservative degrade), so any between-scrub gossip commits a
+        # corrupt row's hash as the new baseline — laundered before the
+        # next verify could see it. The detection-within-cadence
+        # guarantee this harness asserts therefore only exists at
+        # cadence 1 under dense stepping; frontier's exact dirty
+        # tracking extends it to rows untouched between scrubs
+        # (docs/RESILIENCE.md "Active anti-entropy").
+        raise ValueError(
+            f"scrub_every={scrub_every} with mode={mode!r} cannot "
+            "uphold the detection guarantee (dense all-dirty marks "
+            "launder corruption between scrubs) — use scrub_every=1, "
+            "or mode='frontier' for wider cadences"
+        )
+    if detect_within is None:
+        detect_within = int(scrub_every)
+    rt_free = build()
+    free_rounds = rt_free.run_to_convergence(max_rounds=max_rounds)
+    free_states = snapshot_states(rt_free)
+    del rt_free
+
+    def one_run():
+        rt = build()
+        ch = ChaosRuntime(rt, schedule)
+        sc = AAEScrubber(ch, scrub_every=scrub_every,
+                         seg_size=seg_size, quorum=quorum)
+        while ch.round < max_rounds:
+            residual = ch.step(mode=mode)
+            if (
+                residual == 0
+                and ch.round > schedule.horizon
+                and not sc.pending
+            ):
+                break
+        else:
+            raise InvariantViolation(
+                f"AAE soak did not quiesce within {max_rounds} rounds "
+                f"({len(sc.pending)} repairs pending)"
+            )
+        # closing scrub: verify the final population (a corruption
+        # landing on the very last faulted round must still be caught)
+        sc.scrub(ch.round)
+        rt.run_to_convergence(max_rounds=max_rounds)
+        return rt, ch, sc
+
+    rt1, ch1, sc1 = one_run()
+    checked = check_corruption_detected_and_repaired(
+        rt1, ch1, sc1, free_states, detect_within=detect_within
+    )
+    report = sc1.report()
+    report.update(checked)
+    report.update({
+        "injected": len(ch1.injected_corruptions),
+        "injected_by_kind": {
+            k: sum(1 for r in ch1.injected_corruptions
+                   if r["kind"] == k)
+            for k in {r["kind"] for r in ch1.injected_corruptions}
+        },
+        "rounds": ch1.round,
+        "fault_free_rounds": free_rounds,
+        "healed": not bool(ch1.crashed.any()),
+        "bit_identical_to_fault_free": True,
+        "detected_and_repaired": True,
+    })
+    if replay:
+        rt2, ch2, sc2 = one_run()
+        if sc1.detected != sc2.detected or (
+            ch1.injected_corruptions != ch2.injected_corruptions
+        ):
+            raise InvariantViolation(
+                "AAE replay diverged: the same (seed, schedule) must "
+                "reproduce the injection and detection ledgers exactly"
+            )
+        if fingerprint(snapshot_states(rt1)) != fingerprint(
+            snapshot_states(rt2)
+        ):
+            raise InvariantViolation(
+                "AAE replay reached a different final state"
+            )
+        report["replay_identical"] = True
+    return report
+
+
 def run_harness(build, schedule, mode: str = "dense",
                 max_rounds: int = 512, replay: bool = True,
                 removed_terms: "dict | None" = None,

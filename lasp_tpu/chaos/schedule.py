@@ -125,8 +125,48 @@ class SlowShard(NamedTuple):
     period: int = 3
 
 
+#: silent-corruption mutation kinds (``docs/RESILIENCE.md`` fault
+#: model): ``bitflip`` flips one state bit (a flipped exists-bit —
+#: possibly INFLATIONARY: the one corruption class gossip would spread
+#: outward), ``rollback`` halves a positive counter lane (counter
+#: rollback — non-inflationary), ``truncate`` zeroes the tail half of
+#: the row's last wire plane (truncated dot planes).
+CORRUPTION_KINDS = ("bitflip", "rollback", "truncate")
+
+
+class CorruptRows(NamedTuple):
+    """SILENT corruption at the start of round ``at``: ``n_rows``
+    seeded live replica rows of a seeded variable (``var`` None = drawn
+    over the store) mutate per ``kind`` — directly in device state,
+    bypassing every dirty-tracking path (that is the fault class:
+    bit-rot, a bad kernel, a botched restore). Pure function of
+    ``(seed, schedule, round)`` like every other event; no mask
+    effect. Detection/repair is the AAE layer's job
+    (``lasp_tpu.aae``) — without it, gossip happily joins the
+    corruption outward."""
+
+    at: int
+    kind: str = "bitflip"
+    n_rows: int = 1
+    var: "str | None" = None
+
+
+class BitRot(NamedTuple):
+    """Windowed :class:`CorruptRows`: one seeded injection every
+    ``every``-th round of ``[start, stop)`` — ambient media decay
+    rather than a point event."""
+
+    start: int
+    stop: int
+    every: int = 2
+    kind: str = "bitflip"
+    n_rows: int = 1
+    var: "str | None" = None
+
+
 #: event kinds with a [start, stop) activity window
-_WINDOWED = (Partition, FlakyLinks, DelayLinks, DuplicateLinks, SlowShard)
+_WINDOWED = (Partition, FlakyLinks, DelayLinks, DuplicateLinks,
+             SlowShard, BitRot)
 
 
 def _mix(keys: np.ndarray, salt: int) -> np.ndarray:
@@ -170,6 +210,23 @@ class ChaosSchedule:
             if isinstance(ev, _WINDOWED):
                 if ev.stop <= ev.start:
                     raise ValueError(f"empty fault window: {ev!r}")
+                if isinstance(ev, BitRot):
+                    if ev.kind not in CORRUPTION_KINDS:
+                        raise ValueError(
+                            f"{ev!r}: kind must be one of "
+                            f"{CORRUPTION_KINDS}"
+                        )
+                    if ev.every < 1 or ev.n_rows < 1:
+                        raise ValueError(
+                            f"{ev!r}: every and n_rows must be >= 1"
+                        )
+            elif isinstance(ev, CorruptRows):
+                if ev.kind not in CORRUPTION_KINDS:
+                    raise ValueError(
+                        f"{ev!r}: kind must be one of {CORRUPTION_KINDS}"
+                    )
+                if ev.n_rows < 1:
+                    raise ValueError(f"{ev!r}: n_rows must be >= 1")
             elif isinstance(ev, (Crash, Restore)):
                 if not 0 <= ev.replica < self.n_replicas:
                     raise ValueError(
@@ -224,12 +281,41 @@ class ChaosSchedule:
         return [ev for ev in self._actions_sorted() if ev.at == rnd]
 
     def next_action_round(self, rnd: int) -> "int | None":
-        """First round > ``rnd`` with a crash/restore action (None when
-        the timeline holds no further actions) — fused chaos windows
-        must break there to process the action host-side."""
+        """First round > ``rnd`` with a crash/restore action or a
+        corruption injection (None when the timeline holds no further
+        actions) — fused chaos windows must break there to process the
+        action host-side."""
         future = [ev.at for ev in self.events
                   if isinstance(ev, (Crash, Restore)) and ev.at > rnd]
+        for ev in self.events:
+            if isinstance(ev, CorruptRows) and ev.at > rnd:
+                future.append(ev.at)
+            elif isinstance(ev, BitRot):
+                if rnd < ev.start:
+                    nxt = ev.start
+                else:
+                    k = (rnd - ev.start) // ev.every + 1
+                    nxt = ev.start + k * ev.every
+                if nxt < ev.stop:
+                    future.append(nxt)
         return min(future) if future else None
+
+    def corruptions_at(self, rnd: int) -> list:
+        """Corruption injections due at the START of ``rnd``:
+        ``[(event_index, event, shot), ...]`` where ``shot`` is the
+        occurrence ordinal inside a :class:`BitRot` window (0 for point
+        :class:`CorruptRows`) — the per-occurrence seed column."""
+        out = []
+        for i, ev in enumerate(self.events):
+            if isinstance(ev, CorruptRows) and ev.at == rnd:
+                out.append((i, ev, 0))
+            elif (
+                isinstance(ev, BitRot)
+                and ev.start <= rnd < ev.stop
+                and (rnd - ev.start) % ev.every == 0
+            ):
+                out.append((i, ev, (rnd - ev.start) // ev.every))
+        return out
 
     def crashed_at(self, rnd: int) -> np.ndarray:
         """``bool[R]``: replicas down DURING round ``rnd`` (actions take
@@ -374,9 +460,19 @@ class ChaosSchedule:
 # nemesis presets
 # ---------------------------------------------------------------------------
 
-#: canonical preset names (CLI spelling; underscores accepted too)
+#: canonical preset names (CLI spelling; underscores accepted too).
+#: These are the CRASH/PARTITION-class presets: every one upholds the
+#: full ``run_harness`` invariant suite (inflation + post-heal
+#: bit-equality) with no repair layer attached.
 PRESETS = ("ring-cut", "rolling-crash", "flaky-links", "slow-shard",
            "delay-links")
+
+#: CORRUPTION-class presets (silent state mutation — a different fault
+#: class: without the AAE layer attached nothing detects them and the
+#: fixed point is NOT the fault-free one; see the fault-model table in
+#: docs/RESILIENCE.md). Soaked via ``chaos.invariants.run_aae_harness``
+#: / ``lasp_tpu aae``, never the plain invariant harness.
+CORRUPTION_PRESETS = ("bit-rot", "corrupt-partition")
 
 
 def nemesis(preset: str, n_replicas: int, neighbors, *, seed: int = 0,
@@ -434,9 +530,38 @@ def nemesis(preset: str, n_replicas: int, neighbors, *, seed: int = 0,
             frac=float(kwargs.pop("frac", 0.3)),
             delay=int(kwargs.pop("delay", 2)),
         )]
+    elif name == "bit-rot":
+        # ambient silent corruption: one seeded injection every
+        # `every`-th round of the window (all three mutation kinds
+        # cycle unless pinned) — the fault class only the AAE layer
+        # can detect (docs/RESILIENCE.md "Active anti-entropy")
+        every = int(kwargs.pop("every", 2))
+        n_rows = int(kwargs.pop("n_rows", 1))
+        kind = kwargs.pop("kind", None)
+        if kind is not None:
+            ev = [BitRot(start, stop, every=every, kind=kind,
+                         n_rows=n_rows)]
+        else:
+            ev = [
+                BitRot(start + i, stop, every=every * 3, kind=k,
+                       n_rows=n_rows)
+                for i, k in enumerate(CORRUPTION_KINDS)
+                if start + i < stop
+            ]
+    elif name == "corrupt-partition":
+        # corruption INSIDE a split brain: detection and quorum repair
+        # must both stay confined to the corrupt row's component — the
+        # combined nemesis the acceptance drill runs
+        n_groups = int(kwargs.pop("n_groups", 2))
+        n_rows = int(kwargs.pop("n_rows", 1))
+        ev = [Partition(start, stop, n_groups),
+              CorruptRows(start + 1, kind="bitflip", n_rows=n_rows),
+              CorruptRows(min(start + 3, stop - 1), kind="rollback",
+                          n_rows=n_rows)]
     else:
         raise ValueError(
-            f"unknown nemesis preset {preset!r} (known: {PRESETS})"
+            f"unknown nemesis preset {preset!r} "
+            f"(known: {PRESETS + CORRUPTION_PRESETS})"
         )
     if kwargs:
         raise TypeError(

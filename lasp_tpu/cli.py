@@ -170,16 +170,87 @@ def cmd_quorum(args) -> int:
         (1 + i % max(1, args.rounds), "kv", (i * 11) % args.replicas)
         for i in range(args.reads)
     ]
+    if args.prune_hints and not args.hints:
+        # the harness's in-memory log dies with this process: pruning
+        # it would report 0 while inspecting nothing
+        print("error: --prune-hints needs --hints PATH (only a "
+              "durable hint log outlives the harness to be reclaimed)",
+              file=sys.stderr)
+        return 2
     report = run_quorum_harness(
         build, schedule, writes=writes, reads=reads,
         n=args.n, r=args.r, w=args.w, timeout=args.timeout,
         retries=args.retries, engine=args.engine,
+        hints_path=args.hints,
         replay=not args.no_replay,
     )
+    if args.prune_hints:
+        # the harness's own convergence check just proved the
+        # population absorbed every hinted write — the documented safe
+        # point for a FULL reclaim (per-record reclaim already runs on
+        # every restore via QuorumRuntime's prune_replayed wiring)
+        from lasp_tpu.quorum import HintLog
+
+        report["hints_pruned"] = HintLog(args.hints).prune()
     report["preset"] = args.preset
     report["topology"] = args.topology
     report["replicas"] = args.replicas
     report["quorum_health"] = get_monitor().health().get("quorum")
+    print(json.dumps(report))
+    return 0
+
+
+def cmd_aae(args) -> int:
+    """Active anti-entropy drill (the scrub verb): inject silent
+    corruption via a corruption-class nemesis preset, run the Merkle
+    hash forest + exchange + quorum repair per round, and verify
+    detection/localization/repair plus bit-equality with a fault-free
+    twin (docs/RESILIENCE.md "Active anti-entropy")."""
+    from lasp_tpu.chaos import nemesis
+    from lasp_tpu.chaos.invariants import run_aae_harness
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import random_regular, ring, scale_free
+    from lasp_tpu.mesh.runtime import ReplicatedRuntime
+    from lasp_tpu.store import Store
+    from lasp_tpu.telemetry import get_monitor
+
+    topo = {"ring": ring, "random": random_regular,
+            "scale_free": scale_free}[args.topology]
+    nbrs = topo(args.replicas, args.fanout)
+
+    def build():
+        store = Store(n_actors=max(16, args.writers))
+        var = store.declare(type=args.type, n_elems=args.elems,
+                            id="scrub")
+        rt = ReplicatedRuntime(store, Graph(store), args.replicas, nbrs)
+        rt.update_batch(
+            var,
+            [
+                ((w * args.replicas) // args.writers,
+                 ("add", f"item{w}"), f"writer{w}")
+                for w in range(args.writers)
+            ],
+        )
+        return rt
+
+    schedule = nemesis(
+        args.preset, args.replicas, nbrs, seed=args.seed,
+        rounds=args.rounds,
+    )
+    try:
+        report = run_aae_harness(
+            build, schedule, scrub_every=args.scrub_every,
+            seg_size=args.seg_size, max_rounds=args.max_rounds,
+            mode=args.mode, replay=not args.no_replay,
+        )
+    except ValueError as exc:  # e.g. dense + scrub_every > 1
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    report["preset"] = args.preset
+    report["topology"] = args.topology
+    report["replicas"] = args.replicas
+    report["schedule"] = schedule.describe()
+    report["aae_health"] = get_monitor().health().get("aae")
     print(json.dumps(report))
     return 0
 
@@ -708,6 +779,51 @@ def main(argv=None) -> int:
                     default="batched")
     qu.add_argument("--no-replay", action="store_true",
                     help="skip the replay-determinism second run")
+    qu.add_argument("--hints", default=None, metavar="PATH",
+                    help="durable hint-log path (default: in-memory)")
+    qu.add_argument("--prune-hints", action="store_true",
+                    help="after the harness converges fault-free, "
+                         "reclaim every remaining hint record (safe: "
+                         "the population has verifiably absorbed them) "
+                         "and report the count")
+
+    aae = sub.add_parser(
+        "aae",
+        help="active anti-entropy scrub: inject silent corruption "
+             "(bit-rot / corrupt-partition presets), detect it via the "
+             "Merkle hash forest, localize, quorum-repair, and verify "
+             "the healed population bit-equal to a fault-free twin "
+             "(docs/RESILIENCE.md 'Active anti-entropy')",
+    )
+    # literal list (the no-jax-at-parse rule, like --preset above);
+    # tests/chaos/test_engine.py pins it against CORRUPTION_PRESETS
+    aae.add_argument("--preset", default="bit-rot",
+                     choices=["bit-rot", "corrupt-partition"])
+    aae.add_argument("--replicas", type=int, default=32)
+    aae.add_argument("--topology", choices=["ring", "random",
+                                            "scale_free"],
+                     default="ring")
+    aae.add_argument("--fanout", type=int, default=cfg.fanout)
+    aae.add_argument("--type", default="lasp_gset",
+                     choices=["lasp_gset", "lasp_orset",
+                              "riak_dt_orswot"])
+    aae.add_argument("--elems", type=int, default=64)
+    aae.add_argument("--writers", type=int, default=8)
+    aae.add_argument("--seed", type=int, default=0)
+    aae.add_argument("--rounds", type=int, default=8,
+                     help="corruption-window length in gossip rounds")
+    aae.add_argument("--scrub-every", type=int, default=1,
+                     help="verify/exchange cadence in rounds (bounds "
+                          "detection latency; cadences > 1 require "
+                          "--mode frontier — dense all-dirty marks "
+                          "launder corruption between scrubs)")
+    aae.add_argument("--mode", choices=["dense", "frontier"],
+                     default="dense")
+    aae.add_argument("--seg-size", type=int, default=8,
+                     help="Merkle tree leaves per segment")
+    aae.add_argument("--max-rounds", type=int, default=512)
+    aae.add_argument("--no-replay", action="store_true",
+                     help="skip the replay-determinism second run")
 
     sv = sub.add_parser(
         "serve",
@@ -742,7 +858,8 @@ def main(argv=None) -> int:
     # this against the registry
     scen.add_argument(
         "name",
-        choices=["adcounter_10m", "adcounter_6", "bridge_throughput",
+        choices=["aae_scrub", "adcounter_10m", "adcounter_6",
+                 "bridge_throughput",
                  "chaos_heal", "dataflow_chain", "frontier_sparse",
                  "gset_1k", "many_vars", "orset_100k", "packed_vs_dense",
                  "partitioned_gossip", "pipeline_1m", "quorum_kv",
@@ -838,6 +955,7 @@ def main(argv=None) -> int:
         "bench": cmd_bench,
         "chaos": cmd_chaos,
         "quorum": cmd_quorum,
+        "aae": cmd_aae,
         "serve": cmd_serve,
         "scenario": cmd_scenario,
         "metrics": cmd_metrics,

@@ -317,6 +317,18 @@ class ReplicatedRuntime:
         #: cached group executables were keyed under — see
         #: :meth:`_invalidate_plan`
         self._plan = None
+        #: AAE bookkeeping (``lasp_tpu.aae``): when a HashForest is
+        #: attached it parks its dirty accumulator here and every
+        #: tracked row mutation ORs into it (:meth:`_aae_mark`) — the
+        #: incremental-rehash feed. The epochs mirror the plan
+        #: invalidation triggers: structural events (resize / shard /
+        #: restore / var growth) bump the STATE epoch (committed hashes
+        #: drop), a chaos mask flip bumps the TREE epoch (row hashes
+        #: are state-pure and survive; only the tree levels and the
+        #: exchange pairing rebuild).
+        self._aae_dirty: "dict | None" = None
+        self._aae_state_epoch = 0
+        self._aae_tree_epoch = 0
         self._sync_graph()
 
     def _sync_graph(self) -> None:
@@ -371,6 +383,20 @@ class ReplicatedRuntime:
         # every plan-invalidating event can also change state shapes:
         # the ledger's per-var row-footprint cache rides along
         self._row_bytes_cache.clear()
+        # AAE trees invalidate on the same triggers (before the plan's
+        # own early-return: the event happened whether or not a plan
+        # was compiled). Structural events drop the committed-hash
+        # baseline outright (shapes/census changed); a mask flip only
+        # rebuilds the tree LEVELS (row hashes are state-pure); a
+        # restore needs neither — reseed_row marks the reseeded row
+        # AAE-dirty itself, keeping every OTHER row's baseline live so
+        # corruption near a restore stays detectable.
+        if reason == "mask_change":
+            self._aae_tree_epoch = getattr(self, "_aae_tree_epoch", 0) + 1
+        elif reason != "restore":
+            self._aae_state_epoch = (
+                getattr(self, "_aae_state_epoch", 0) + 1
+            )
         if getattr(self, "_plan", None) is None:
             return
         self._plan = None
@@ -2452,6 +2478,7 @@ class ReplicatedRuntime:
                 raise KeyError(v)
             if rows is None:
                 self._frontier[v] = np.ones(self.n_replicas, dtype=bool)
+                self._aae_mark(v, None)
             else:
                 self._mark_dirty_rows(v, rows)
 
@@ -2460,6 +2487,26 @@ class ReplicatedRuntime:
         if f is None or f.shape[0] != self.n_replicas:
             f = self._frontier[var_id] = np.zeros(self.n_replicas, bool)
         f[np.asarray(rows, dtype=np.int64)] = True
+        self._aae_mark(var_id, rows)
+
+    def _aae_mark(self, var_id: "str | None" = None, rows=None) -> None:
+        """OR tracked row mutations into an attached AAE forest's dirty
+        accumulator (``var_id`` None = every variable; ``rows`` None =
+        every row). A no-op (one attribute read) when no forest is
+        attached — the hot-path contract. Mutations that bypass this
+        (direct state surgery without :meth:`mark_dirty`) are exactly
+        what the AAE verify pass flags as silent corruption."""
+        d = self._aae_dirty
+        if d is None:
+            return
+        for v in ((var_id,) if var_id is not None else self.var_ids):
+            m = d.get(v)
+            if m is None or m.shape[0] != self.n_replicas:
+                m = d[v] = np.zeros(self.n_replicas, dtype=bool)
+            if rows is None:
+                m.fill(True)
+            else:
+                m[np.asarray(rows, dtype=np.int64)] = True
 
     def _frontier_sync_mask(self, edge_mask) -> None:
         """Frontier knowledge is only valid relative to the edge_mask it
@@ -2501,13 +2548,18 @@ class ReplicatedRuntime:
         nonzero changed unknown rows (all-dirty)."""
         for v, r in zip(self.var_ids, np.asarray(res_vec).tolist()):
             self._frontier_fill(v, bool(r))
+            if r:
+                self._aae_mark(v, None)
 
     def _frontier_after_opaque(self, quiescent: bool) -> None:
         """After a fused block / on-device while dispatch, per-row
         knowledge never reached the host: quiescence clears every
-        frontier, anything else degrades them all to all-dirty."""
+        frontier, anything else degrades them all to all-dirty. AAE
+        dirtiness degrades UNCONDITIONALLY — a block that quiesced
+        still changed rows on the way to its fixed point."""
         for v in self.var_ids:
             self._frontier_fill(v, not quiescent)
+            self._aae_mark(v, None)
 
     def frontier_size(self, var_id: str) -> int:
         """Current dirty-row count of one variable's frontier."""
@@ -2633,6 +2685,8 @@ class ReplicatedRuntime:
             touched = int(rows.size)
             dense = 0
         self._frontier[v] = changed_mask
+        if changed_mask.any():
+            self._aae_mark(v, np.flatnonzero(changed_mask))
         return int(changed_mask.sum()), touched, 0, dense, 1
 
     def _frontier_round_pervar(self, edge_mask) -> dict:
@@ -2731,6 +2785,8 @@ class ReplicatedRuntime:
                     mask = np.array(changed[i])
                     self._frontier[v] = mask
                     changed_of[v] = int(mask.sum())
+                    if changed_of[v]:
+                        self._aae_mark(v, np.flatnonzero(mask))
             if sparse_subset:
                 max_rows = max(r.size for _v, r in sparse_subset)
                 bucket = max(self._frontier_bucket(max_rows), max_rows)
@@ -2752,6 +2808,8 @@ class ReplicatedRuntime:
                     mask[rows[ch]] = True
                     self._frontier[v] = mask
                     changed_of[v] = int(mask.sum())
+                    if changed_of[v]:
+                        self._aae_mark(v, rows[ch])
         return {
             "per_var_changed": [changed_of.get(v, 0) for v in self.var_ids],
             "rows_touched": rows_touched,
@@ -4012,8 +4070,15 @@ class ReplicatedRuntime:
             )
         # row-level change provenance is gone population-wide (peers must
         # re-deliver to the reseeded row even if quiescent): all-dirty,
-        # the same conservative degrade resize and checkpoint restore use
-        self.mark_dirty()
+        # the same conservative degrade resize and checkpoint restore
+        # use. AAE dirtiness stays ROW-SCOPED on purpose: only the
+        # reseeded row's STATE changed — frontier all-dirty is about
+        # delivery knowledge, and marking every row AAE-dirty here
+        # would blind the verify pass to corruption landing the same
+        # round as a restore.
+        for v in self.var_ids:
+            self._frontier_fill(v, True)
+        self._aae_mark(None, [replica])
         # checkpoint-row restore invalidates the plan too (the grouping
         # is unchanged in practice, but the recompile-or-degrade rule is
         # uniform across every state-surgery event — the walk is cheap)

@@ -401,10 +401,19 @@ class QuorumRuntime:
         residual = self.ch.step(mode=self.mode)
         for replica in self.ch.last_restored:
             handed = self.hints.replay(self.rt, replica)
-            self.trace.append((rnd, -1, "handoff", (int(replica), handed)))
+            # post-replay reclaim: records this restore just re-acked
+            # at FULL preflist strength stop accumulating across
+            # repeat crashes (records still short of N live holders
+            # stay load-bearing — the no-write-lost contract)
+            pruned = self.hints.prune_replayed(
+                self.rt, replica, live=~self.ch.crashed
+            )
+            self.trace.append(
+                (rnd, -1, "handoff", (int(replica), handed, pruned))
+            )
             tel_events.emit(
                 "quorum", replica=int(replica), action="hinted_handoff",
-                rows=handed, round=rnd,
+                rows=handed, pruned=pruned, round=rnd,
             )
         with span("quorum.step", round=rnd):
             out = self._fsm_step(rnd)

@@ -139,3 +139,77 @@ class HintLog:
             help="hinted-handoff records held for crashed-replica catch-up",
         ).set(0)
         return n
+
+    def _reindex(self, keep: list) -> None:
+        """Replace the record set (prune rewrite): in-memory index and
+        the durable file both rebuild from the survivors."""
+        self.records = []
+        self._by_replica = {}
+        for rec in keep:
+            self._index(rec)
+        if self.path is not None:
+            tmp = self.path + ".prune"
+            with open(tmp, "wb") as fp:
+                for rec in self.records:
+                    pickle.dump(rec, fp)
+                fp.flush()
+                os.fsync(fp.fileno())
+            os.replace(tmp, self.path)
+        gauge(
+            "quorum_hints_pending",
+            help="hinted-handoff records held for crashed-replica catch-up",
+        ).set(len(self.records))
+
+    def prune_replayed(self, runtime, replica: int,
+                       live=None) -> int:
+        """Reclaim the hints a completed replay has RE-ACKED at full
+        preflist strength: a record naming ``replica`` drops iff EVERY
+        replica its preflist names is live and that replica's row
+        already dominates the hinted row (the join would be an exact
+        no-op — the write is held at all N homes again, the riak
+        delete-after-handoff point). Anything weaker stays: a record
+        whose preflist still has a crashed or lagging member remains
+        load-bearing for the next bottom-restore (the
+        no-acknowledged-write-lost contract). Returns records
+        reclaimed. Called from the quorum engine's post-replay restore
+        hook; repeat crashes therefore no longer accumulate fully
+        re-acked records without bound."""
+        import jax
+
+        pending = self._by_replica.get(int(replica))
+        if not pending:
+            return 0
+        if live is None:
+            live = np.ones(runtime.n_replicas, dtype=bool)
+        live = np.asarray(live, dtype=bool)
+        drop: set = set()
+        for i in pending:
+            var_id, picks, row, _rid = self.records[i]
+            if var_id not in runtime.var_ids:
+                continue
+            if not live[np.asarray(picks, dtype=np.int64)].all():
+                continue
+            pop = runtime._population(var_id)
+            codec, spec = runtime._mesh_meta(var_id)
+            dominated = True
+            for p in picks:
+                cur = jax.tree_util.tree_map(
+                    lambda x: x[int(p)], pop
+                )
+                merged = codec.merge(spec, cur, row)
+                if not bool(codec.equal(spec, merged, cur)):
+                    dominated = False
+                    break
+            if dominated:
+                drop.add(i)
+        if not drop:
+            return 0
+        self._reindex(
+            [rec for i, rec in enumerate(self.records) if i not in drop]
+        )
+        counter(
+            "quorum_hints_pruned_total",
+            help="hinted-handoff records reclaimed after full-preflist "
+                 "re-ack (post-replay restore path)",
+        ).inc(len(drop))
+        return len(drop)

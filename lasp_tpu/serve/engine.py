@@ -71,7 +71,8 @@ class ServeFrontend:
     def __init__(self, runtime, *, admission: "AdmissionController | None" = None,
                  gossip_block: int = 4, coalesce_max: int = 2048,
                  clock=None, chaos_mode: str = "dense",
-                 write_backup: bool = True):
+                 write_backup: bool = True,
+                 aae=None, scrub_every: int = 16):
         from ..chaos import ChaosRuntime
 
         if isinstance(runtime, ChaosRuntime):
@@ -80,6 +81,27 @@ class ServeFrontend:
         else:
             self.chaos = None
             self.rt = runtime
+        #: background anti-entropy (``lasp_tpu.aae.AAEScrubber``): every
+        #: ``scrub_every``-th cycle runs one scrub AFTER the cycle's
+        #: client work — but only while the degradation ladder sits
+        #: below the shed-reads rung (level < 1): under pressure,
+        #: client traffic outranks hygiene and the skipped scrub is
+        #: counted, not silently dropped. A chaos-wrapped runtime whose
+        #: scrubber auto-attached to the engine hooks scrubs in-round
+        #: instead — pass ``auto_attach=False`` there to let the
+        #: front-end own the cadence.
+        self.aae = aae
+        self.scrub_every = max(1, int(scrub_every))
+        self.scrubs_run = 0
+        self.scrubs_skipped = 0
+        #: when set, the admission controller's drain-rate EWMA is fed
+        #: THIS many seconds per cycle instead of measured wall time —
+        #: simulated-clock harnesses set it to their tick length so
+        #: retry_after hints become backlog/throughput in simulated
+        #: time and two same-seed runs produce identical shed/retry
+        #: traces (wall jitter would otherwise skew the retry
+        #: schedule). Telemetry histograms still record real wall time.
+        self.admission_cycle_seconds: "float | None" = None
         self.store = self.rt.store
         self.admission = admission or AdmissionController()
         self.subs = SubscriptionTable()
@@ -226,7 +248,33 @@ class ServeFrontend:
                 drained = (
                     applied + resolved + len(parked) + fired + expired
                 )
-        level = self.admission.observe_cycle(ct.elapsed, drained)
+                if (
+                    self.aae is not None
+                    and self.cycles % self.scrub_every
+                    == self.scrub_every - 1
+                ):
+                    # scrubbing coexists with serving UNDER the
+                    # admission ladder: any climb above normal defers
+                    # the scrub to a calmer cycle (counted)
+                    if self.admission.level < 1:
+                        self.aae.scrub()
+                        self.scrubs_run += 1
+                        outcome = "run"
+                    else:
+                        self.scrubs_skipped += 1
+                        outcome = "deferred"
+                    counter(
+                        "aae_background_scrubs_total",
+                        help="serving-cycle background AAE scrubs, by "
+                             "outcome (run, or deferred because the "
+                             "degradation ladder was above normal)",
+                        outcome=outcome,
+                    ).inc()
+        level = self.admission.observe_cycle(
+            ct.elapsed if self.admission_cycle_seconds is None
+            else self.admission_cycle_seconds,
+            drained,
+        )
         self.cycles += 1
         histogram(
             "serve_cycle_seconds",
@@ -612,6 +660,8 @@ class ServeFrontend:
                 "watch_fires": self.watch_fires,
                 "watch_parked": len(self.subs),
                 "unreplicated_acks": self.unreplicated_acks,
+                "aae_scrubs": self.scrubs_run,
+                "aae_scrubs_deferred": self.scrubs_skipped,
                 "latency": latency,
                 "overlap_seconds": round(self._overlap_seconds, 6),
                 "gossip_rounds": self._gossip_rounds,

@@ -90,6 +90,23 @@ def _zipf_cdf(n: int, s: float) -> np.ndarray:
     return np.cumsum(w / w.sum())
 
 
+def client_seed(run_seed: int, client: int) -> int:
+    """Each simulated client's RNG seed as a pure function of
+    ``(run seed, client id)`` — counter-based (the chaos ``_mix``
+    discipline), so a client's behavior replays identically on any
+    host regardless of which OTHER clients ran or in what order. This
+    is what makes two same-seed harness runs produce identical
+    offered/shed/outcome traces (asserted by tests/serve/test_load.py
+    ``test_same_seed_runs_are_replay_identical``)."""
+    from ..chaos.schedule import _mix
+
+    u = _mix(
+        np.asarray([client + 1], dtype=np.uint64),
+        int(run_seed) * 1_000_003 + 0x5EED,
+    )[0]
+    return int(u * (1 << 31))
+
+
 def threshold_parity(rt, var_id: str, n: int, *, seed: int = 0) -> dict:
     """Vectorized-vs-per-watch parity at ``n`` registered thresholds:
     two identically-registered subscription tables over the live
@@ -156,6 +173,7 @@ def run_load(
     gossip_block: int = 4,
     parity_thresholds: int = 0,
     seed_watches: int = 0,
+    record_trace: bool = False,
 ) -> dict:
     """One full open-loop run; see the module doc. Returns the load
     report (the ``serve_load`` artifact body)."""
@@ -191,6 +209,11 @@ def run_load(
         gossip_block=gossip_block,
         clock=lambda: float(tick),
     )
+    # the whole harness runs on the simulated tick clock — the
+    # admission drain-rate EWMA must too, or retry_after hints (and so
+    # the clients' retry schedule) would ride wall-clock jitter and
+    # break same-seed replay determinism
+    fe.admission_cycle_seconds = MS_PER_TICK / 1000.0
 
     var_cdf = _zipf_cdf(n_vars, zipf_s)
     key_cdf = _zipf_cdf(key_space, zipf_s)
@@ -232,36 +255,54 @@ def run_load(
                 retry_q.append((due, kind, args, attempts + 1))
         return t
 
+    #: per-client RNGs, lazily seeded from (run seed, client id): a
+    #: client's request stream is ITS OWN pure function of the run seed
+    #: (never of global draw order) — the replay-determinism contract
+    client_rngs: dict = {}
+
+    def _crng(c: int) -> np.random.RandomState:
+        r = client_rngs.get(c)
+        if r is None:
+            r = client_rngs[c] = np.random.RandomState(
+                client_seed(seed, c)
+            )
+        return r
+
     def _mk_request(c: int):
-        r = float(rng.random_sample())
-        replica = int(rng.randint(n_replicas))
+        crng = _crng(c)
+        r = float(crng.random_sample())
+        replica = int(crng.randint(n_replicas))
         deadline = float(tick + deadline_ticks)
         if r < mix[0]:
-            v = gset_vars[int(np.searchsorted(var_cdf, rng.random_sample()))]
-            if rng.random_sample() < 0.15:
+            v = gset_vars[int(np.searchsorted(var_cdf, crng.random_sample()))]
+            if crng.random_sample() < 0.15:
                 # one counter actor per target replica: gcounter lanes
                 # are writer identities, and a lane minted at two rows
                 # would max-merge away increments (the actor-discipline
                 # rule, mesh/runtime.py update_at)
                 return (rq.WRITE, ((ctr, ("increment",), f"a{replica}"),
                                    {"replica": replica}))
-            key = int(np.searchsorted(key_cdf, rng.random_sample()))
+            key = int(np.searchsorted(key_cdf, crng.random_sample()))
             return (rq.WRITE, ((v, ("add", f"k{key}"), f"c{c}"),
                                {"replica": replica}))
         if r < mix[0] + mix[1]:
-            v = gset_vars[int(np.searchsorted(var_cdf, rng.random_sample()))]
-            prio = rq.PRIO_LOW if rng.random_sample() < 0.5 else rq.PRIO_NORMAL
+            v = gset_vars[int(np.searchsorted(var_cdf, crng.random_sample()))]
+            prio = (
+                rq.PRIO_LOW if crng.random_sample() < 0.5
+                else rq.PRIO_NORMAL
+            )
             return (rq.READ, ((v,), {"replica": replica,
                                      "deadline": deadline,
                                      "priority": prio}))
         # watch: a counter threshold slightly ahead of the current
         # acked total — fires as the workload advances
-        ahead = int(rng.randint(1, 50))
+        ahead = int(crng.randint(1, 50))
         base = fe.completed[rq.WRITE] // 8
         return (rq.WATCH, ((ctr, Threshold(base + ahead)),
                            {"replica": replica, "deadline": deadline}))
 
     depth_curve = []
+    trace: list = []
     for tick in range(ticks):
         factor = burst_factor if tick in burst_window else 1
         # due retries first (they were promised capacity "later")
@@ -280,6 +321,23 @@ def run_load(
         )
         max_inflight = max(max_inflight, offered - terminal)
         depth_curve.append(sum(fe.admission.depths().values()))
+        if record_trace:
+            # the replay-determinism witness: the full per-tick
+            # offered/shed/outcome accounting (two same-seed runs must
+            # produce EQUAL traces — tests/serve/test_load.py)
+            trace.append({
+                "tick": tick,
+                "offered": dict(fe.offered),
+                "completed": dict(fe.completed),
+                "errors": dict(fe.errors),
+                "expired": dict(fe.expired),
+                "shed": {
+                    f"{k}:{r}": n
+                    for (k, r), n in sorted(fe.sheds.items())
+                },
+                "retries": client_retries,
+                "gave_up": gave_up,
+            })
     tick = ticks
     # drain the backlog, heal, converge — then the invariant gate
     fe.drain(max_cycles=512)
@@ -346,6 +404,7 @@ def run_load(
         "acked_writes": sum(len(ts) for ts in fe.acked_terms.values()),
         "no_write_lost": True,
         "threshold_parity": parity,
+        "trace": trace if record_trace else None,
     }
     if chaos:
         report["chaos"] = {

@@ -100,6 +100,10 @@ class ConvergenceMonitor:
         #: counts, parked watches, degradation-ladder level —
         #: serve.ServeFrontend.report); empty until a front-end reports
         self.serve: dict = {}
+        #: latest active-anti-entropy report (detections, incidents,
+        #: repair traffic, hash work — aae.AAEScrubber.report); empty
+        #: until a scrubber reports
+        self.aae: dict = {}
         self._tel: "dict | None" = None
 
     def _check_generation(self) -> None:
@@ -226,6 +230,18 @@ class ConvergenceMonitor:
             self._check_generation()
             self.serve.update(report)
             self.serve["round"] = self.round
+
+    def observe_aae(self, **report) -> None:
+        """Fold an active-anti-entropy report into the health surface —
+        scrub counts, corruption detections/incidents, pending and
+        applied repairs, repair-vs-resync traffic, and hash work by
+        mode from ``aae.AAEScrubber.report`` land under the snapshot's
+        ``aae`` key (the ``{health}`` verb and ``lasp_tpu top`` read it
+        alongside ``chaos``/``quorum``/``serve``)."""
+        with self._lock:
+            self._check_generation()
+            self.aae.update(report)
+            self.aae["round"] = self.round
 
     def observe_membership(self, kind: str, old_n: int, new_n: int) -> None:
         with self._lock:
@@ -497,6 +513,7 @@ class ConvergenceMonitor:
                 "chaos": dict(self.chaos),
                 "quorum": dict(self.quorum),
                 "serve": dict(self.serve),
+                "aae": dict(self.aae),
                 "residual_curve": curve[-64:],
                 "memberships": list(self.memberships),
                 "probe": self.last_probe,

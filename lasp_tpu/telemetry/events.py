@@ -62,6 +62,7 @@ EVENT_TYPES = frozenset({
     "chaos",           # fault injected/healed, crash/restore, degraded read
     "quorum",          # quorum FSM round summary / hinted handoff replay
     "serve",           # one serving-cycle summary (writes/reads/fires/shed)
+    "aae",             # anti-entropy scrub/detect/incident lifecycle
 })
 
 _lock = threading.Lock()

@@ -130,10 +130,12 @@ def _measure(n_replicas: int, step_samples: int,
     dataflow = _measure_dataflow(
         step_samples, max(emission_samples // 3, 200)
     )
+    aae = _measure_aae(step_samples, max(emission_samples // 3, 200))
     return {
         "frontier": frontier,
         "ledger": ledger,
         "dataflow": dataflow,
+        "aae": aae,
         "event_emit_cost_s": round(event_cost, 9),
         "event_log": {
             k: _events.stats()[k] for k in ("ring_size", "deep")
@@ -303,6 +305,91 @@ def _measure_dataflow(step_samples: int, emission_samples: int,
         "edges": len(g.edges),
         "sweeps_per_propagate": depth + 1,
         "emission_samples": emission_samples,
+    }
+
+
+def _measure_aae(step_samples: int, emission_samples: int,
+                 n_replicas: int = 256, n_vars: int = 24) -> dict:
+    """Incremental-rehash arm of the guard (the AAE tentpole's hot
+    path): with a hash forest attached, every executed round pays one
+    ``HashForest.refresh()`` — the incremental tree commit. The
+    CONTRACT is that quiescent variables and clean segments cost
+    nothing (a dict walk, no device work), so the 5%-budget figure is
+    the steady-state refresh priced against an active frontier round;
+    the dirty-row arm (gather + hash of the hot rows only) and the
+    from-scratch full rebuild ride in the artifact as the incremental-
+    vs-full comparison the ``aae_scrub`` bench scenario re-measures at
+    its own shapes."""
+    from ..aae import HashForest
+    from ..dataflow import Graph
+    from ..mesh import ReplicatedRuntime
+    from ..mesh.topology import random_regular
+    from ..store import Store
+
+    prev = _registry.enabled()
+    store = Store(n_actors=4)
+    ids = [
+        store.declare(id=f"v{i}", type="lasp_gset", n_elems=16)
+        for i in range(n_vars)
+    ]
+    rt = ReplicatedRuntime(
+        store, Graph(store), n_replicas,
+        random_regular(n_replicas, 3, seed=11),
+    )
+    for i, v in enumerate(ids):
+        rt.update_batch(v, [(i % n_replicas, ("add", "x"), f"a{i}")])
+    # denominator FIRST, before any forest attaches: the round must not
+    # carry the very cost the numerator isolates
+    rt.frontier_step()  # compile + warm
+
+    def one_active_round():
+        for i, vid in enumerate(ids):
+            rt._mark_dirty_rows(vid, [i % n_replicas])
+        rt.frontier_step()
+
+    _registry.set_enabled(False)
+    try:
+        round_s = min(_timed(one_active_round) for _ in range(step_samples))
+    finally:
+        _registry.set_enabled(prev)
+
+    forest = HashForest(rt)
+    forest.refresh()  # commit the baseline + warm the hash kernels
+    t0 = time.perf_counter()
+    for _ in range(emission_samples):
+        forest.refresh()  # every var quiescent: the steady-state cost
+    quiescent_cost = (time.perf_counter() - t0) / emission_samples
+
+    hot = [0, n_replicas // 2]
+
+    def dirty_refresh():
+        for v in ids[: max(2, n_vars // 8)]:  # a few hot vars
+            rt._aae_mark(v, hot)
+        forest.refresh()
+
+    dirty_refresh()  # warm the subset kernel
+    dirty_s = min(_timed(dirty_refresh) for _ in range(step_samples))
+
+    def full_rebuild():
+        for v in ids:
+            rt._aae_mark(v, None)
+        forest.refresh()
+
+    full_rebuild()
+    full_s = min(_timed(full_rebuild) for _ in range(step_samples))
+    return {
+        "refresh_cost_quiescent_s": round(quiescent_cost, 9),
+        "round_seconds": round(round_s, 6),
+        "overhead_frac": round(
+            quiescent_cost / round_s if round_s > 0 else 0.0, 4
+        ),
+        "dirty_refresh_seconds": round(dirty_s, 6),
+        "full_rebuild_seconds": round(full_s, 6),
+        "incremental_vs_full": round(
+            full_s / dirty_s if dirty_s > 0 else 0.0, 2
+        ),
+        "n_vars": n_vars,
+        "n_replicas": n_replicas,
     }
 
 

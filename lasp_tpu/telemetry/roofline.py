@@ -84,6 +84,7 @@ FAMILIES = (
     "boundary_exchange",
     "dataflow_fused",
     "quorum_step",
+    "aae_hash",
 )
 
 
@@ -267,6 +268,20 @@ def kernel_traffic(
         lo = F * (8 + 4 * K)
         hi = 4 * moved + pad
         return TrafficEstimate(moved, lo, hi, F * K)
+
+    if family == "aae_hash":
+        # the AAE row-hash kernel (aae.hashtree): per hashed row one
+        # full state-row read plus a 4-byte hash out, stacked G-wide
+        # for plan-group dispatches; ``rows`` is the rows hashed
+        # (bucket-padded subsets move their pad slots too). The hi
+        # bound covers the uint32 word-view materialization the XLA
+        # lowering may pay on bool planes. No joins — hashing reads,
+        # never merges.
+        F = int(rows or 0)
+        moved = G * F * (int(row_bytes) + 4)
+        lo = G * F * int(row_bytes)
+        hi = 3 * G * F * (int(row_bytes) + 4) + pad
+        return TrafficEstimate(moved, lo, hi, 0)
 
     # boundary_exchange: the partitioned round's wire+local traffic —
     # local read+write of the population plus the cut rows crossing the

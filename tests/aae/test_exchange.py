@@ -1,0 +1,81 @@
+"""Tree exchange: root fast path, exact localization, component
+confinement, and the O(log)-comparison walk."""
+
+import numpy as np
+
+from lasp_tpu.aae import HashForest, exchange_pair, sweep
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime
+from lasp_tpu.mesh.topology import ring
+from lasp_tpu.store import Store
+
+R = 12
+
+
+def _runtime(n_vars=6):
+    store = Store(n_actors=8)
+    for i in range(n_vars):
+        store.declare(id=f"v{i}", type="lasp_gset", n_elems=16)
+    rt = ReplicatedRuntime(store, Graph(store), R, ring(R, 2))
+    return rt
+
+
+def _diverge(rt, var, row, elem=7):
+    """Make ONE row of one var differ (a tracked write that has not
+    gossiped yet)."""
+    rt.update_at(row, var, ("add", f"d{elem}"), f"w{elem}")
+
+
+def test_converged_population_exchanges_in_one_root_comparison():
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()
+    out = exchange_pair(forest, 2, 9)
+    assert out["divergent"] == [] and out["comparisons"] == 1
+    sw = sweep(forest)
+    assert sw["divergent"] == {}
+    # stride-1 early exit: one pairing round, R root comparisons
+    assert sw["rounds"] == 1 and sw["comparisons"] == R
+
+
+def test_exchange_localizes_exactly_the_divergent_var():
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()
+    _diverge(rt, "v3", 5)
+    forest.refresh()
+    out = exchange_pair(forest, 5, 6)
+    assert out["divergent"] == ["v3"]
+    # the walk descended: root + all segments + one segment's leaves
+    assert out["comparisons"] > 1
+    sw = sweep(forest)
+    assert set(sw["divergent"]) == {"v3"}
+    assert 5 in sw["divergent"]["v3"]
+
+
+def test_sweep_respects_components():
+    """Divergence across a partition cut is NOT paired — exchange
+    through the cut would be the side channel the chaos discipline
+    forbids."""
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()
+    _diverge(rt, "v1", 2)  # rows 0..5 = component 0, 6..11 = comp 1
+    forest.refresh()
+    comp = np.asarray([0] * 6 + [6] * 6, dtype=np.int32)
+    sw = sweep(forest, components=comp)
+    # row 2 diverges only against ITS component's members
+    assert all(r < 6 for r in sw["divergent"]["v1"])
+    assert sw["components"] == 2
+
+
+def test_sweep_skips_crashed_rows():
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()
+    _diverge(rt, "v0", 4)
+    forest.refresh()
+    live = np.ones(R, dtype=bool)
+    live[4] = False  # the divergent row is down: frozen, not exchanged
+    sw = sweep(forest, live=live)
+    assert sw["divergent"] == {}

@@ -1,0 +1,158 @@
+"""HashForest: row-hash sensitivity, incremental-vs-full agreement,
+plan-group dispatch, segment reuse, and epoch lifecycle."""
+
+import numpy as np
+import pytest
+
+from lasp_tpu.aae import HashForest, group_row_hashes, row_hashes
+from lasp_tpu.aae.hashtree import subset_row_hashes
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime
+from lasp_tpu.mesh.topology import ring
+from lasp_tpu.store import Store
+
+R = 10
+
+
+def _runtime(packed=False, n_gsets=3):
+    store = Store(n_actors=8)
+    for i in range(n_gsets):
+        store.declare(id=f"g{i}", type="lasp_gset", n_elems=24)
+    store.declare(id="o", type="riak_dt_orswot", n_elems=12, n_actors=4)
+    store.declare(id="p", type="lasp_orset", n_elems=12,
+                  tokens_per_actor=4)
+    rt = ReplicatedRuntime(store, Graph(store), R, ring(R, 2),
+                           packed=packed)
+    for i in range(n_gsets):
+        rt.update_at(i % R, f"g{i}", ("add", f"e{i}"), f"w{i}")
+    rt.update_at(1, "o", ("add", "x"), "a0")
+    rt.update_at(2, "p", ("add", "y"), "b0")
+    return rt
+
+
+@pytest.mark.parametrize("packed", [False, True])
+@pytest.mark.parametrize("var", ["g0", "o", "p"])
+def test_row_hash_detects_any_single_bit_flip(packed, var):
+    """The mixer is a bijection: flipping ONE bit of ONE wire word
+    changes that row's hash with certainty — never just whp."""
+    import jax
+
+    rt = _runtime(packed=packed)
+    pop = rt._population(var)
+    before = row_hashes(pop)
+    leaves = jax.tree_util.tree_leaves(pop)
+    host = np.array(np.asarray(leaves[0]))
+    flat = host.reshape(R, -1)
+    if flat.dtype == np.bool_:
+        flat[4, 0] = ~flat[4, 0]
+    else:
+        flat[4, 0] = flat[4, 0] ^ flat.dtype.type(1)
+    mutated = jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(pop),
+        [host] + [np.asarray(x) for x in leaves[1:]],
+    )
+    after = row_hashes(mutated)
+    assert after[4] != before[4]
+    mask = np.ones(R, dtype=bool)
+    mask[4] = False
+    assert np.array_equal(after[mask], before[mask])
+
+
+def test_subset_hashes_match_full():
+    rt = _runtime()
+    pop = rt._population("o")
+    full = row_hashes(pop)
+    rows = np.asarray([0, 3, 7], dtype=np.int64)
+    assert np.array_equal(subset_row_hashes(pop, rows), full[rows])
+
+
+def test_grouped_hashes_match_pervar():
+    from lasp_tpu.mesh.plan import stack_group
+
+    rt = _runtime(n_gsets=4)
+    ids = [f"g{i}" for i in range(4)]
+    stacked = stack_group([rt._population(v) for v in ids])
+    mat = group_row_hashes(stacked)
+    for i, v in enumerate(ids):
+        assert np.array_equal(mat[i], row_hashes(rt._population(v)))
+
+
+def test_quiescent_refresh_costs_nothing():
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()  # commit the baseline
+    out = forest.refresh()
+    assert out["rows_hashed"] == 0 and out["vars_touched"] == 0
+
+
+def test_incremental_refresh_matches_full_rebuild():
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()
+    rt.update_at(5, "g1", ("add", "fresh"), "w9")  # marks row 5 dirty
+    out = forest.refresh()
+    assert 0 < out["rows_hashed"] < R  # the incremental arm ran
+    inc = {v: forest.committed[v].copy() for v in forest.var_order}
+    # from-scratch twin forest over the same population
+    twin = HashForest(rt)
+    twin.refresh()
+    for v in forest.var_order:
+        assert np.array_equal(inc[v], twin.committed[v]), v
+    assert np.array_equal(forest.roots, twin.roots)
+
+
+def test_clean_segments_are_not_rehashed():
+    rt = _runtime(n_gsets=12)  # > 2 segments at seg_size=4
+    forest = HashForest(rt, seg_size=4)
+    forest.refresh()
+    base = forest.segments_rehashed
+    rt.update_at(0, "g0", ("add", "zz"), "wz")  # leaf 0 -> segment 0
+    forest.refresh()
+    assert forest.segments_rehashed == base + 1  # only segment 0
+
+
+def test_verify_flags_untracked_mutation_exactly():
+    import jax
+    import jax.numpy as jnp
+
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()
+    # silent mutation: direct state write, no mark_dirty / _aae_mark
+    pop = rt.states["g0"]
+    rt.states["g0"] = pop._replace(mask=pop.mask.at[6, 3].set(True))
+    out = forest.refresh(verify=True)
+    assert out["corrupt"] == [("g0", 6)]
+    # tracked mutations are never flagged
+    rt.update_at(2, "g0", ("add", "ok"), "wk")
+    out = forest.refresh(verify=True)
+    assert out["corrupt"] == []
+
+
+def test_structural_epoch_resyncs_and_mask_epoch_keeps_baseline():
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()
+    committed_before = {
+        v: forest.committed[v].copy() for v in forest.var_order
+    }
+    rt._invalidate_plan("mask_change")
+    forest.refresh()
+    for v in forest.var_order:  # baseline survives a mask flip
+        assert np.array_equal(forest.committed[v], committed_before[v])
+    rt._invalidate_plan("resize")
+    forest.refresh()
+    # resync happened: everything went dirty and recommitted (values
+    # equal — state unchanged — but the pass was a full rehash)
+    assert forest.rows_hashed["full"] > 0
+
+
+def test_late_declared_variable_joins_the_forest():
+    rt = _runtime()
+    forest = HashForest(rt)
+    forest.refresh()
+    rt.store.declare(id="late", type="lasp_gset", n_elems=8)
+    rt._population("late")  # sync the late declare
+    forest.refresh()
+    assert "late" in forest.var_order
+    assert forest.committed["late"].shape == (R,)

@@ -31,6 +31,7 @@ def test_scenario_registry_complete():
         "quorum_kv",
         "chaos_heal",
         "serve_load",
+        "aae_scrub",
     }
 
 
@@ -250,3 +251,23 @@ def test_serve_load_small():
         + sum(out["expired"].values()) + sum(out["shed"].values())
     )
     assert offered == terminal + out["watch_parked_final"]
+
+
+def test_aae_scrub_small():
+    """The aae_scrub artifact shape: per-preset detection latency,
+    repair-vs-resync traffic, incremental-vs-full rehash cost — with
+    the corruption drill invariant asserted in-scenario for EVERY
+    nemesis preset (CorruptRows overlays on the crash/partition class,
+    the corruption presets natively)."""
+    from lasp_tpu.bench_scenarios import aae_scrub
+    from lasp_tpu.chaos import CORRUPTION_PRESETS, PRESETS
+
+    out = aae_scrub(n_replicas=16, rounds=6)
+    assert set(out["presets"]) == set(PRESETS) | set(CORRUPTION_PRESETS)
+    for preset, rep in out["presets"].items():
+        assert rep["detected_and_repaired"], preset
+        assert rep["injected"] >= 1, preset
+        assert rep["detection_latency_rounds_max"] <= 1, preset
+        assert rep["repair_frac_of_resync"] < 1.0, preset
+    rh = out["rehash"]
+    assert rh["incremental_seconds"] > 0 and rh["full_seconds"] > 0

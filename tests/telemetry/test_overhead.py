@@ -34,3 +34,12 @@ def test_telemetry_overhead_under_5_percent():
     assert out["dataflow"]["propagate_seconds"] > 0
     assert out["dataflow"]["emission_cost_per_propagate_s"] >= 0
     assert out["dataflow"]["overhead_frac"] < 0.05, out["dataflow"]
+    # incremental-rehash arm (the AAE tentpole's per-round hook): the
+    # steady-state HashForest.refresh — quiescent vars and clean
+    # segments cost nothing — priced against an active frontier round;
+    # the dirty-row and full-rebuild figures ride in the artifact as
+    # the incremental-vs-full comparison
+    assert out["aae"]["round_seconds"] > 0
+    assert out["aae"]["refresh_cost_quiescent_s"] >= 0
+    assert out["aae"]["overhead_frac"] < 0.05, out["aae"]
+    assert out["aae"]["full_rebuild_seconds"] > 0

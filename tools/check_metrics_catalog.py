@@ -23,6 +23,13 @@ fails the Makefile ``verify`` target):
   ``PROBE_ATTEMPT_KEYS``, parsed statically) must match the "Probe
   report schema" table rows, both ways — the hardened TPU capture
   path's artifact contract.
+- **ledger families** — every kernel family declared in
+  ``lasp_tpu/telemetry/roofline.py``'s ``FAMILIES`` tuple (parsed
+  statically) must be named in the "Roofline & cost ledger" section,
+  and every `` `family` `` token that section names in its family list
+  must still be declared — so a new dispatch family (e.g. ``aae_hash``)
+  cannot land without its documentation, nor linger documented after
+  removal.
 
 Dynamic metric/event names are invisible to this lint and therefore
 forbidden by convention (docs/OBSERVABILITY.md).
@@ -128,6 +135,45 @@ def declared_probe_keys() -> set:
                 if m:
                     names.add(m.group(1))
     return names
+
+
+def declared_ledger_families() -> set:
+    """``FAMILIES`` members, parsed statically from
+    telemetry/roofline.py (the no-import rule)."""
+    path = os.path.join(SRC, "telemetry", "roofline.py")
+    names: set = set()
+    decl = re.compile(r"""^\s*['"]([a-z][a-z0-9_]*)['"],""")
+    with open(path, encoding="utf-8") as fp:
+        in_block = False
+        for line in fp:
+            if re.match(r"^FAMILIES = \($", line):
+                in_block = True
+                continue
+            if in_block:
+                if line.strip().startswith(")"):
+                    break
+                m = decl.match(line)
+                if m:
+                    names.add(m.group(1))
+    return names
+
+
+def roofline_section_families() -> set:
+    """Every backticked family-looking token in the doc's "Roofline &
+    cost ledger" section that matches a declared-family shape."""
+    out: set = set()
+    in_section = False
+    with open(DOC, encoding="utf-8") as fp:
+        for line in fp:
+            if line.startswith("##"):
+                in_section = (
+                    "roofline & cost ledger"
+                    in line.lstrip("#").strip().lower()
+                )
+                continue
+            if in_section:
+                out.update(re.findall(r"`([a-z][a-z0-9_]*)`", line))
+    return out
 
 
 def cataloged() -> dict:
@@ -272,6 +318,36 @@ def main() -> int:
             + "\n  ".join(probe_stale)
         )
 
+    families = declared_ledger_families()
+    doc_tokens = roofline_section_families()
+    fam_missing_doc = sorted(families - doc_tokens)
+    if fam_missing_doc:
+        problems.append(
+            "kernel ledger families declared in telemetry/roofline.py "
+            "FAMILIES but never named in the docs/OBSERVABILITY.md "
+            "'Roofline & cost ledger' section:\n  "
+            + "\n  ".join(fam_missing_doc)
+        )
+    # reverse direction: doc tokens that LOOK like families (end in a
+    # family-ish suffix or exactly match a historical family) but are
+    # no longer declared — restricted to tokens that were clearly
+    # family names to avoid flagging ordinary code spans in prose
+    fam_stale = sorted(
+        t for t in doc_tokens
+        if (t.endswith("_dense") or t.endswith("_rows")
+            or t.endswith("_window") or t.endswith("_exchange")
+            or t.endswith("_fused") or t.endswith("_step")
+            or t.endswith("_block") or t.endswith("_hash"))
+        and t not in families
+        and not t.startswith("roofline")
+    )
+    if fam_stale:
+        problems.append(
+            "family-shaped tokens in the 'Roofline & cost ledger' "
+            "section with no matching FAMILIES declaration (stale "
+            "rows):\n  " + "\n  ".join(fam_stale)
+        )
+
     if problems:
         print("\n".join(problems))
         return 1
@@ -279,7 +355,8 @@ def main() -> int:
         f"telemetry catalog OK ({len(code['metrics'])} metrics, "
         f"{len(code['events'])} event types, "
         f"{len(docs['spans'])} span rows, "
-        f"{len(probe_declared)} probe-report keys; code == docs)"
+        f"{len(probe_declared)} probe-report keys, "
+        f"{len(families)} ledger families; code == docs)"
     )
     return 0
 
