@@ -45,9 +45,16 @@ test-slow:
 # bit-equal across three codecs x both corruption presets plus
 # aae_* metric liveness (docs/RESILIENCE.md "Active anti-entropy"),
 # then the non-slow tests run (the tier-1 shape)
+# ... and a sharded-frontier smoke guards the multi-chip hot path on
+# the 8-device emulated mesh: sparse boundary exchange bit-identical to
+# the dense partitioned round AND the unsharded reference across
+# ring/random x leafwise/vclock/packed x both wire modes, plus the
+# hierarchical converge's exact-round-count contract (docs/PERF.md
+# "Sharded frontier")
 verify:
 	python tools/check_metrics_catalog.py
 	python tools/frontier_smoke.py
+	python tools/shard_smoke.py
 	python tools/plan_smoke.py
 	python tools/chaos_smoke.py
 	python tools/roofline_smoke.py

@@ -476,6 +476,18 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["many_vars"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- sharded frontier on the partitioned mesh (~seconds at CI shape):
+    # sparse boundary exchange vs the dense cut plane at measured dirty
+    # fractions + the hierarchical on-device quiescence tree; the slow
+    # 1M-replica variant is the ROADMAP open-item-1 scale run
+    # (tests/mesh/test_shard_frontier.py::test_mesh_scale_1m_slow) ------
+    try:
+        from lasp_tpu.bench_scenarios import mesh_scale
+
+        detail["mesh_scale"] = mesh_scale()
+    except Exception as exc:
+        detail["mesh_scale"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- whole-graph dataflow fusion arm (~seconds): one deep write wave
     # over 74 mixed-codec combinator edges, per-edge host round loop vs
     # the on-device fixed-point megakernel from identical snapshots —

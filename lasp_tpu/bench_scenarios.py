@@ -321,6 +321,31 @@ def roofline_workload(n_replicas: int = 128, n_vars: int = 12,
             else:
                 df_store.update(f"o{c}_0", ("add", f"x{rep}"), "w")
         df_g.propagate(mode="fused")
+    # the partitioned sparse-exchange family: a small partitioned mesh
+    # (as many devices as exist) runs two frontier write waves so the
+    # roofline table always carries a warm `shard_exchange` row next to
+    # the families it complements
+    import jax
+    from jax.sharding import Mesh
+
+    from lasp_tpu.mesh.topology import locality_order, scale_free
+
+    n_dev = len(jax.devices())
+    r_part = 64 if 64 % n_dev == 0 else 8 * n_dev
+    _, nn = locality_order(scale_free(r_part, 3, seed=5))
+    pstore = Store(n_actors=4)
+    pv = pstore.declare(id="pv", type="lasp_gset", n_elems=16)
+    prt = ReplicatedRuntime(pstore, Graph(pstore), r_part, nn)
+    prt.shard(
+        Mesh(np.array(jax.devices()), ("replicas",)),
+        axis="replicas", partition=True,
+    )
+    for rep in range(2):
+        prt.update_batch(
+            pv, [((3 * rep + 1) % r_part, ("add", f"p{rep}"), "pw")]
+        )
+        while prt.frontier_step():
+            pass
     return rt
 
 
@@ -2274,6 +2299,261 @@ def aae_scrub(
     }
 
 
+def mesh_scale(
+    n_replicas: int = 1 << 12,
+    n_shards: int = 8,
+    k: int = 3,
+    write_frac: float = 0.002,
+    cycles: int = 2,
+    n_vars: int = 2,
+    n_elems: int = 64,
+    seed: int = 23,
+    mode: str = "alltoall",
+    sync_every: int = 8,
+    wire_gate: "float | None" = None,
+) -> dict:
+    """The multi-chip scale path, measured (ROADMAP open item 1): a
+    partitioned 8-device mesh runs the row-sparse frontier scheduler
+    NATIVELY — each round's boundary exchange moves only dirty cut
+    rows (bucket-padded, ``shard_gossip.sparse_exchange_tables``)
+    while interior joins overlap the in-flight collective — and
+    quiescence is the hierarchical on-device ``psum`` tree, not a
+    per-round barrier. The workload is the steady-state serving shape:
+    repeated small write waves (``write_frac`` of replicas) each run
+    to quiescence under ``frontier_step``, recording PER ROUND the
+    dirty-cut fraction, the sparse payload bytes actually moved, and
+    the dense cut plane's equivalent — so the exchange saving is
+    measured at known dirty fractions, not claimed. The artifact
+    carries ``cut_rows_sparse_bytes`` vs ``cut_rows_dense_bytes``
+    (cumulative, same padded-payload convention), per-shard cut-byte
+    accounting, the exchange-vs-interior overlap fraction, rounds to
+    quiescence per cycle, the hierarchical-converge round count (bit-
+    exactness vs the host-driven loop is asserted in-scenario at CI
+    shapes), and a non-null ``roofline_frac`` from the
+    ``shard_exchange`` ledger family on every backend.
+
+    Gate: at every measured sparse round with dirty-cut fraction
+    <= 5%, the sparse exchange must move >= ``wire_gate``x fewer bytes
+    than the dense cut plane (default 5x at >= 1M replicas, 2x at CI
+    shapes where the pad bucket floor dominates)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime
+    from lasp_tpu.mesh.shard_gossip import shard_cut_bytes
+    from lasp_tpu.mesh.topology import locality_order, scale_free
+    from lasp_tpu.store import Store
+    from lasp_tpu.telemetry import get_ledger
+    from lasp_tpu.telemetry.capability import device_capability
+    from lasp_tpu.telemetry.roofline import state_row_bytes
+
+    n_dev = min(n_shards, len(jax.devices()))
+    n_replicas -= n_replicas % n_dev
+    if wire_gate is None:
+        wire_gate = 5.0 if n_replicas >= (1 << 20) else 2.0
+    _, nn = locality_order(scale_free(n_replicas, k, seed=seed))
+    store = Store(n_actors=8)
+    ids = [
+        store.declare(id=f"v{i}", type="lasp_gset", n_elems=n_elems)
+        for i in range(n_vars)
+    ]
+    rt = ReplicatedRuntime(store, Graph(store), n_replicas, nn)
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("replicas",))
+    rt.shard(mesh, axis="replicas", partition=True, partition_mode=mode)
+    pplan = rt._partition["plan"]
+    cut = int(pplan["stats"]["send_rows"])
+    rng = np.random.RandomState(seed)
+    n_writes = max(2, int(write_frac * n_replicas))
+
+    def write_wave(cycle: int) -> None:
+        for i, v in enumerate(ids):
+            rows = rng.choice(n_replicas, size=n_writes, replace=False)
+            rt.update_batch(
+                v,
+                [(int(r), ("add", f"c{cycle}e{(int(r) + i) % 8}"),
+                  f"w{int(r)}") for r in rows],
+            )
+
+    def dirty_cut_frac() -> float:
+        union = np.zeros(n_replicas, dtype=bool)
+        for v in ids:
+            union |= rt._frontier[v]
+        return float(union[pplan["cut_rows"]].sum()) / max(cut, 1)
+
+    # cycle 0 compiles every bucket the schedule needs (untimed)
+    write_wave(0)
+    while rt.frontier_step():
+        pass
+
+    rounds_per_cycle: list = []
+    per_round: list = []
+    sparse_s = 0.0
+    led0 = get_ledger().totals()["bytes"]
+    for cycle in range(1, cycles + 1):
+        write_wave(cycle)
+        rounds = 0
+        while True:
+            frac = dirty_cut_frac()
+            xb0 = rt.part_exchange_bytes_total
+            db0 = rt.part_dense_plane_bytes_total
+            fresh = any(v not in rt._part_halo for v in ids)
+            res, secs = _timed(lambda: rt.frontier_step())
+            sparse_s += secs
+            rounds += 1
+            per_round.append({
+                "cycle": cycle,
+                "dirty_cut_frac": round(frac, 5),
+                "payload_bytes": rt.part_exchange_bytes_total - xb0,
+                "dense_plane_bytes": rt.part_dense_plane_bytes_total - db0,
+                "halo_resync": bool(fresh),
+                "dense_arm": bool(
+                    getattr(rt, "frontier_dense_falls_last", 0)
+                ),
+            })
+            if res == 0:
+                break
+        rounds_per_cycle.append(rounds)
+    led_bytes = get_ledger().totals()["bytes"] - led0
+
+    # the measured-at-<=5%-dirty wire gate (resync rounds excluded:
+    # they ship the full cut by design, once per halo lifetime). The
+    # gate only means something on a REAL multi-shard cut — a
+    # single-device run (e.g. the bare CLI on a laptop) has no
+    # boundary to save wire on, so it records nulls instead of a
+    # vacuous 1.0x "failure"; the tier-1/slow tests pin the gate on
+    # the 8-device mesh.
+    # dense-crossover rounds ship the full plane by DESIGN (and record
+    # a vacuous 1.0x) — excluded like resyncs, the gate measures the
+    # sparse arm only
+    gated = [
+        r for r in per_round
+        if r["dirty_cut_frac"] <= 0.05 and not r["halo_resync"]
+        and not r["dense_arm"]
+        and r["payload_bytes"] and r["dense_plane_bytes"]
+    ]
+    worst_cut = min(
+        (r["dense_plane_bytes"] / r["payload_bytes"] for r in gated),
+        default=None,
+    )
+    if n_dev >= 2 and cut > 0:
+        assert gated, "no measured round at <= 5% dirty-cut fraction"
+        assert worst_cut >= wire_gate, (
+            f"sparse exchange moved only {worst_cut:.2f}x fewer bytes "
+            f"than the dense cut plane at <= 5% dirty (gate "
+            f"{wire_gate}x)"
+        )
+    else:
+        worst_cut = None
+        wire_gate = None
+
+    # hierarchical on-device convergence: one dispatch to the fixed
+    # point, quiescence via the psum tree. At CI shapes the exact-
+    # round-count contract vs the host-driven loop is asserted here
+    # too (tests pin it shape-independently).
+    write_wave(cycles + 1)
+    host_rounds = None
+    if n_replicas <= (1 << 14):
+        # REAL copies, not aliases: converge_on_device DONATES its
+        # inputs on accelerators, so a device_put-to-same-sharding
+        # "snapshot" would share the donated buffers and be deleted by
+        # the converge — jnp.array(copy=True) forces fresh buffers,
+        # re-placed under the original sharding
+        snap = (
+            {v: jax.tree_util.tree_map(
+                lambda x: jax.device_put(
+                    jnp.array(x, copy=True), x.sharding
+                ),
+                rt.states[v]) for v in ids},
+            {v: rt._frontier[v].copy() for v in ids},
+        )
+        hier_rounds, hier_s = _timed(
+            lambda: rt.converge_on_device(sync_every=sync_every)
+        )
+        for v, st in snap[0].items():
+            rt.states[v] = st
+        rt._frontier = dict(snap[1])
+        rt._part_halo.clear()
+        host_rounds = 0
+        while True:
+            host_rounds += 1
+            if rt.step() == 0:
+                break
+        assert hier_rounds == host_rounds, (hier_rounds, host_rounds)
+    else:
+        hier_rounds, hier_s = _timed(
+            lambda: rt.converge_on_device(sync_every=sync_every)
+        )
+
+    row_bytes = sum(state_row_bytes(rt.states[v], n_replicas) for v in ids)
+    ledger_rows = [
+        r for r in get_ledger().snapshot()
+        if r["family"] == "shard_exchange"
+    ]
+    cap = device_capability()
+    sparse_total = sum(r["payload_bytes"] for r in per_round)
+    dense_total = sum(r["dense_plane_bytes"] for r in per_round)
+    return {
+        "scenario": f"mesh_scale_{n_replicas}",
+        "n_replicas": n_replicas,
+        "n_shards": n_dev,
+        "n_vars": n_vars,
+        "partition_mode": mode,
+        "write_density": round(n_writes / n_replicas, 5),
+        "cut_rows": cut,
+        "per_shard": shard_cut_bytes(nn, n_dev, row_bytes),
+        "rounds_to_quiescence": rounds_per_cycle,
+        "cut_rows_sparse_bytes": int(sparse_total),
+        "cut_rows_dense_bytes": int(dense_total),
+        "wire_cut_total": (
+            round(dense_total / sparse_total, 2) if sparse_total else None
+        ),
+        "wire_cut_at_5pct_dirty": (
+            round(worst_cut, 2) if worst_cut else None
+        ),
+        "wire_gate": wire_gate,
+        "per_round": per_round[-24:],
+        "interior_overlap_frac": (
+            round(
+                rt.part_interior_rows_total
+                / max(rt.part_interior_rows_total
+                      + rt.part_boundary_rows_total, 1),
+                4,
+            )
+        ),
+        "hier_converge": {
+            "rounds": int(hier_rounds),
+            "seconds": round(hier_s, 4),
+            "sync_every": sync_every,
+            "host_loop_rounds": host_rounds,
+        },
+        "sparse_round_seconds_total": round(sparse_s, 4),
+        "ledger_bytes_moved": int(led_bytes),
+        "impl_roofline": {
+            "shard_exchange": {
+                "achieved_GBps": (
+                    ledger_rows[0]["achieved_GBps"] if ledger_rows else None
+                ),
+                "roofline_frac": (
+                    ledger_rows[0]["roofline_frac"] if ledger_rows else None
+                ),
+            },
+        },
+        "capability": {
+            "platform": cap.get("platform"),
+            "device_kind": cap.get("device_kind"),
+            "peak_GBps": cap.get("peak_GBps"),
+        },
+        "engine": "ReplicatedRuntime(frontier_step, partitioned)",
+        "check": (
+            "sparse-vs-dense wire gate at <=5% dirty; hierarchical "
+            "converge round count equals the host-driven loop at CI "
+            "shapes"
+        ),
+    }
+
+
 SCENARIOS = {
     "adcounter_6": adcounter_6,
     "gset_1k": gset_1k,
@@ -2283,6 +2563,7 @@ SCENARIOS = {
     "packed_vs_dense": packed_vs_dense,
     "bridge_throughput": bridge_throughput,
     "partitioned_gossip": partitioned_gossip,
+    "mesh_scale": mesh_scale,
     "frontier_sparse": frontier_sparse,
     "many_vars": many_vars,
     "dataflow_chain": dataflow_chain,

@@ -33,7 +33,7 @@ import numpy as np
 
 from ..lattice.base import Threshold, replicate
 from ..ops.flatpack import FlatORSet, FlatORSetSpec
-from ..telemetry import counter, events as tel_events, histogram, span
+from ..telemetry import counter, events as tel_events, gauge, histogram, span
 from ..telemetry.convergence import get_monitor, record_membership
 from ..telemetry.roofline import get_ledger, state_row_bytes
 from ..utils.metrics import StepTrace, Timer
@@ -329,6 +329,25 @@ class ReplicatedRuntime:
         self._aae_dirty: "dict | None" = None
         self._aae_state_epoch = 0
         self._aae_tree_epoch = 0
+        #: per-var boundary HALOS of the sparse partitioned exchange
+        #: (``shard_gossip.make_halo``): device-resident last-shipped
+        #: values of every cut row, at the buffer positions the
+        #: combined index tables read. Absence = "ship the full cut on
+        #: the next sparse round" (the lazy resync); every path that
+        #: can change rows without frontier knowledge drops entries
+        #: (plan invalidation, opaque fused/converge blocks, a member's
+        #: dense-crossover round).
+        self._part_halo: dict = {}
+        #: sparse-exchange wire accounting (the mesh_scale bench and
+        #: the MULTICHIP evidence read these): padded payload rows /
+        #: bytes actually moved, the dense cut plane's equivalent under
+        #: the same convention, and the interior/boundary row split of
+        #: the overlapped joins
+        self.part_exchange_rows_last = 0
+        self.part_exchange_bytes_total = 0
+        self.part_dense_plane_bytes_total = 0
+        self.part_interior_rows_total = 0
+        self.part_boundary_rows_total = 0
         self._sync_graph()
 
     def _sync_graph(self) -> None:
@@ -397,6 +416,13 @@ class ReplicatedRuntime:
             self._aae_state_epoch = (
                 getattr(self, "_aae_state_epoch", 0) + 1
             )
+        # boundary halos are only exact while frontier knowledge is:
+        # every plan-invalidating event may have moved rows the sparse
+        # exchange never shipped, so the next sparse round must resync
+        # the full cut (halo absence = full-cut ship)
+        halos = getattr(self, "_part_halo", None)
+        if halos:
+            halos.clear()
         if getattr(self, "_plan", None) is None:
             return
         self._plan = None
@@ -2360,13 +2386,26 @@ class ReplicatedRuntime:
         if mode not in ("dense", "frontier", "auto"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode != "dense":
-            reason = self._frontier_unsupported()
+            key, reason = self._frontier_unsupported_key()
             if reason is None:
                 return self._frontier_convergence(max_rounds, edge_mask)
             if mode == "frontier":
                 raise RuntimeError(
                     f"frontier gossip unavailable here: {reason}"
                 )
+            # auto degraded to dense: OBSERVABLY (an operator asking for
+            # frontier scheduling and silently getting the dense sweep
+            # was the r13 blind spot — the partitioned mesh did exactly
+            # that before the sharded-frontier path existed)
+            counter(
+                "gossip_frontier_dense_fallbacks_total",
+                help="dense rounds/runs taken where frontier scheduling "
+                     "was requested, by reason",
+                reason=key,
+            ).inc()
+            tel_events.emit(
+                "frontier_skip", fallback=key, mode="auto",
+            )
         if block > 1:
             rounds = 0
             while rounds < max_rounds:
@@ -2391,7 +2430,8 @@ class ReplicatedRuntime:
         return rounds
 
     def converge_on_device(
-        self, max_rounds: int = 10_000, edge_mask=None, strict: bool = True
+        self, max_rounds: int = 10_000, edge_mask=None, strict: bool = True,
+        sync_every: int = 8,
     ) -> int:
         """Run to the fixed point in ONE device dispatch: a
         ``lax.while_loop`` over the full step (sweep + triggers + gossip +
@@ -2410,9 +2450,33 @@ class ReplicatedRuntime:
         even a residual) is observable until the whole run finishes, so
         use fused blocks when a caller wants progress (e.g.
         ``read_until``'s threshold checks) and this when it only wants
-        the fixed point."""
+        the fixed point.
+
+        On a PARTITIONED runtime with no dataflow edges/triggers and no
+        edge mask, the loop runs SHARDED with a hierarchical quiescence
+        reduction (``shard_gossip.partitioned_converge_fn``): each
+        shard accumulates its local per-round residual partials and one
+        log-depth ``psum`` tree combines them every ``sync_every``
+        rounds — no per-round global convergence barrier, and the
+        returned round count is still exact (the tree evaluates the
+        same per-round residual sequence, just reduced hierarchically;
+        up to ``sync_every - 1`` no-op rounds may run past the fixed
+        point). ``sync_every=0`` forces the historical global-reduction
+        while loop."""
         if max_rounds < 1:
             raise ValueError("max_rounds must be >= 1")
+        if sync_every < 0:
+            raise ValueError("sync_every must be >= 0")
+        if (
+            sync_every > 0
+            and self._partition is not None
+            and edge_mask is None
+            and not self.graph.edges
+            and not self._triggers
+        ):
+            return self._converge_partitioned(
+                max_rounds, strict, sync_every
+            )
         tables = self._ensure_step()
         self._frontier_sync_mask(edge_mask)
         fn = self._fused_steps_cache.get("while")
@@ -2464,6 +2528,76 @@ class ReplicatedRuntime:
                 f"no convergence within {-signed_rounds} rounds"
             )
         return signed_rounds
+
+    def _converge_partitioned(self, max_rounds: int, strict: bool,
+                              window: int) -> int:
+        """Sharded ``converge_on_device`` body: one dispatch of
+        ``shard_gossip.partitioned_converge_fn``'s while loop — the
+        boundary-exchange round per group, per-shard residual partials,
+        one ``psum`` tree per ``window`` rounds. Exact round counts
+        (the final quiescent round included), zero per-round host OR
+        cross-shard convergence syncs."""
+        self._check_poisoned()
+        if self._n_edges != len(self.graph.edges):
+            self._sync_graph()
+        self._frontier_sync_mask(None)
+        if not self._round_traffic:
+            self._round_traffic = round_traffic_bytes(
+                self._states, self._ledger_fanout()
+            )
+        plan = self._ensure_plan()
+        groups = self._part_groups(plan)
+        part = self._partition
+        key = ("part_while", tuple(g.var_ids for g in groups),
+               int(window), part.get("mode", "gather"))
+        fn = self._fused_steps_cache.get(key)
+        if fn is None:
+            from .shard_gossip import partitioned_converge_fn
+
+            fn = partitioned_converge_fn(
+                tuple((g.codec, g.spec, len(g.var_ids)) for g in groups),
+                part["mesh"], part["plan"], axis=part["axis"],
+                mode=part.get("mode", "gather"), window=window,
+                donate=bool(self._donate_argnums()),
+            )
+            self._fused_steps_cache[key] = fn
+        member_states = tuple(
+            tuple(self.states[v] for v in g.var_ids) for g in groups
+        )
+        with span("gossip.converge", annotate=True):
+            with Timer() as t:
+                try:
+                    outs, signed = fn(
+                        member_states, part["send_idx"], part["idx"],
+                        max_rounds,
+                    )
+                    signed = int(np.asarray(signed))  # device sync
+                except Exception as exc:
+                    self._poison_if_donated(exc)
+                    raise
+        for g, out in zip(groups, outs):
+            for v, st in zip(g.var_ids, out):
+                self.states[v] = st
+        # opaque block: frontiers degrade/clear, boundary halos drop
+        # (the converge's internal rounds re-shipped the full plane
+        # fresh each round, never the halos)
+        self._frontier_after_opaque(signed > 0)
+        self.trace.record_round(0 if signed > 0 else -1, t.elapsed)
+        self._record_rounds(abs(signed))
+        if signed:
+            self._ledger_record_store("converge", t.elapsed, abs(signed))
+            rb = sum(self._row_bytes(v) for v in self.var_ids)
+            plane = self._part_dense_plane_rows()
+            self.part_dense_plane_bytes_total += abs(signed) * plane * rb
+            self.part_exchange_bytes_total += abs(signed) * plane * rb
+        self._observe_opaque_block(abs(signed), signed > 0, t.elapsed)
+        if signed > 0:
+            self._record_quiescence(signed)
+        if signed < 0 and strict:
+            raise RuntimeError(
+                f"no convergence within {-signed} rounds"
+            )
+        return signed
 
     # -- frontier / delta gossip (dirty-set scheduling) -----------------------
     def mark_dirty(self, var_id: "str | None" = None, rows=None) -> None:
@@ -2556,30 +2690,40 @@ class ReplicatedRuntime:
         knowledge never reached the host: quiescence clears every
         frontier, anything else degrades them all to all-dirty. AAE
         dirtiness degrades UNCONDITIONALLY — a block that quiesced
-        still changed rows on the way to its fixed point."""
+        still changed rows on the way to its fixed point. Boundary
+        halos drop for the same reason: the block changed cut rows the
+        sparse exchange never shipped (even a quiescent block changed
+        rows on the way), so the next sparse round resyncs the full
+        cut."""
         for v in self.var_ids:
             self._frontier_fill(v, not quiescent)
             self._aae_mark(v, None)
+        self._part_halo.clear()
 
     def frontier_size(self, var_id: str) -> int:
         """Current dirty-row count of one variable's frontier."""
         self._population(var_id)
         return int(self._frontier[var_id].sum())
 
-    def _frontier_unsupported(self) -> "str | None":
-        """None when the frontier engine can schedule this runtime, else
-        the human-readable reason the dense sweep is required."""
+    def _frontier_unsupported_key(self) -> "tuple[str | None, str | None]":
+        """``(reason_key, human_reason)`` when this runtime's shape needs
+        the dense sweep, ``(None, None)`` when the frontier engine can
+        schedule it. The key labels the observable auto-mode fallback
+        counter (``gossip_frontier_dense_fallbacks_total{reason=}``).
+        Partitioned runtimes are NOT a reason anymore: the sparse
+        boundary exchange (``shard_gossip.partitioned_frontier_round_
+        fn``) is the native frontier path on the partitioned mesh."""
         if self.graph.edges or self._triggers:
-            return (
+            return "dataflow", (
                 "dataflow edges / triggers sweep every replica row "
                 "locally (a row can change from its own state)"
             )
-        if self._partition is not None:
-            return (
-                "partitioned boundary-exchange gossip bakes a dense row "
-                "plan (shard with partition=False for frontier runs)"
-            )
-        return None
+        return None, None
+
+    def _frontier_unsupported(self) -> "str | None":
+        """None when the frontier engine can schedule this runtime, else
+        the human-readable reason the dense sweep is required."""
+        return self._frontier_unsupported_key()[1]
 
     def frontier_step(self, edge_mask=None) -> int:
         """ONE frontier-scheduled anti-entropy round: per variable,
@@ -2614,7 +2758,17 @@ class ReplicatedRuntime:
         plan = self._ensure_plan()
         with span("gossip.frontier_round", annotate=True):
             with Timer() as t:
-                if plan is None:
+                if self._partition is not None:
+                    if edge_mask is not None:
+                        raise ValueError(
+                            "partitioned sharded gossip does not support "
+                            "edge_mask failure injection"
+                        )
+                    with span(
+                        "gossip.shard_frontier_round", annotate=True,
+                    ):
+                        stats = self._frontier_round_partitioned(plan)
+                elif plan is None:
                     stats = self._frontier_round_pervar(edge_mask)
                 else:
                     with span(
@@ -2630,8 +2784,10 @@ class ReplicatedRuntime:
         dense_falls = stats["dense_falls"]
         total = sum(per_var_changed)
         #: host-visible work accounting (the frontier_sparse bench derives
-        #: its crossover autotune from this)
+        #: its crossover autotune from this; mesh_scale's wire gate
+        #: excludes rounds where a member took the dense arm)
         self.frontier_rows_last = rows_touched
+        self.frontier_dense_falls_last = dense_falls
         self.frontier_rows_total = (
             getattr(self, "frontier_rows_total", 0) + rows_touched
         )
@@ -2817,6 +2973,333 @@ class ReplicatedRuntime:
             "dense_falls": dense_falls,
             "dispatches": dispatches,
         }
+
+    # -- sharded frontier: sparse boundary exchange on the partitioned mesh ---
+    def _part_groups(self, plan):
+        """Dispatch groups for the partitioned frontier scheduler: the
+        compiled plan's groups, or one singleton group per var when
+        planning is off — ONE code path either way (the sparse exchange
+        kernel is grouped; singletons ride as G=1)."""
+        if plan is not None:
+            return plan.groups
+        from .plan import PlanGroup
+
+        groups = []
+        for v in self.var_ids:
+            codec, spec = self._mesh_meta(v)
+            groups.append(PlanGroup(var_ids=(v,), codec=codec, spec=spec))
+        return tuple(groups)
+
+    def _frontier_round_partitioned(self, plan) -> dict:
+        """ONE frontier round on the partitioned mesh: per group, every
+        active member's dirty CUT rows ride one bucket-padded sparse
+        collective into the boundary halos, interior reach rows join
+        while that exchange is in flight, boundary reach rows rejoin at
+        the scatter epilogue — bit-identical to the dense partitioned
+        round by the frontier-reach + halo invariants
+        (tests/mesh/test_shard_frontier.py). Host dispatches scale with
+        active groups; wire scales with the DIRTY cut, not the cut
+        plane."""
+        changed_of: dict = {}
+        rows_touched = 0
+        skipped = 0
+        dense_falls = 0
+        dispatches = 0
+        exchange_rows = 0
+        for group in self._part_groups(plan):
+            members: list = []
+            for v in group.var_ids:
+                f = self._frontier_mask_of(v)
+                if not f.any():
+                    skipped += 1
+                    changed_of[v] = 0
+                    members.append((v, None))
+                    continue
+                rows = self._frontier_reach_rows(f, None)
+                if rows.size == 0:
+                    # dirty rows with no out-edges deliver nothing —
+                    # and none of them can be CUT rows (a cut row is
+                    # referenced, hence has an out-edge), so retiring
+                    # them leaves the halo exact
+                    self._frontier[v] = np.zeros(self.n_replicas, bool)
+                    skipped += 1
+                    changed_of[v] = 0
+                    members.append((v, None))
+                    continue
+                members.append((v, rows))
+            active = [(v, r) for v, r in members if r is not None]
+            if not active:
+                continue
+            thresh = self.frontier_crossover * self.n_replicas
+            dense_subset = [(v, r) for v, r in active if r.size > thresh]
+            sparse_subset = [(v, r) for v, r in active if r.size <= thresh]
+            if dense_subset:
+                changed = self._part_dense_round(group, dense_subset)
+                dense_falls += len(dense_subset)
+                dispatches += 1
+                rows_touched += self.n_replicas * len(dense_subset)
+                for i, (v, _rows) in enumerate(dense_subset):
+                    mask = np.array(changed[i])
+                    self._frontier[v] = mask
+                    changed_of[v] = int(mask.sum())
+                    if changed_of[v]:
+                        self._aae_mark(v, np.flatnonzero(mask))
+                    # the dense arm re-ships the whole plane fresh and
+                    # REPLACES the frontier — the dirty rows it retired
+                    # were never shipped into the halo, so this member
+                    # resyncs the full cut on its next sparse round
+                    self._part_halo.pop(v, None)
+            if sparse_subset:
+                sp_changed, touched, xrows = self._part_sparse_round(
+                    group, sparse_subset
+                )
+                dispatches += 1
+                rows_touched += touched
+                exchange_rows += xrows
+                changed_of.update(sp_changed)
+        return {
+            "per_var_changed": [changed_of.get(v, 0) for v in self.var_ids],
+            "rows_touched": rows_touched,
+            "skipped": skipped,
+            "dense_falls": dense_falls,
+            "dispatches": dispatches,
+            "exchange_rows": exchange_rows,
+        }
+
+    def _part_dense_round(self, group, active) -> np.ndarray:
+        """Dense crossover arm on the partitioned mesh: the full
+        boundary-exchange round (whole cut plane on the wire) over the
+        group's stacked active members, plus per-member per-row change
+        vectors — the partitioned twin of :meth:`_plan_dense_round`."""
+        part = self._partition
+        var_ids = tuple(v for v, _r in active)
+        key = ("part_dense", group.codec, group.spec, len(active),
+               part.get("mode", "gather"))
+        fn = self._fused_steps_cache.get(key)
+        if fn is None:
+            from .shard_gossip import partitioned_gossip_round_grouped
+
+            codec, spec = group.codec, group.spec
+            n_g = len(active)
+            round_fn = partitioned_gossip_round_grouped(
+                codec, spec, part["mesh"], part["plan"],
+                axis=part["axis"], mode=part.get("mode", "gather"),
+            )
+
+            def dense(states_tuple, send_tbl, idx_tbl):
+                stacked = stack_group(states_tuple)
+                new = round_fn(stacked, send_tbl, idx_tbl)
+                changed = jax.vmap(
+                    jax.vmap(lambda a, b: ~codec.equal(spec, a, b))
+                )(stacked, new)
+                return unstack_group(new, n_g), changed
+
+            fn = jax.jit(dense, donate_argnums=self._frontier_donate())
+            self._fused_steps_cache[key] = fn
+        states_in = tuple(self.states[v] for v in var_ids)
+        with Timer() as t:
+            try:
+                outs, changed = fn(
+                    states_in, part["send_idx"], part["idx"]
+                )
+                jax.block_until_ready(changed)
+            except Exception as exc:
+                if self._frontier_donate() and any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for state in states_in
+                    for leaf in jax.tree_util.tree_leaves(state)
+                ):
+                    self._poisoned = (
+                        f"{type(exc).__name__}: {str(exc)[:200]}"
+                    )
+                raise
+        for i, v in enumerate(var_ids):
+            self.states[v] = outs[i]
+        self._record_shard_exchange(
+            var_ids[0], t.elapsed, len(active),
+            payload_rows=self._part_dense_plane_rows(),
+            dense_rows=self._part_dense_plane_rows(),
+            join_rows=self.n_replicas * len(active),
+        )
+        # np.array (copy): becomes the frontier mask _frontier_fill
+        # later mutates in place
+        return np.array(changed)
+
+    def _part_dense_plane_rows(self) -> int:
+        """The dense cut plane's per-round collective payload rows for
+        one group member, under the runtime's wire mode — the
+        ``cut_rows_dense_bytes`` half of the exchange accounting."""
+        pplan = self._partition["plan"]
+        s = pplan["n_shards"]
+        if self._partition.get("mode", "gather") == "alltoall":
+            return s * s * pplan["m2"]
+        return s * pplan["m"]
+
+    def _record_shard_exchange(self, var_id: str, seconds: float,
+                               g_active: int, payload_rows: int,
+                               dense_rows: int, join_rows: int) -> None:
+        """Wire + ledger accounting of one partitioned frontier
+        dispatch: what the sparse exchange actually moved
+        (``payload_rows``, pad slots included — they are real collective
+        slots) vs what the dense cut plane would have moved for the
+        same round, plus the ``shard_exchange`` roofline family row."""
+        rb = self._row_bytes(var_id)
+        payload_bytes = payload_rows * rb * g_active
+        dense_bytes = dense_rows * rb * g_active
+        self.part_exchange_rows_last = payload_rows * g_active
+        self.part_exchange_bytes_total += payload_bytes
+        self.part_dense_plane_bytes_total += dense_bytes
+        from ..telemetry import registry as _reg
+
+        if not _reg.enabled():
+            return
+        gauge(
+            "gossip_shard_exchange_rows",
+            help="cut rows the last sparse boundary exchange moved "
+                 "(bucket-padded payload, all members)",
+        ).set(payload_rows * g_active)
+        codec, _spec = self._mesh_meta(var_id)
+        k = self._ledger_fanout()
+        get_ledger().record(
+            "shard_exchange",
+            codec.__name__,
+            n_replicas=self.n_replicas,
+            fanout=k,
+            seconds=seconds,
+            row_bytes=rb,
+            rows=payload_rows,
+            g_active=g_active,
+            bytes_moved=2 * payload_bytes + (k + 2) * join_rows * rb,
+            joins=join_rows * k,
+        )
+
+    def _part_sparse_round(self, group, active):
+        """Dispatch one group's sparse boundary-exchange frontier round
+        over its active members. Returns ``(changed_of, rows_touched,
+        exchange_rows)``."""
+        from .shard_gossip import (
+            make_halo,
+            partitioned_frontier_round_fn,
+            sparse_exchange_tables,
+        )
+
+        part = self._partition
+        mode = part.get("mode", "gather")
+        pplan = part["plan"]
+        s_shards, block = pplan["n_shards"], pplan["block"]
+        bmask = pplan["boundary_mask"]
+        var_ids = tuple(v for v, _r in active)
+        n_g = len(active)
+        # halos: a member without one must resync its FULL cut this
+        # round (zeros are only safe because every readable position is
+        # written before the first boundary join) — the union payload
+        # ships the full cut for every member then, a one-round cost
+        fresh = False
+        for v in var_ids:
+            if v not in self._part_halo:
+                self._part_halo[v] = make_halo(
+                    self.states[v], pplan, mode, part["mesh"],
+                    axis=part["axis"],
+                )
+                fresh = True
+        if fresh:
+            dirty = None  # full-cut resync
+        else:
+            dirty = np.zeros(self.n_replicas, dtype=bool)
+            for v in var_ids:
+                dirty |= self._frontier[v]
+        tabs = sparse_exchange_tables(pplan, mode, dirty)
+        # per-member reach rows, split INTERIOR (all neighbors local —
+        # joined while the exchange is in flight) vs BOUNDARY (rejoin
+        # after the halo scatter), bucketed per shard
+        per_member: list = []
+        max_i = max_b = 0
+        for v, rows in active:
+            owner = rows // block
+            is_b = bmask[rows]
+            by_shard = []
+            for s in range(s_shards):
+                sel = owner == s
+                ri = rows[sel & ~is_b]
+                rb_ = rows[sel & is_b]
+                by_shard.append((ri, rb_))
+                max_i = max(max_i, ri.size)
+                max_b = max(max_b, rb_.size)
+            per_member.append(by_shard)
+        from .shard_gossip import _pow2_bucket
+
+        f_i = _pow2_bucket(max_i, 4, block)
+        f_b = _pow2_bucket(max_b, 4, block)
+        rows_i = np.zeros((s_shards, n_g, f_i), dtype=np.int32)
+        valid_i = np.zeros((s_shards, n_g, f_i), dtype=bool)
+        rows_b = np.zeros((s_shards, n_g, f_b), dtype=np.int32)
+        valid_b = np.zeros((s_shards, n_g, f_b), dtype=bool)
+        for g, by_shard in enumerate(per_member):
+            for s, (ri, rb_) in enumerate(by_shard):
+                rows_i[s, g, : ri.size] = ri - s * block
+                valid_i[s, g, : ri.size] = True
+                rows_b[s, g, : rb_.size] = rb_ - s * block
+                valid_b[s, g, : rb_.size] = True
+        key = ("part_sparse", group.codec, group.spec, n_g, mode)
+        fn = self._fused_steps_cache.get(key)
+        if fn is None:
+            fn = partitioned_frontier_round_fn(
+                group.codec, group.spec, part["mesh"], pplan,
+                axis=part["axis"], mode=mode, n_g=n_g,
+                donate=bool(self._frontier_donate()),
+            )
+            self._fused_steps_cache[key] = fn
+        states_in = tuple(self.states[v] for v in var_ids)
+        halos_in = tuple(self._part_halo[v] for v in var_ids)
+        with Timer() as t:
+            try:
+                outs, halos, ch_i, ch_b = fn(
+                    states_in, halos_in,
+                    jnp.asarray(tabs["pay_slot"]),
+                    jnp.asarray(tabs["pay_pos"]),
+                    jnp.asarray(rows_i), jnp.asarray(valid_i),
+                    jnp.asarray(rows_b), jnp.asarray(valid_b),
+                    part["idx"],
+                )
+                jax.block_until_ready(ch_b)
+            except Exception as exc:
+                if self._frontier_donate() and any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for state in states_in + halos_in
+                    for leaf in jax.tree_util.tree_leaves(state)
+                ):
+                    self._poisoned = (
+                        f"{type(exc).__name__}: {str(exc)[:200]}"
+                    )
+                raise
+        for i, v in enumerate(var_ids):
+            self.states[v] = outs[i]
+            self._part_halo[v] = halos[i]
+        ch_i = np.asarray(ch_i)  # [S, G, Fi]
+        ch_b = np.asarray(ch_b)  # [S, G, Fb]
+        changed_of: dict = {}
+        touched = 0
+        interior_rows = 0
+        for g, (v, rows) in enumerate(active):
+            mask = np.zeros(self.n_replicas, dtype=bool)
+            for s, (ri, rb_) in enumerate(per_member[g]):
+                mask[ri[ch_i[s, g, : ri.size]]] = True
+                mask[rb_[ch_b[s, g, : rb_.size]]] = True
+                interior_rows += int(ri.size)
+            self._frontier[v] = mask
+            changed_of[v] = int(mask.sum())
+            if changed_of[v]:
+                self._aae_mark(v, np.flatnonzero(mask))
+            touched += int(rows.size)
+        self.part_interior_rows_total += interior_rows
+        self.part_boundary_rows_total += touched - interior_rows
+        self._record_shard_exchange(
+            var_ids[0], t.elapsed, n_g,
+            payload_rows=tabs["payload_rows"],
+            dense_rows=tabs["dense_rows"],
+            join_rows=touched,
+        )
+        return changed_of, touched, tabs["payload_rows"] * n_g
 
     def _plan_sparse_round(self, group, active, rows_mat: np.ndarray,
                            valid: np.ndarray, edge_mask) -> np.ndarray:
@@ -3225,8 +3708,9 @@ class ReplicatedRuntime:
             if dense_falls:
                 counter(
                     "gossip_frontier_dense_fallbacks_total",
-                    help="per-var dense rounds taken because the frontier "
-                         "density crossed frontier_crossover",
+                    help="dense rounds/runs taken where frontier "
+                         "scheduling was requested, by reason",
+                    reason="crossover",
                 ).inc(dense_falls)
             mon = get_monitor()
             res_last = tel["residual_last"]
@@ -3885,6 +4369,12 @@ class ReplicatedRuntime:
         # projection tables derive from element order; rebuild them (shapes
         # are spec-fixed, so the compiled step does NOT retrace)
         self.graph.refresh()
+        # the reindex rewrote every row WITHOUT frontier knowledge: a
+        # boundary halo still holds old-element-order rows, and a later
+        # sparse round's boundary join would scatter them into the
+        # reindexed population — silent resurrection of the reclaimed
+        # slots. Drop the halo; the next sparse round resyncs the cut.
+        self._part_halo.pop(var_id, None)
         return reclaimed
 
     def compact_map_field(self, var_id: str, key) -> int:
@@ -3928,6 +4418,9 @@ class ReplicatedRuntime:
             self.store.reindex_orset_state(leaf_of(states, idxs), order),
         )
         shim.elems = fresh
+        # same halo rule as compact_orset: the reindexed planes make any
+        # boundary halo's old-order rows poison — drop it
+        self._part_halo.pop(var_id, None)
         return reclaimed
 
     @contextlib.contextmanager

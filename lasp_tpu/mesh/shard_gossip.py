@@ -231,6 +231,20 @@ def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
     flat2 = B + owner * m2 + (pos - starts[owner * n_shards + src_shard])
     idx2 = np.where(cross, flat2, nbrs - src_shard * B)
 
+    # -- sparse-exchange layout tables (the sharded-frontier path):
+    # the cut's global row ids + their gather-buffer positions, and the
+    # per-(owner, destination) pair rows with their receive-halo
+    # positions — the static layout a per-round DIRTY subset indexes
+    # into (sparse_exchange_tables), so shipping only dirty cut rows
+    # lands them at exactly the slots the combined index tables already
+    # read. boundary_mask marks rows with >= 1 cross-shard neighbor
+    # (the interior/boundary split of the overlapped frontier round).
+    keep2 = keep
+    pair_rows = p_rows[keep2]
+    pair_dst = p_dst[keep2]
+    pair_pos = p_owner[keep2] * m2 + slot[keep2]
+    boundary_mask = cross.any(axis=1)
+
     # stats derive from the arrays just built (one walk of the table,
     # and one definition of the cut — shard_cut_stats exists for callers
     # that have no plan)
@@ -280,6 +294,12 @@ def partitioned_gossip_plan(neighbors, n_shards: int) -> dict:
         "block": B,
         "m": m,
         "m2": m2,
+        "cut_rows": send_rows.astype(np.int64),
+        "cut_pos": pos_of[send_rows].astype(np.int64),
+        "pair_rows": pair_rows.astype(np.int64),
+        "pair_dst": pair_dst.astype(np.int64),
+        "pair_pos": pair_pos.astype(np.int64),
+        "boundary_mask": boundary_mask,
         "stats": stats,
     }
 
@@ -394,13 +414,35 @@ def partitioned_gossip_round_grouped(codec, spec, mesh: Mesh, plan: dict,
         )
     if mode not in ("gather", "alltoall"):
         raise ValueError(f"unknown partitioned gossip mode {mode!r}")
+    local = _grouped_exchange_local(codec, spec, plan, axis, mode)
+    tbl_spec = P(axis, None, None) if alltoall_mode(mode) else P(axis, None)
+    return _shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, axis), tbl_spec, P(axis, None)),
+        out_specs=P(None, axis), **_SM_NOCHECK,
+    )
+
+
+def alltoall_mode(mode: str) -> bool:
+    if mode not in ("gather", "alltoall"):
+        raise ValueError(f"unknown partitioned gossip mode {mode!r}")
+    return mode == "alltoall"
+
+
+def _grouped_exchange_local(codec, spec, plan: dict, axis, mode: str):
+    """The per-device body of ONE grouped boundary-exchange round —
+    ``local(block, send_tbl, idx) -> block`` over ``[G, B, ...]`` block
+    leaves. Factored so :func:`partitioned_gossip_round_grouped` (the
+    per-round entry) and :func:`partitioned_converge_fn` (the
+    hierarchical on-device convergence loop) run EXACTLY the same round
+    body — a round-rule change cannot diverge the two."""
     from .gossip import _leafwise_op
 
     # double-vmapped merge: [G, B] leading axes
     vmerge = jax.vmap(jax.vmap(lambda a, b: codec.merge(spec, a, b)))
     leaf_op = _leafwise_op(codec)
     k_cols = plan["idx"].shape[1]
-    alltoall = mode == "alltoall"
+    alltoall = alltoall_mode(mode)
 
     def local(block, send_tbl, idx):
         # block leaves: [G, B, ...] (B = per-device replica block)
@@ -447,12 +489,458 @@ def partitioned_gossip_round_grouped(codec, spec, mesh: Mesh, plan: dict,
             acc = vmerge(acc, nbr)
         return acc
 
-    tbl_spec = P(axis, None, None) if alltoall else P(axis, None)
-    return _shard_map(
-        local, mesh=mesh,
-        in_specs=(P(None, axis), tbl_spec, P(axis, None)),
-        out_specs=P(None, axis), **_SM_NOCHECK,
+    return local
+
+
+# ---------------------------------------------------------------------------
+# sparse boundary exchange: the sharded-frontier wire path
+# ---------------------------------------------------------------------------
+#
+# The dense partitioned round re-ships the WHOLE cut plane every round
+# (every boundary row, dirty or not). At a quiescent steady state that
+# is pure no-op wire — the exact waste the frontier scheduler skips on
+# the row axis, now skipped on the WIRE axis too: each round's
+# collective moves only the cut rows that are frontier-DIRTY (changed
+# since their last ship), bucket-padded with valid-slot masks like
+# ``gossip_round_rows``; every shard keeps a device-resident HALO of
+# the boundary rows' last-shipped values at exactly the buffer
+# positions the combined index tables (``idx`` / ``idx2``) already
+# read. Invariant: after the scatter, ``halo[p]`` equals the CURRENT
+# value of cut row ``p`` — dirty rows were just shipped, clean rows
+# have not changed since their last ship — so the join reads the same
+# neighbor values as the dense exchange, bit for bit. The runtime owns
+# the halo lifecycle (fresh halos ship the full cut once; any path
+# that changes rows without frontier knowledge drops halos).
+
+
+def _pow2_bucket(n: int, floor: int, cap: int) -> int:
+    """Power-of-two padded bucket for ``n`` slots (one compiled kernel
+    per band, not per distinct count), capped at the dense extent."""
+    b = max(int(floor), 1)
+    while b < n:
+        b <<= 1
+    return max(min(b, int(cap)), int(n), 1)
+
+
+def sparse_exchange_tables(plan: dict, mode: str, dirty=None,
+                           min_bucket: int = 8) -> dict:
+    """Host-side payload tables for one sparse boundary exchange:
+    which cut rows ship this round (``dirty: bool[R]`` — typically the
+    frontier union; None = the full cut, the fresh-halo resync) and
+    where they land in the receive halo.
+
+    Returns ``{"pay_slot", "pay_pos", "bucket", "payload_rows",
+    "real_rows", "halo_len", "dense_rows"}`` where ``payload_rows`` is
+    the PADDED row count the collective actually moves (the honest wire
+    figure) and ``dense_rows`` the dense cut plane's equivalent under
+    the same convention — the ``cut_rows_sparse_bytes`` vs
+    ``cut_rows_dense_bytes`` accounting pair.
+
+    - gather: ``pay_slot int32[S, D]`` (block-local ids of shard s's
+      dirty cut rows, pad 0), ``pay_pos int32[S, D]`` (union-buffer
+      positions; pad = halo_len, dropped at the scatter).
+    - alltoall: ``pay_slot int32[S, S, D2]`` (owner-major
+      per-destination slices), ``pay_pos int32[S, S, D2]``
+      (RECEIVER-major positions into the destination's own halo; pad =
+      halo_len)."""
+    import numpy as np
+
+    B = plan["block"]
+    S = plan["n_shards"]
+    if alltoall_mode(mode):
+        pr, pd, pp = plan["pair_rows"], plan["pair_dst"], plan["pair_pos"]
+        m2 = plan["m2"]
+        halo_len = S * m2
+        if dirty is not None:
+            sel = np.asarray(dirty, bool)[pr]
+            pr, pd, pp = pr[sel], pd[sel], pp[sel]
+        owner = pr // B
+        key = owner * S + pd
+        order = np.argsort(key, kind="stable")
+        pr, pd, pp, owner, key = (
+            pr[order], pd[order], pp[order], owner[order], key[order]
+        )
+        counts = np.bincount(key, minlength=S * S)
+        need = int(counts.max()) if len(pr) else 0
+        bucket = _pow2_bucket(need, min_bucket, m2)
+        starts = np.zeros(S * S + 1, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        j = np.arange(len(pr)) - starts[key]
+        pay_slot = np.zeros((S, S, bucket), dtype=np.int32)
+        pay_pos = np.full((S, S, bucket), halo_len, dtype=np.int32)
+        pay_slot[owner, pd, j] = (pr - owner * B).astype(np.int32)
+        pay_pos[pd, owner, j] = pp.astype(np.int32)
+        return {
+            "pay_slot": pay_slot,
+            "pay_pos": pay_pos,
+            "bucket": int(bucket),
+            "payload_rows": int(S * S * bucket),
+            "real_rows": int(len(pr)),
+            "halo_len": int(halo_len),
+            "dense_rows": int(S * S * m2),
+        }
+    cut_rows, cut_pos = plan["cut_rows"], plan["cut_pos"]
+    m = plan["m"]
+    halo_len = S * m
+    if dirty is not None:
+        sel = np.asarray(dirty, bool)[cut_rows]
+        cut_rows, cut_pos = cut_rows[sel], cut_pos[sel]
+    owner = cut_rows // B
+    counts = np.bincount(owner, minlength=S)
+    need = int(counts.max()) if len(cut_rows) else 0
+    bucket = _pow2_bucket(need, min_bucket, m)
+    starts = np.zeros(S + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    j = np.arange(len(cut_rows)) - starts[owner]
+    pay_slot = np.zeros((S, bucket), dtype=np.int32)
+    pay_pos = np.full((S, bucket), halo_len, dtype=np.int32)
+    pay_slot[owner, j] = (cut_rows - owner * B).astype(np.int32)
+    pay_pos[owner, j] = cut_pos.astype(np.int32)
+    return {
+        "pay_slot": pay_slot,
+        "pay_pos": pay_pos,
+        "bucket": int(bucket),
+        "payload_rows": int(S * bucket),
+        "real_rows": int(len(cut_rows)),
+        "halo_len": int(halo_len),
+        "dense_rows": int(S * m),
+    }
+
+
+def make_halo(states, plan: dict, mode: str, mesh: Mesh, axis="replicas"):
+    """A zero-initialized boundary halo for one variable's ``[R, ...]``
+    population: gather mode holds the full union buffer REPLICATED on
+    every device (``[H, ...]``, H = S*m — every shard receives every
+    boundary row); alltoall mode holds each shard's own receive buffer
+    block-sharded (``[S, H2, ...]``, H2 = S*m2). Zeros are safe: the
+    runtime ships the FULL cut on a fresh halo's first round, so every
+    position a join can read is written before it is read."""
+    S = plan["n_shards"]
+    if alltoall_mode(mode):
+        h2 = S * plan["m2"]
+        sh = jax.sharding.NamedSharding(mesh, P(axis))
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(
+                jnp.zeros((S, h2) + x.shape[1:], dtype=x.dtype), sh
+            ),
+            states,
+        )
+    h = S * plan["m"]
+    sh = jax.sharding.NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            jnp.zeros((h,) + x.shape[1:], dtype=x.dtype), sh
+        ),
+        states,
     )
+
+
+def _member_rows_join(codec, spec, k_cols: int):
+    """One member's masked row join: ``(src, block, rows, nbr_idx) ->
+    (new_rows, changed)`` — gather ``rows``' pre-round states from
+    ``block`` and their K neighbors from ``src`` (the local block for
+    interior rows, ``[block | halo]`` for boundary rows), fold the
+    join in the same k order as the dense exchange, and flag raw
+    inequality. vmapped over the group axis by the kernel."""
+    from .gossip import _leafwise_op
+
+    leaf_op = _leafwise_op(codec)
+    vmerge = jax.vmap(lambda a, b: codec.merge(spec, a, b))
+
+    def join(src, block, rows, nbr_idx):
+        old = jax.tree_util.tree_map(lambda x: x[rows], block)
+        if leaf_op is not None:
+            def leaf(xs, o):
+                acc = o
+                for k in range(k_cols):
+                    acc = leaf_op(acc, xs[nbr_idx[:, k]])
+                return acc
+
+            new = jax.tree_util.tree_map(leaf, src, old)
+        else:
+            acc = old
+            for k in range(k_cols):
+                nbr = jax.tree_util.tree_map(
+                    lambda x, _k=k: x[nbr_idx[:, _k]], src
+                )
+                acc = vmerge(acc, nbr)
+            new = acc
+        changed = ~jax.vmap(lambda a, b: codec.equal(spec, a, b))(old, new)
+        return new, changed
+
+    return join
+
+
+def partitioned_frontier_round_fn(codec, spec, mesh: Mesh, plan: dict,
+                                  axis="replicas", mode: str = "gather",
+                                  n_g: int = 1, donate: bool = True):
+    """Build the SPARSE boundary-exchange frontier round for one
+    dispatch-plan group (``n_g`` stacked same-codec members; singletons
+    ride as G=1 — one implementation):
+
+    ``fn(states_tuple, halo_tuple, pay_slot, pay_pos, rows_i, valid_i,
+    rows_b, valid_b, idx_tbl) -> (states_tuple, halo_tuple,
+    changed_i: bool[S, G, Fi], changed_b: bool[S, G, Fb])``
+
+    where ``rows_*``/``valid_*`` are the per-shard per-member
+    frontier-REACHABLE rows (block-local ids, bucket-padded), split
+    INTERIOR (every neighbor local — joined while the cut-row exchange
+    is in flight; no data dependence on the collective, so the
+    scheduler overlaps them) vs BOUNDARY (rejoining at the scatter
+    epilogue after the halo update). Bit-identical to the dense
+    partitioned round on the same round by the frontier-reach invariant
+    plus the halo invariant (tests/mesh/test_shard_frontier.py,
+    tools/shard_smoke.py). Pad slots gather clamped garbage and are
+    DROPPED at every scatter (`mode="drop"` with out-of-range targets)
+    — no pad-write semantics to reason about, and valid rows are
+    unique so no scatter races exist."""
+    if plan["n_shards"] != axis_extent(mesh, axis):
+        raise ValueError(
+            f"plan was built for {plan['n_shards']} shards but mesh axis "
+            f"{axis!r} has {axis_extent(mesh, axis)} devices — rebuild "
+            "the plan"
+        )
+    alltoall = alltoall_mode(mode)
+    B = plan["block"]
+    k_cols = (plan["idx2"] if alltoall else plan["idx"]).shape[1]
+    join = _member_rows_join(codec, spec, k_cols)
+    tmap = jax.tree_util.tree_map
+
+    def local(block, halo, pay_slot, pay_pos, rows_i, valid_i,
+              rows_b, valid_b, idx_blk):
+        # block [G, B, ...]; rows_*/valid_* [1, G, F]; idx_blk [B, K]
+        ri, vi = rows_i[0], valid_i[0]
+        rb, vb = rows_b[0], valid_b[0]
+        # 1) dirty cut rows onto the wire FIRST: nothing below this
+        #    line reads `recv` until the halo scatter, so the interior
+        #    joins overlay the in-flight collective (the Join-Calculus
+        #    overlap; on TPU the async all-gather/all-to-all pair hides
+        #    under the gather+join compute)
+        if alltoall:
+            slot = pay_slot[0]  # [S, D2]
+            payload = tmap(
+                lambda x: x[:, slot.reshape(-1)].reshape(
+                    (x.shape[0],) + slot.shape + x.shape[2:]
+                ),
+                block,
+            )  # [G, S, D2, ...]
+            recv = tmap(
+                lambda c: jax.lax.all_to_all(
+                    c, axis, split_axis=1, concat_axis=1, tiled=False
+                ),
+                payload,
+            )  # [G, S, D2, ...]: slice s = what owner s sent to ME
+            my_halo = tmap(lambda h: h[:, 0], halo)  # [G, H2, ...]
+            flat_pos = pay_pos[0].reshape(-1)  # [S*D2] (pad = H2: drop)
+        else:
+            slot = pay_slot[0]  # [D]
+            payload = tmap(lambda x: x[:, slot], block)  # [G, D, ...]
+            recv = tmap(
+                lambda c: jax.lax.all_gather(c, axis), payload
+            )  # [S, G, D, ...]
+            my_halo = halo  # [G, H, ...] (replicated union buffer)
+            flat_pos = pay_pos.reshape(-1)  # [S*D] (pad = H: drop)
+        # 2) interior joins: sources entirely in the local block (pad
+        #    slots may reference the halo range — clamped gathers whose
+        #    writes are dropped below)
+        nbr_i = idx_blk[ri]  # [G, Fi, K]
+        new_i, ch_i = jax.vmap(join, in_axes=(0, 0, 0, 0))(
+            block, block, ri, nbr_i
+        )
+        # 3) halo scatter: received dirty rows land at their buffer
+        #    positions (the halo invariant: every cut position now
+        #    holds the row's CURRENT value)
+        if alltoall:
+            vals = tmap(
+                lambda r: r.reshape((r.shape[0], -1) + r.shape[3:]), recv
+            )  # [G, S*D2, ...]
+        else:
+            vals = tmap(
+                lambda r: jnp.moveaxis(r, 0, 1).reshape(
+                    (r.shape[1], -1) + r.shape[3:]
+                ),
+                recv,
+            )  # [G, S*D, ...]
+        new_halo = tmap(
+            lambda h, v: h.at[:, flat_pos].set(v, mode="drop"),
+            my_halo, vals,
+        )
+        # 4) boundary joins from [block | halo] — the same combined
+        #    layout the dense exchange's index tables address
+        full = tmap(
+            lambda b, h: jnp.concatenate([b, h], axis=1), block, new_halo
+        )
+        nbr_b = idx_blk[rb]  # [G, Fb, K]
+        new_b, ch_b = jax.vmap(join, in_axes=(0, 0, 0, 0))(
+            full, block, rb, nbr_b
+        )
+        # 5) epilogue scatter: every gather above read PRE-round state;
+        #    invalid slots target row B (out of block range -> dropped),
+        #    valid rows are unique and interior/boundary disjoint, so
+        #    the scatter is race-free
+        tgt_i = jnp.where(vi, ri, B)
+        tgt_b = jnp.where(vb, rb, B)
+
+        def upd(x, ni, nb):
+            def one(xm, ti, nim, tb, nbm):
+                return xm.at[ti].set(nim, mode="drop").at[tb].set(
+                    nbm, mode="drop"
+                )
+
+            return jax.vmap(one)(x, tgt_i, ni, tgt_b, nb)
+
+        out = tmap(upd, block, new_i, new_b)
+        halo_out = (
+            tmap(lambda h: h[:, None], new_halo) if alltoall else new_halo
+        )
+        return out, halo_out, (ch_i & vi)[None], (ch_b & vb)[None]
+
+    if alltoall:
+        halo_spec = P(None, axis)
+        pay_specs = (P(axis, None, None), P(axis, None, None))
+    else:
+        halo_spec = P(None)
+        pay_specs = (P(axis, None), P(None))
+    rows_spec = P(axis, None, None)
+    sm = _shard_map(
+        local, mesh=mesh,
+        in_specs=(
+            P(None, axis), halo_spec, pay_specs[0], pay_specs[1],
+            rows_spec, rows_spec, rows_spec, rows_spec, P(axis, None),
+        ),
+        out_specs=(P(None, axis), halo_spec, rows_spec, rows_spec),
+        **_SM_NOCHECK,
+    )
+    from .plan import stack_group, unstack_group
+
+    def run(states_tuple, halo_tuple, pay_slot, pay_pos, rows_i, valid_i,
+            rows_b, valid_b, idx_tbl):
+        stacked = stack_group(states_tuple)
+        halo = stack_group(halo_tuple)
+        out, new_halo, ch_i, ch_b = sm(
+            stacked, halo, pay_slot, pay_pos, rows_i, valid_i,
+            rows_b, valid_b, idx_tbl,
+        )
+        return (
+            unstack_group(out, n_g), unstack_group(new_halo, n_g),
+            ch_i, ch_b,
+        )
+
+    return jax.jit(run, donate_argnums=(0, 1) if donate else ())
+
+
+def partitioned_converge_fn(groups, mesh: Mesh, plan: dict,
+                            axis="replicas", mode: str = "gather",
+                            window: int = 8, donate: bool = True):
+    """The SHARDED ``converge_on_device``: run boundary-exchange rounds
+    to the store-wide fixed point in ONE dispatch, with quiescence
+    detected by a HIERARCHICAL residual reduction instead of a
+    per-round global barrier (the Tascade move — PAPERS.md, atomic-free
+    asynchronous reduction trees). Each shard accumulates its LOCAL
+    per-round residual partials (changed rows in its block, summed over
+    every group member — no collective) into a ``window``-slot vector;
+    every ``window`` rounds ONE log-depth ``lax.psum`` combines the
+    per-round partial VECTORS across shards and the loop exits at the
+    first round whose global residual is zero. Exactness: the tree is
+    evaluated on the same per-round residual sequence the host-driven
+    loop observes, just reduced hierarchically and ``window`` rounds at
+    a time — the returned count (final quiescent round included) is
+    identical; up to ``window - 1`` rounds may execute PAST the fixed
+    point, which join idempotence makes exact no-ops.
+
+    ``groups``: tuple of ``(codec, spec, n_members)`` — one stacked
+    ``[G, R, ...]`` population per dispatch-plan group. Returns
+    ``fn(member_states, send_tbl, idx_tbl, max_rounds) ->
+    (member_states, signed_rounds)`` with the ``converge_on_device``
+    sign convention (positive = exact rounds to quiescence, negative =
+    budget exhausted after ``-rounds``)."""
+    if window < 1:
+        raise ValueError("window must be >= 1")
+    locals_ = [
+        _grouped_exchange_local(codec, spec, plan, axis, mode)
+        for codec, spec, _n in groups
+    ]
+    equals = [
+        jax.vmap(jax.vmap(
+            lambda a, b, _c=codec, _s=spec: ~_c.equal(_s, a, b)
+        ))
+        for codec, spec, _n in groups
+    ]
+
+    def local(states_groups, send_tbl, idx, mr):
+        def round_once(sts):
+            return tuple(
+                loc(s, send_tbl, idx) for loc, s in zip(locals_, sts)
+            )
+
+        def local_residual(old_l, new_l):
+            tot = jnp.int32(0)
+            for eq, o, n in zip(equals, old_l, new_l):
+                tot = tot + jnp.sum(eq(o, n).astype(jnp.int32))
+            return tot
+
+        def super_body(carry):
+            sts, rounds, done_at = carry
+            t = jnp.minimum(jnp.int32(window), mr - rounds)
+
+            def inner(i, c):
+                s_l, partials = c
+                new_l = round_once(s_l)
+                return new_l, partials.at[i].set(local_residual(s_l, new_l))
+
+            # unexecuted slots keep a nonzero sentinel so the first-zero
+            # scan below never reads past the executed prefix (sentinel
+            # 1, NOT a huge constant: the psum multiplies it by the
+            # shard count and must never overflow int32 to zero)
+            sts2, partials = jax.lax.fori_loop(
+                0, t, inner,
+                (sts, jnp.ones((window,), jnp.int32)),
+            )
+            totals = jax.lax.psum(partials, axis)  # ONE collective / window
+            zero = totals == 0
+            done_at = jnp.where(
+                jnp.any(zero),
+                rounds + jnp.argmax(zero).astype(jnp.int32) + 1,
+                done_at,
+            )
+            return sts2, rounds + t, done_at
+
+        def cond(carry):
+            _s, rounds, done_at = carry
+            return (done_at < 0) & (rounds < mr)
+
+        sts, rounds, done_at = jax.lax.while_loop(
+            cond, super_body, (states_groups, jnp.int32(0), jnp.int32(-1))
+        )
+        return sts, jnp.where(done_at > 0, done_at, -rounds)
+
+    tbl_spec = (
+        P(axis, None, None) if alltoall_mode(mode) else P(axis, None)
+    )
+    n_groups = len(groups)
+    sm = _shard_map(
+        local, mesh=mesh,
+        in_specs=(
+            tuple(P(None, axis) for _ in range(n_groups)),
+            tbl_spec, P(axis, None), P(),
+        ),
+        out_specs=(tuple(P(None, axis) for _ in range(n_groups)), P()),
+        **_SM_NOCHECK,
+    )
+    from .plan import stack_group, unstack_group
+
+    def run(member_states, send_tbl, idx_tbl, mr):
+        stacked = tuple(stack_group(ms) for ms in member_states)
+        out, signed = sm(stacked, send_tbl, idx_tbl, jnp.int32(mr))
+        return (
+            tuple(
+                unstack_group(o, len(ms))
+                for o, ms in zip(out, member_states)
+            ),
+            signed,
+        )
+
+    return jax.jit(run, donate_argnums=(0,) if donate else ())
 
 
 def shard_frontier_counts(frontier, n_shards: int):

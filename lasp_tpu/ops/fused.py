@@ -199,10 +199,13 @@ def fused_frontier_rounds(
     under ``edge_mask``); the new frontier is exactly the rows the round
     inflated. Per-round compute here stays dense (the masked select is
     for exact frontier semantics, not work skipping — this variant
-    serves SHARDED populations, where a host-scheduled row gather would
-    fight the partitioner; the work-skipping host path is
-    ``mesh.gossip.gossip_round_rows``). Returns ``(new_states,
-    new_frontier, n_productive)``."""
+    serves plainly auto-sharded populations, where a host-scheduled row
+    gather would fight the partitioner; the work-skipping host path is
+    ``mesh.gossip.gossip_round_rows``, and PARTITIONED meshes have the
+    real thing: ``mesh.shard_gossip.partitioned_frontier_round_fn``
+    moves only dirty cut rows over the wire with the interior joins
+    overlapping the exchange). Returns ``(new_states, new_frontier,
+    n_productive)``."""
 
     def cond(carry):
         _s, f, i = carry

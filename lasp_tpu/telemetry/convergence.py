@@ -394,6 +394,26 @@ class ConvergenceMonitor:
                 union, part["plan"]
             )
             probe["cut_rows"] = int(part["plan"]["stats"]["send_rows"])
+            # the sparse exchange's cumulative wire ledger: what the
+            # sharded-frontier rounds actually moved vs what the dense
+            # cut plane would have, plus the interior/boundary split of
+            # the overlapped joins (exchange-vs-interior overlap headroom)
+            moved = getattr(runtime, "part_exchange_bytes_total", 0)
+            plane = getattr(runtime, "part_dense_plane_bytes_total", 0)
+            ir = getattr(runtime, "part_interior_rows_total", 0)
+            br = getattr(runtime, "part_boundary_rows_total", 0)
+            probe["shard_exchange"] = {
+                "payload_bytes_total": int(moved),
+                "dense_plane_bytes_total": int(plane),
+                "wire_cut": (
+                    round(plane / moved, 2) if moved else None
+                ),
+                "interior_rows_total": int(ir),
+                "boundary_rows_total": int(br),
+                "interior_overlap_frac": (
+                    round(ir / (ir + br), 4) if (ir + br) else None
+                ),
+            }
         if _registry.enabled():
             reg = _registry.get_registry()
             for v, behind in per_var.items():

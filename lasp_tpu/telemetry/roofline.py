@@ -82,6 +82,7 @@ FAMILIES = (
     "converge",
     "chaos_window",
     "boundary_exchange",
+    "shard_exchange",
     "dataflow_fused",
     "quorum_step",
     "aae_hash",
@@ -282,6 +283,27 @@ def kernel_traffic(
         lo = G * F * int(row_bytes)
         hi = 3 * G * F * (int(row_bytes) + 4) + pad
         return TrafficEstimate(moved, lo, hi, 0)
+
+    if family == "shard_exchange":
+        # the SPARSE partitioned frontier round (shard_gossip.
+        # partitioned_frontier_round_fn): ``rows`` is the bucket-padded
+        # cut-row payload the collective moves (crossing the wire twice,
+        # send + receive; pad slots are real collective slots),
+        # ``exchange_rows`` the frontier-reachable rows the overlapped
+        # interior/boundary joins touch — (K+1) gathered rows + 1
+        # written per touched row, stacked G-wide. The runtime's
+        # dispatch site passes exact figures (bytes_moved/joins
+        # overrides); this analytic branch seeds the family for
+        # roofline_workload and prices ad-hoc calls.
+        X = int(rows or 0)  # payload rows (bucket-padded)
+        F = int(exchange_rows)  # joined (touched) rows
+        moved = G * (2 * X + (K + 2) * F) * int(row_bytes)
+        lo = G * 2 * X * int(row_bytes)
+        hi = (
+            G * (2 * X + (2 * K + 4) * F) * int(row_bytes)
+            + 2 * G * S + N + pad
+        )
+        return TrafficEstimate(moved, lo, hi, G * F * K)
 
     # boundary_exchange: the partitioned round's wire+local traffic —
     # local read+write of the population plus the cut rows crossing the

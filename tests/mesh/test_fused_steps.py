@@ -432,6 +432,95 @@ def test_converge_on_device_budget_and_mask():
     assert rt.divergence("s") == 0
 
 
+def test_converge_on_device_under_chaos_edge_mask():
+    """converge_on_device with a chaos-compiled edge mask (a masked
+    FIXED point, not the fault-free one): exact round counts and
+    bit-identical states vs the host-stepped loop under the SAME
+    mask — the mask rides as a traced operand through the while body."""
+    import jax
+
+    from lasp_tpu.chaos import ChaosSchedule, Partition
+
+    def build():
+        store = Store(n_actors=4)
+        s = store.declare(id="s", type="lasp_gset", n_elems=8)
+        rt = ReplicatedRuntime(
+            store, Graph(store), 48, random_regular(48, 3, seed=4)
+        )
+        rt.update_batch(s, [(0, ("add", "a"), "w0"),
+                            (24, ("add", "b"), "w1")])
+        return rt, s
+
+    rt_d, s = build()
+    rt_h, _ = build()
+    sched = ChaosSchedule(
+        48, random_regular(48, 3, seed=4), seed=9,
+        events=[Partition(0, 1 << 30, 2)],
+    )
+    mask = jnp.asarray(sched.mask_at(0))
+    host_rounds = 0
+    while True:
+        host_rounds += 1
+        if rt_h.step(edge_mask=mask) == 0:
+            break
+    dev_rounds = rt_d.converge_on_device(edge_mask=mask)
+    assert dev_rounds == host_rounds
+    same = jax.tree_util.tree_map(
+        lambda a, b: bool(jnp.array_equal(a, b)),
+        rt_d.states[s], rt_h.states[s],
+    )
+    assert all(jax.tree_util.tree_leaves(same))
+    # the masked fixed point is NOT the fault-free one: healing the
+    # mask converges further (non-vacuousness of the mask operand)
+    assert rt_d.run_to_convergence() > 1
+    assert rt_d.coverage_value(s) == {"a", "b"}
+
+
+def test_converge_interleaved_with_fused_steps_no_donation():
+    """donate_steps=False: the `_fused_steps_cache["while"]` entry and
+    the integer-block entries share one cache — interleaving
+    converge_on_device between fused_steps blocks (and a plain step)
+    must keep state intact and reach the same fixed point as a twin
+    running the same schedule, with no donation poisoning."""
+    import jax
+
+    def build():
+        store = Store(n_actors=4)
+        s = store.declare(id="s", type="lasp_gset", n_elems=8)
+        rt = ReplicatedRuntime(
+            store, Graph(store), 32, random_regular(32, 3, seed=7),
+            donate_steps=False,
+        )
+        rt.update_batch(s, [(0, ("add", "a"), "w0")])
+        return rt, s
+
+    rt, s = build()
+    twin, _ = build()
+    # the same interleaved schedule on both: fused block -> step ->
+    # on-device while -> fused block again (the "while" cache entry is
+    # exercised before AND after integer-block entries)
+    for r in (rt, twin):
+        r.fused_steps(2)
+        r.step()
+        r.converge_on_device()
+        r.update_batch(s, [(5, ("add", "b"), "w1")])
+        r.fused_steps(3)
+        r.converge_on_device()
+    assert rt._poisoned is None
+    assert "while" in rt._fused_steps_cache
+    assert any(isinstance(k, int) for k in rt._fused_steps_cache)
+    same = jax.tree_util.tree_map(
+        lambda a, b: bool(jnp.array_equal(a, b)),
+        rt.states[s], twin.states[s],
+    )
+    assert all(jax.tree_util.tree_leaves(same))
+    assert rt.coverage_value(s) == {"a", "b"}
+    assert rt.divergence(s) == 0
+    # undonated entry states stay readable after every dispatch (the
+    # keep-state-across-failures mode's core guarantee)
+    _ = rt.states[s]
+
+
 def test_read_until_on_device_matches_host_loop():
     """The device-parked read (lax.while_loop threshold wait) delivers
     the same row, fails the same ways, and stops exactly when met."""

@@ -25,6 +25,7 @@ def test_scenario_registry_complete():
         "packed_vs_dense",
         "bridge_throughput",
         "partitioned_gossip",
+        "mesh_scale",
         "frontier_sparse",
         "many_vars",
         "dataflow_chain",

@@ -455,7 +455,11 @@ def test_multichip_evidence_extraction_and_validation():
     ge = _graft()
     good = {
         "devices": [{"id": 0, "platform": "cpu", "kind": "cpu"}],
-        "boundary_exchange": {"per_shard_cut_bytes": [128, 128]},
+        "boundary_exchange": {
+            "per_shard_cut_bytes": [128, 128],
+            "cut_rows_sparse_bytes": 64,
+            "cut_rows_dense_bytes": 256,
+        },
     }
     import json
 
@@ -467,6 +471,15 @@ def test_multichip_evidence_extraction_and_validation():
     assert ge._validate_evidence({"devices": []}) is not None
     assert ge._validate_evidence(
         {"devices": [{"id": 0}], "boundary_exchange": {}}
+    ) is not None
+    # r13: sparse-vs-dense exchange accounting is part of the contract —
+    # a record without the measured pair is the old claim-not-measure
+    # shape and must fail validation
+    assert ge._validate_evidence(
+        {
+            "devices": [{"id": 0}],
+            "boundary_exchange": {"per_shard_cut_bytes": [128]},
+        }
     ) is not None
     assert ge._extract_evidence("rc=0 but no evidence line\n") is None
 
@@ -498,6 +511,39 @@ def test_dryrun_inline_emits_evidence():
     assert be["alltoall_bytes_per_round"] > 0
     assert ev["tiers"]["packed_converge_rounds"] >= 1
     assert ev["tiers"]["partitioned_converge_rounds"] >= 1
+    # r13 tiers: the sharded frontier ran and measured its exchange
+    assert ev["tiers"]["sharded_frontier_rounds"] >= 1
+    assert ev["tiers"]["hier_converge_rounds"] >= 1
+    assert be["cut_rows_sparse_bytes"] > 0
+    assert be["cut_rows_dense_bytes"] > 0
+
+
+def test_shard_exchange_traffic_family():
+    """The sparse partitioned exchange's analytic family: bytes scale
+    with the PAYLOAD (2x on the wire) plus the joined rows, never the
+    population — and the family is priced per stacked group width."""
+    from lasp_tpu.telemetry.roofline import kernel_traffic
+
+    one = kernel_traffic(
+        "shard_exchange", row_bytes=64, n_replicas=1 << 20, fanout=3,
+        rows=128, exchange_rows=512, g_active=1,
+    )
+    # payload crosses twice + (K+2) moves per joined row
+    assert one.bytes_moved == (2 * 128 + 5 * 512) * 64
+    assert one.joins == 512 * 3
+    grp = kernel_traffic(
+        "shard_exchange", row_bytes=64, n_replicas=1 << 20, fanout=3,
+        rows=128, exchange_rows=512, g_active=4,
+    )
+    assert grp.bytes_moved == 4 * one.bytes_moved
+    assert one.xla_lo <= one.bytes_moved <= one.xla_hi
+    # population-independent: the same payload at 8x the population
+    # moves the same bytes (the whole point of the sparse exchange)
+    big = kernel_traffic(
+        "shard_exchange", row_bytes=64, n_replicas=1 << 23, fanout=3,
+        rows=128, exchange_rows=512, g_active=1,
+    )
+    assert big.bytes_moved == one.bytes_moved
 
 
 # -- bench arm roofline -------------------------------------------------------

@@ -1,0 +1,105 @@
+#!/usr/bin/env python3
+"""North-star scale run: 1M-10M replicas across the (emulated or real)
+multi-chip mesh, with roofline accounting — ROADMAP open item 1's
+artifact producer.
+
+Runs ``bench_scenarios.mesh_scale`` (the sharded-frontier steady-state
+workload: sparse boundary exchange + hierarchical on-device
+quiescence) at population scale and persists a MULTICHIP-shaped JSON
+artifact carrying per-shard cut-row bytes, ``cut_rows_sparse_bytes``
+vs ``cut_rows_dense_bytes``, the exchange-vs-interior overlap
+fraction, rounds-to-quiescence, achieved GB/s and ``roofline_frac``
+via the capability registry — real per-device numbers, never
+``{ok: true, tail: ""}``.
+
+Usage::
+
+    python tools/scale_run.py --replicas 1048576 --devices 8 \
+        --out docs/artifacts/scale_run.json
+
+On a machine without accelerators the mesh is CPU-emulated
+(``--xla_force_host_platform_device_count``); on TPU pass
+``--no-force-cpu`` so the real chips serve the mesh."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replicas", type=int, default=1 << 20)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--cycles", type=int, default=2)
+    ap.add_argument("--write-frac", type=float, default=0.002)
+    ap.add_argument("--vars", type=int, default=2)
+    ap.add_argument("--mode", choices=["gather", "alltoall"],
+                    default="alltoall")
+    ap.add_argument("--sync-every", type=int, default=8)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: stdout only)")
+    ap.add_argument("--no-force-cpu", action="store_true",
+                    help="use the machine's real accelerators instead "
+                         "of the emulated CPU mesh")
+    args = ap.parse_args()
+
+    if not args.no_force_cpu:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        os.environ["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={args.devices}"
+        ).strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    import jax
+
+    if not args.no_force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from lasp_tpu.bench_scenarios import mesh_scale
+
+    t0 = time.time()
+    out = mesh_scale(
+        n_replicas=args.replicas,
+        n_shards=args.devices,
+        write_frac=args.write_frac,
+        cycles=args.cycles,
+        n_vars=args.vars,
+        mode=args.mode,
+        sync_every=args.sync_every,
+    )
+    artifact = {
+        "ok": True,
+        "kind": "scale_run",
+        "wall_seconds": round(time.time() - t0, 1),
+        "devices": [
+            {
+                "id": int(d.id),
+                "platform": str(d.platform),
+                "kind": str(getattr(d, "device_kind", d.platform)),
+            }
+            for d in jax.devices()[: args.devices]
+        ],
+        **out,
+    }
+    text = json.dumps(artifact, indent=1, default=str)
+    print(text)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fp:
+            fp.write(text + "\n")
+        print(f"scale_run: artifact written to {args.out}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
