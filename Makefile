@@ -63,6 +63,7 @@ verify:
 	python tools/quorum_smoke.py
 	python tools/serve_smoke.py
 	python tools/aae_smoke.py
+	python tools/ingest_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

@@ -476,6 +476,19 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["many_vars"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- plan-grouped ingest arm (~seconds): 128 mixed-codec vars absorb
+    # Zipf-hot client-op cycles (adds/increments/removes/map field
+    # writes) under per-var vs grouped op-table dispatch from identical
+    # snapshots — bit-identical final states and one-dispatch-per-active-
+    # group-per-cycle asserted inside the scenario; both arm medians land
+    # in its impl_block_seconds --------------------------------------------
+    try:
+        from lasp_tpu.bench_scenarios import ingest_storm
+
+        detail["ingest_storm"] = ingest_storm()
+    except Exception as exc:
+        detail["ingest_storm"] = {"error": f"{type(exc).__name__}: {exc}"}
+
     # -- sharded frontier on the partitioned mesh (~seconds at CI shape):
     # sparse boundary exchange vs the dense cut plane at measured dirty
     # fractions + the hierarchical on-device quiescence tree; the slow

@@ -1410,6 +1410,277 @@ def many_vars(
     }
 
 
+def _ingest_schedule(ids, kinds, n_replicas: int, cycles: int,
+                     ops_per_cycle: int, seed: int) -> list:
+    """The ingest_storm op schedule: ``cycles`` serving cycles of
+    ``ops_per_cycle`` client ops each, Zipf-hot over variables, mixed
+    verbs — adds/increments dominate, OR-Set/OR-SWOT removes target
+    terms KNOWN live at their position (the precondition must hold so
+    both arms replay the identical schedule), map field writes ride the
+    per-var fallback. Pure function of the seed; returned as
+    ``[{var: [(replica, op, actor), ...]}, ...]``."""
+    rng = np.random.RandomState(seed)
+    n_vars = len(ids)
+    # Zipf-hot variable popularity (rank-1/r weights)
+    w = 1.0 / np.arange(1, n_vars + 1)
+    w /= w.sum()
+    order = rng.permutation(n_vars)
+    live: dict = {}  # (var, replica) -> [added-not-removed terms]
+    mints: dict = {}  # (var, replica, term-slot) -> OR-Set adds issued
+    schedule = []
+    for _c in range(cycles):
+        cycle: dict = {}
+        vs = rng.choice(n_vars, size=ops_per_cycle, p=w)
+        rows = rng.randint(0, n_replicas, size=ops_per_cycle)
+        rolls = rng.rand(ops_per_cycle)
+        for v_rank, r, roll in zip(vs, rows, rolls):
+            v = int(order[v_rank])
+            var, kind = ids[v], kinds[v % len(kinds)]
+            r = int(r)
+            actor = f"a{r % 4}"
+            if kind == "riak_dt_gcounter":
+                op = ("increment", 1 + int(roll * 3))
+            elif kind == "riak_dt_map":
+                op = (
+                    ("update", "hits", ("increment",))
+                    if roll < 0.5
+                    else ("update", "tags", ("add", f"t{int(roll * 8)}"))
+                )
+            else:
+                bag = live.setdefault((var, r), [])
+                removable = kind in ("lasp_orset", "riak_dt_orswot")
+                if removable and bag and roll < 0.15:
+                    op = ("remove", bag.pop())
+                else:
+                    t0 = int(roll * 8)
+                    if kind == "lasp_orset":
+                        # OR-Set tokens never free: cap adds per (var,
+                        # replica, term) at the actor pool width so the
+                        # schedule can never exhaust a slot pool (both
+                        # arms must replay it error-free)
+                        t0 = next(
+                            (t % 8 for t in range(t0, t0 + 8)
+                             if mints.get((var, r, t % 8), 0) < 8),
+                            None,
+                        )
+                        if t0 is None:
+                            if bag:
+                                op = ("remove", bag.pop())
+                                cycle.setdefault(var, []).append(
+                                    (r, op, actor)
+                                )
+                            continue
+                        mints[(var, r, t0)] = mints.get((var, r, t0), 0) + 1
+                    term = f"e{t0}"
+                    op = ("add", term)
+                    if removable and term not in bag:
+                        bag.append(term)
+            cycle.setdefault(var, []).append((r, op, actor))
+        schedule.append(cycle)
+    return schedule
+
+
+def ingest_storm(
+    n_replicas: int = 256,
+    n_vars: int = 128,
+    cycles: int = 6,
+    ops_per_cycle: int = 2048,
+    fanout: int = 3,
+    seed: int = 31,
+    reps: int = 3,
+    gate: "float | None" = 3.0,
+) -> dict:
+    """Plan-grouped device-resident ingest A/B — the write-path twin of
+    ``many_vars``: a store of ``n_vars`` mixed-codec CRDTs (G-Set /
+    G-Counter / OR-SWOT / OR-Set / riak_dt_map, cycled) absorbs
+    ``cycles`` serving cycles of Zipf-hot client ops (adds, increments,
+    live-targeted removes, map field writes) under both ingest arms:
+
+    - **per_var** (``plan="off"``): the historical path — every
+      variable's batch resolves and dispatches on its own, O(vars
+      touched) device dispatches per cycle;
+    - **grouped** (``plan="auto"``): ops resolve into dense op tables
+      and every same-signature variable lands in ONE vmapped kernel
+      per dispatch-plan group per cycle (``mesh.ingest``) — map vars
+      ride the per-var fallback by contract.
+
+    Both arms replay the IDENTICAL schedule warm from snapshots
+    (median of ``reps``), final states are asserted bit-identical
+    in-scenario, and the grouped arm's DISPATCH COUNT is asserted:
+    exactly one ``ingest_apply`` dispatch per active plan group per
+    cycle. ``impl_roofline`` prices both arms against the shared
+    ``ingest_apply`` ledger numerator (the ingest work is identical;
+    the arms differ in dispatch count — the PR 7 like-for-like rule).
+    The artifact also carries the ``_normalize_ops`` allocation check:
+    scalar-op batches must materialize O(1), not O(ops) (the
+    copy-on-write micro-fix)."""
+    import tracemalloc
+
+    import jax
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.mesh.ingest import group_key
+    from lasp_tpu.store import Store
+    from lasp_tpu.telemetry import get_ledger
+
+    kinds = ("lasp_gset", "riak_dt_gcounter", "riak_dt_orswot",
+             "lasp_orset", "riak_dt_map")
+    nbrs = random_regular(n_replicas, fanout, seed=seed)
+
+    def build(plan: str):
+        store = Store(n_actors=4)
+        ids = []
+        for i in range(n_vars):
+            kind = kinds[i % len(kinds)]
+            if kind == "lasp_gset":
+                ids.append(store.declare(id=f"v{i}", type=kind, n_elems=16))
+            elif kind == "riak_dt_gcounter":
+                ids.append(store.declare(id=f"v{i}", type=kind, n_actors=4))
+            elif kind == "riak_dt_orswot":
+                ids.append(store.declare(id=f"v{i}", type=kind, n_elems=8,
+                                         n_actors=4))
+            elif kind == "lasp_orset":
+                ids.append(store.declare(id=f"v{i}", type=kind, n_elems=8,
+                                         n_actors=4, tokens_per_actor=8))
+            else:
+                ids.append(store.declare(
+                    id=f"v{i}", type=kind,
+                    fields=[("tags", "lasp_gset", {"n_elems": 8}),
+                            ("hits", "riak_dt_gcounter", {})],
+                    n_actors=4,
+                ))
+        rt = ReplicatedRuntime(store, Graph(store), n_replicas, nbrs,
+                               plan=plan)
+        return rt, ids
+
+    probe_rt, probe_ids = build("auto")
+    schedule = _ingest_schedule(
+        probe_ids, kinds, n_replicas, cycles, ops_per_cycle, seed
+    )
+    # expected grouped dispatches: one per ACTIVE plan group per cycle
+    # (encodable vars only — map rides the fallback)
+    expected_dispatches = 0
+    for cycle in schedule:
+        sigs = {
+            group_key(probe_rt, v)
+            for v in cycle
+            if probe_rt.store.variable(v).type_name != "riak_dt_map"
+        }
+        expected_dispatches += len(sigs)
+    del probe_rt
+
+    def drive(rt, ids) -> None:
+        for cycle in schedule:
+            rt.ingest_cycle(cycle)
+        jax.block_until_ready([rt.states[v] for v in ids])
+
+    from lasp_tpu.telemetry.registry import get_registry
+
+    def dispatch_total() -> int:
+        ent = get_registry().snapshot().get("ingest_apply_dispatches_total")
+        return (
+            sum(s["value"] for s in ent["series"]) if ent else 0
+        )
+
+    results = {}
+    finals = {}
+    dispatch_check = None
+    for arm, plan in (("per_var", "off"), ("grouped", "auto")):
+        rt, ids = build(plan)
+        snap = _snapshot_runtime(rt)
+        before = dispatch_total()
+        drive(rt, ids)  # cold: compiles/warms every kernel in the schedule
+        if plan == "auto":
+            got = dispatch_total() - before
+            dispatch_check = {
+                "expected": expected_dispatches,
+                "got": int(got),
+            }
+            # THE dispatch contract: one kernel per active plan group
+            # per cycle, nothing else
+            assert got == expected_dispatches, dispatch_check
+        rep_secs = []
+        bytes0 = get_ledger().totals()["bytes"]
+        for _ in range(reps):
+            _restore_runtime(rt, snap)
+            _, secs = _timed(lambda: drive(rt, ids))
+            rep_secs.append(secs)
+        arm_bytes = get_ledger().totals()["bytes"] - bytes0
+        results[arm] = {
+            "seconds": float(np.median(rep_secs)),
+            "seconds_each": [round(s, 6) for s in rep_secs],
+            "noise_band": round(
+                max(rep_secs) / max(min(rep_secs), 1e-9), 2
+            ),
+            "bytes_moved": arm_bytes,
+            "reps_seconds_total": round(sum(rep_secs), 6),
+        }
+        finals[arm] = {
+            v: jax.tree_util.tree_map(np.asarray, rt.states[v]) for v in ids
+        }
+        del rt
+
+    # the grouped-ingest contract, asserted at the bench shape
+    same = jax.tree_util.tree_map(
+        lambda a, b: bool(np.array_equal(a, b)),
+        finals["per_var"], finals["grouped"],
+    )
+    assert all(jax.tree_util.tree_leaves(same)), "arm states diverged"
+
+    # micro-fix allocation check: scalar-op normalize is copy-on-write
+    big = [(0, ("increment",), "a0")] * 100_000
+    tracemalloc.start()
+    base = tracemalloc.get_traced_memory()[0]
+    out = ReplicatedRuntime._normalize_ops(big)
+    alloc = tracemalloc.get_traced_memory()[0] - base
+    tracemalloc.stop()
+    assert out is big, "scalar-op normalize must return the input list"
+    assert alloc < 65536, f"normalize allocated {alloc}B for scalar ops"
+
+    pv_s = results["per_var"]["seconds"]
+    gr_s = results["grouped"]["seconds"]
+    # shared ideal-traffic numerator: only the grouped arm ledgers
+    # ingest_apply rows, and the ingest WORK is identical across arms —
+    # per_var prices the same bytes over its own wall time
+    shared_bytes = results["grouped"]["bytes_moved"]
+    impl_roofline = _arm_roofline({
+        "per_var": (shared_bytes, results["per_var"]["reps_seconds_total"]),
+        "grouped": (shared_bytes, results["grouped"]["reps_seconds_total"]),
+    })
+    speedup = round(pv_s / gr_s, 2) if gr_s > 0 else None
+    if gate is not None:
+        assert speedup is not None and speedup >= gate, (
+            f"grouped ingest speedup {speedup}x under the {gate}x gate"
+        )
+    return {
+        "scenario": f"ingest_storm_{n_vars}x{n_replicas}",
+        "n_replicas": n_replicas,
+        "n_vars": n_vars,
+        "cycles": cycles,
+        "ops_per_cycle": ops_per_cycle,
+        "fanout": fanout,
+        "dispatches": dispatch_check,
+        "impl_block_seconds": {
+            "per_var": round(pv_s, 6),
+            "grouped": round(gr_s, 6),
+        },
+        "impl_roofline": impl_roofline,
+        "normalize_alloc_bytes": int(alloc),
+        "timing": {
+            "policy": f"median of {reps} warm snapshot replays per arm",
+            "per_var": results["per_var"],
+            "grouped": results["grouped"],
+        },
+        "ingest_impl": "grouped" if gr_s <= pv_s else "per_var",
+        "ingest_speedup": speedup,
+        "gate": gate,
+        "engine": "ReplicatedRuntime.ingest_cycle (mesh.ingest op tables)",
+        "check": "bit-identical final states across arms + one dispatch "
+                 "per active plan group per cycle",
+    }
+
+
 def _build_dataflow_chains(n_chains: int, depth: int):
     """The ``dataflow_chain`` graph: ``n_chains`` parallel depth-``depth``
     combinator chains cycling the three dataflow codec shapes — G-Set
@@ -2566,6 +2837,7 @@ SCENARIOS = {
     "mesh_scale": mesh_scale,
     "frontier_sparse": frontier_sparse,
     "many_vars": many_vars,
+    "ingest_storm": ingest_storm,
     "dataflow_chain": dataflow_chain,
     "chaos_heal": chaos_heal,
     "quorum_kv": quorum_kv,

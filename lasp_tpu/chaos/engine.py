@@ -599,6 +599,32 @@ class ChaosRuntime:
             )
         self.rt.update_at(replica, var_id, op, actor)
 
+    def write_batch(self, var_id: str, ops) -> None:
+        """``update_batch`` with availability semantics — the batched
+        twin of :meth:`write_at`, bit-identical to a per-op ``write_at``
+        loop: the ops BEFORE the first one targeting a crashed replica
+        apply (through the grouped ingest arm, ``mesh.ingest``), the
+        refused op raises :class:`ReplicaDownError` with nothing of
+        itself or its suffix applied."""
+        ops = list(ops)
+        down = next(
+            (k for k, (r, _op, _a) in enumerate(ops)
+             if self.crashed[int(r)]),
+            None,
+        )
+        if down is None:
+            self.rt.update_batch(var_id, ops)
+            return
+        if down:
+            self.rt.update_batch(var_id, ops[:down])
+        replica = int(ops[down][0])
+        err = ReplicaDownError(
+            f"replica {replica} is down; route the write to a live "
+            f"replica ({self.live_replicas()[:4].tolist()}...)"
+        )
+        err.batch_index = down
+        raise err
+
     # -- the soak driver ------------------------------------------------------
     def soak(self, max_rounds: int = 4096, mode: str = "dense",
              block: int = 1,

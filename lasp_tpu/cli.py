@@ -861,7 +861,8 @@ def main(argv=None) -> int:
         choices=["aae_scrub", "adcounter_10m", "adcounter_6",
                  "bridge_throughput",
                  "chaos_heal", "dataflow_chain", "frontier_sparse",
-                 "gset_1k", "many_vars", "mesh_scale", "orset_100k",
+                 "gset_1k", "ingest_storm", "many_vars", "mesh_scale",
+                 "orset_100k",
                  "packed_vs_dense",
                  "partitioned_gossip", "pipeline_1m", "quorum_kv",
                  "serve_load"],

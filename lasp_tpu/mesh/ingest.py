@@ -1,0 +1,691 @@
+"""Plan-grouped device-resident ingest: tensorized client-op tables.
+
+The write-path twin of the gossip plan compiler (``mesh.plan``). The
+read-side hot paths are megabatched — gossip rounds stack same-signature
+variables into one kernel per plan group, dataflow sweeps fuse into
+megakernels — but client INGEST historically stayed per-variable: one
+``update_batch`` per var per serving cycle, each paying host-side
+resolution plus O(1) device dispatches of its own. At hundreds of small
+named CRDTs (the reference's global naming surface) the per-var dispatch
+floor dominates the ingest loop long before per-op compute does —
+exactly the observation PR 5 made for gossip rounds.
+
+This module closes that gap end to end:
+
+1. **Encode** (host, once per cycle per var): a batch of client ops is
+   resolved into a dense **op table** — op-kind codes, replica rows,
+   element/field indices, actor lanes, and payloads, with every
+   data-dependent decision (OR-Set token-slot allocation, OR-SWOT clock
+   minting, remove preconditions, capacity prefixes) settled by the
+   SAME host walks the legacy per-var kernels use (the helpers are
+   shared, not copied), so sequential per-op semantics — including
+   persist-prefix-then-raise failure behavior — are preserved bit for
+   bit. Terms intern once per cycle.
+2. **Group**: tables group by ``plan.signature_of`` — the same
+   (mesh codec, spec, replica count) rule gossip dispatch groups under
+   — and pad to shared power-of-two buckets with OUT-OF-RANGE pad
+   indices (``mode="drop"`` scatters ignore them; the PR 12 pad
+   contract, no pad-write semantics to reason about).
+3. **Apply**: ONE vmapped kernel per plan group per cycle lands every
+   member's table on the stacked ``[G, R, ...]`` population — donated
+   in-place, shape-cached by (family, group width, buckets, leaf
+   shapes) so shifting batch sizes reuse executables — and computes
+   per-row CHANGED flags in-kernel (a G-Set add of a present element
+   changes nothing; everything else is change-by-construction given
+   its precondition). The flags feed the frontier scheduler and AAE
+   dirty marks directly: no host-side re-diff, and the marks equal the
+   per-op ``update_at`` path's exact inflation marks.
+
+Families with no tensorized encode (``riak_dt_map`` — presence dots
+interleave with embedded-field ops in ways one scatter pass cannot
+express) fall back to the legacy per-var arm, counted by
+``ingest_fallback_total``. ``plan="off"`` runtimes skip encoding
+entirely (the bench A/B's per_var arm).
+
+DrJAX (PAPERS.md) grounds the shape — batched client-op application as
+a traceable vmapped primitive over a stacked group axis; JITSPMM
+grounds specializing the apply kernel per (codec, op-mix-bucket)
+signature, exactly as ``plan.signature_of`` already keys gossip
+dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .plan import signature_of
+
+#: op-kind codes of the logical table encoding (the wire format each
+#: family's table columns speak — docs/PERF.md "Grouped ingest")
+OP_ADD, OP_REMOVE, OP_INCREMENT, OP_SET = 0, 1, 2, 3
+
+#: smallest table bucket; buckets grow by powers of two so shifting
+#: batch sizes reuse compiled executables
+_MIN_BUCKET = 8
+
+#: compiled-kernel cache bound (FIFO, like dataflow's PropagateCache)
+_KERNEL_CACHE_MAX = 128
+
+_kernel_cache: dict = {}
+
+
+def bucket_of(n: int) -> int:
+    """Smallest power-of-two bucket holding ``n`` slots (min 8). Zero
+    stays zero — an empty sub-table compiles to no scatter at all."""
+    if n <= 0:
+        return 0
+    b = _MIN_BUCKET
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass
+class IngestTable:
+    """One variable's RESOLVED cycle ops: per-family named columns
+    (unpadded; padded to shared buckets at group-stack time). ``kind``
+    names the apply family; ``n_ops`` the client ops encoded (the
+    metrics figure); ``slots`` the total scatter slots the table
+    carries (the pad-waste denominator)."""
+
+    kind: str
+    var_id: str
+    n_ops: int
+    arrays: dict
+
+    @property
+    def slots(self) -> int:
+        return sum(
+            int(a.shape[0]) for n, a in self.arrays.items()
+            if n.endswith("rows")
+        )
+
+
+#: per-family column roles: row-index columns pad with n_replicas (the
+#: out-of-range drop slot); everything else pads with zeros of its dtype
+_ROW_COLS = frozenset((
+    "rows", "m_rows", "t_rows", "d_rows", "c_rows",
+))
+
+
+# ---------------------------------------------------------------------------
+# encode: ops -> resolved tables (host, sequential semantics preserved)
+# ---------------------------------------------------------------------------
+
+
+def encode_batch(rt, var, tn: str, states, ops):
+    """Resolve one variable's op batch into an :class:`IngestTable`.
+
+    Returns ``(table, deferred_err)``; ``(None, None)`` means this
+    (type, shape) has no tensorized encode and the caller must take the
+    legacy per-var arm. ``deferred_err`` is the error the batch owes
+    AFTER its valid prefix applies (sequential persist-then-raise
+    semantics; ``err.batch_index`` set); the table then covers exactly
+    that prefix. Malformed shapes raise immediately with nothing
+    applied — the legacy kernels' batch-level contract."""
+    if tn == "riak_dt_gcounter":
+        return _encode_gcounter(var, states, ops), None
+    if tn == "lasp_gset":
+        return _encode_gset(var, ops), None
+    if tn == "lasp_ivar":
+        return _encode_ivar(var, states, ops), None
+    if tn == "riak_dt_orswot":
+        return _encode_orswot(rt, var, states, ops)
+    if tn in ("lasp_orset", "lasp_orset_gbtree"):
+        if var.id in rt._packed_specs:
+            return _encode_orset_packed(rt, var, states, ops)
+        return _encode_orset(rt, var, states, ops)
+    return None, None
+
+
+def _encode_gcounter(var, states, ops) -> IngestTable:
+    rows, lanes, by = [], [], []
+    for r, op, actor in ops:
+        if op[0] != "increment":
+            raise ValueError(f"update_batch: unsupported op {op!r}")
+        amount = op[1] if len(op) > 1 else 1
+        if amount < 1:
+            # the reference rejects non-positive increments; the batch
+            # must not silently deflate (the legacy kernel's rule)
+            raise ValueError(
+                f"update_batch: G-Counter increment must be >= 1, "
+                f"got {amount!r}"
+            )
+        rows.append(r)
+        lanes.append(var.actors.intern(actor))
+        by.append(amount)
+    return IngestTable("gcounter", var.id, len(ops), {
+        "rows": np.asarray(rows, dtype=np.int32),
+        "lanes": np.asarray(lanes, dtype=np.int32),
+        "amounts": np.asarray(by, dtype=np.dtype(states.counts.dtype)),
+    })
+
+
+def _encode_gset(var, ops) -> IngestTable:
+    rows, elems = [], []
+    for r, op, _actor in ops:
+        if op[0] == "add":
+            rows.append(r)
+            elems.append(var.elems.intern(op[1]))
+        elif op[0] == "add_all":
+            for e in op[1]:
+                rows.append(r)
+                elems.append(var.elems.intern(e))
+        else:
+            raise ValueError(f"update_batch: unsupported op {op!r}")
+    return IngestTable("gset", var.id, len(ops), {
+        "rows": np.asarray(rows, dtype=np.int32),
+        "elems": np.asarray(elems, dtype=np.int32),
+    })
+
+
+def _encode_ivar(var, states, ops) -> IngestTable:
+    rows, payloads = [], []
+    for r, op, _actor in ops:
+        if op[0] != "set":
+            raise ValueError(f"update_batch: unsupported op {op!r}")
+        rows.append(r)
+        payloads.append(var.ivar_payloads.intern(op[1]))
+    n_ops = len(ops)
+    rows = np.asarray(rows, dtype=np.int32)
+    payloads = np.asarray(payloads, dtype=np.dtype(states.value.dtype))
+    # sequential semantics: per row the FIRST set wins, and an already-
+    # defined row keeps its value (single assignment) — the legacy
+    # kernel's exact filter, including the touched-rows-only gather
+    if rows.size:
+        _, first = np.unique(rows, return_index=True)
+        rows, payloads = rows[first], payloads[first]
+        open_rows = ~take_rows(states.defined, rows)
+        rows, payloads = rows[open_rows], payloads[open_rows]
+    return IngestTable("ivar", var.id, n_ops, {
+        "rows": rows,
+        "vals": payloads,
+    })
+
+
+def _encode_orswot(rt, var, states, ops):
+    fail_op, err = rt._orswot_precheck(var, ops)
+    if err is not None:
+        err.batch_index = fail_op
+        ops = ops[:fail_op]
+    n_ops = len(ops)
+    # normalize to flat (kind, replica, elem, actor) items — every op in
+    # the prefix is now known to succeed (the legacy batch's walk)
+    flat: list = []
+    for r, op, actor in ops:
+        verb = op[0]
+        if verb in ("add", "add_all"):
+            a = var.actors.intern(actor)
+            terms = op[1] if verb == "add_all" else [op[1]]
+            flat.extend(("add", r, var.elems.intern(e), a) for e in terms)
+        else:
+            terms = op[1] if verb == "remove_all" else [op[1]]
+            flat.extend(
+                ("remove", r, var.elems.index_of(e), -1) for e in terms
+            )
+    pairs = sorted({(int(r), int(e)) for _k, r, e, _a in flat})
+    actors = sorted({(int(r), int(a)) for _k, r, _e, a in flat if a >= 0})
+    pr = np.asarray([p[0] for p in pairs], dtype=np.int32)
+    pe = np.asarray([p[1] for p in pairs], dtype=np.int32)
+    dot_rows = {
+        p: np.array(d)
+        for p, d in zip(pairs, take_pairs(states.dots, pr, pe))
+    } if pairs else {}
+    if actors:
+        cr = np.asarray([a[0] for a in actors], dtype=np.int32)
+        ca = np.asarray([a[1] for a in actors], dtype=np.int32)
+        clocks = {
+            a: int(c)
+            for a, c in zip(actors, take_pairs(states.clock, cr, ca))
+        }
+    else:
+        clocks = {}
+    for kind, r, e, a in flat:
+        if kind == "add":
+            key = (int(r), int(a))
+            clocks[key] += 1
+            row = np.zeros_like(dot_rows[(int(r), int(e))])
+            row[int(a)] = clocks[key]
+            dot_rows[(int(r), int(e))] = row
+        else:
+            dot_rows[(int(r), int(e))][:] = 0
+    dots_dt = np.dtype(states.dots.dtype)
+    clock_dt = np.dtype(states.clock.dtype)
+    d_vals = (
+        np.stack([dot_rows[p] for p in pairs]).astype(dots_dt)
+        if pairs else np.zeros((0, int(states.dots.shape[-1])), dots_dt)
+    )
+    table = IngestTable("orswot", var.id, n_ops, {
+        "d_rows": pr,
+        "d_elems": pe,
+        "d_vals": d_vals,
+        "c_rows": np.asarray([k[0] for k in clocks], dtype=np.int32),
+        "c_lanes": np.asarray([k[1] for k in clocks], dtype=np.int32),
+        "c_vals": np.asarray(list(clocks.values()), dtype=clock_dt),
+    })
+    return table, err
+
+
+def take_rows(plane, idx) -> np.ndarray:
+    """O(batch) host pull of ``plane[idx]`` along the leading axis via
+    ONE ``jnp.take`` primitive — the encode paths' gather discipline.
+    Python-side advanced indexing (``plane[rs, es]``) walks jax's
+    ``_index_to_gather`` rewrite per call (~ms of pure-Python tracing);
+    at hundreds of per-var encodes per cycle that overhead alone would
+    eat the dispatch savings the grouped arm exists for."""
+    return np.asarray(jnp.take(plane, jnp.asarray(idx), axis=0))
+
+
+def take_pairs(plane, rs, es) -> np.ndarray:
+    """``plane[rs, es]`` for a ``[R, E, ...]`` plane as one flat take."""
+    e = int(plane.shape[1])
+    flat = np.asarray(rs, dtype=np.int64) * e + np.asarray(
+        es, dtype=np.int64
+    )
+    return take_rows(plane.reshape((-1,) + plane.shape[2:]), flat)
+
+
+class _PairCache:
+    """Host cache of touched OR-Set token rows: ONE vectorized pull of
+    every pair the batch touches (O(batch) — never the population),
+    then an evolving overlay that plays the role the re-gathered device
+    state plays for the legacy per-phase kernels."""
+
+    def __init__(self, exists, removed, pairs):
+        self.ex: dict = {}
+        self.rm: dict = {}
+        need = sorted(set(pairs))
+        if not need:
+            return
+        rs = np.asarray([p[0] for p in need], dtype=np.int32)
+        es = np.asarray([p[1] for p in need], dtype=np.int32)
+        got_ex = take_pairs(exists, rs, es)
+        got_rm = take_pairs(removed, rs, es)
+        for i, p in enumerate(need):
+            self.ex[p] = np.array(got_ex[i])
+            self.rm[p] = np.array(got_rm[i])
+
+
+def _encode_orset(rt, var, states, ops):
+    """Dense OR-Set encode: the legacy phase walk (maximal same-verb
+    runs, shared ``_alloc_pool_slots``/``_check_removes``/
+    ``_atomic_prefix`` helpers) over a host overlay of the touched
+    token rows, emitting mint triples and tombstone rows instead of
+    per-phase scatters."""
+    spec = var.spec
+    k = spec.tokens_per_actor
+    phases = _orset_phases(var, ops, k)
+    # every pair the batch touches, gathered ONCE up front: first-touch
+    # values are pre-batch state by definition, and the overlay carries
+    # all intra-batch evolution
+    cache = _PairCache(states.exists, states.removed, [
+        (int(it[0]), int(it[1]))
+        for kind, items in phases
+        for it in items
+        if kind == "add" or it[1] >= 0
+    ])
+    m_rows: list = []
+    m_elems: list = []
+    m_slots: list = []
+    t_rows: list = []
+    t_elems: list = []
+    t_vals: list = []
+    err = None
+    for kind, items in phases:
+        if kind == "add":
+            pairs = [(int(it[0]), int(it[1])) for it in items]
+            pools = np.stack([
+                cache.ex[p][it[2]: it[2] + k]
+                for p, it in zip(pairs, items)
+            ]) if items else np.zeros((0, k), bool)
+            allocs, err = rt._alloc_pool_slots(var.id, items, pools, k)
+            allocs = allocs[: rt._atomic_prefix(items, len(allocs), err)]
+            for i, slot in allocs:
+                r, e, base = items[i][0], items[i][1], items[i][2]
+                p = (int(r), int(e))
+                cache.ex[p][base + slot] = True
+                cache.rm[p][base + slot] = False
+                m_rows.append(r)
+                m_elems.append(e)
+                m_slots.append(base + slot)
+            if err is not None:
+                break
+        else:
+            live = np.asarray([
+                bool((cache.ex[(int(r), int(e))]
+                      & ~cache.rm[(int(r), int(e))]).any())
+                if e >= 0 else False
+                for r, e, _term, _opk in items
+            ])
+            n_ok, err = rt._check_removes(items, live)
+            ok_count = rt._atomic_prefix(items, n_ok, err)
+            for r, e, _term, _opk in items[:ok_count]:
+                p = (int(r), int(e))
+                t_rows.append(r)
+                t_elems.append(e)
+                # removed |= exists: the tombstone row is the CURRENT
+                # exists row (batch mints included) — the legacy
+                # scatter's exact value
+                t_vals.append(cache.ex[p].copy())
+                cache.rm[p] |= cache.ex[p]
+            if err is not None:
+                break
+    T = int(states.exists.shape[-1])
+    table = IngestTable("orset", var.id, len(ops), {
+        "m_rows": np.asarray(m_rows, dtype=np.int32),
+        "m_elems": np.asarray(m_elems, dtype=np.int32),
+        "m_slots": np.asarray(m_slots, dtype=np.int32),
+        "t_rows": np.asarray(t_rows, dtype=np.int32),
+        "t_elems": np.asarray(t_elems, dtype=np.int32),
+        "t_vals": (
+            np.stack(t_vals) if t_vals else np.zeros((0, T), bool)
+        ),
+    })
+    return table, err
+
+
+def _orset_phases(var, ops, k):
+    """The legacy batch's phase split: maximal same-verb runs in op
+    order, items carrying their op index last (the per-op atomicity
+    boundary ``_atomic_prefix`` trims at)."""
+    phases: list = []
+    for opk, (r, op, actor) in enumerate(ops):
+        verb = op[0]
+        if verb in ("add", "add_all"):
+            kind = "add"
+            a = var.actors.intern(actor)
+            terms = op[1] if verb == "add_all" else [op[1]]
+            items = [
+                (r, var.elems.intern(e), a * k, e, opk) for e in terms
+            ]
+        elif verb in ("remove", "remove_all"):
+            kind = "remove"
+            terms = op[1] if verb == "remove_all" else [op[1]]
+            items = [
+                (r, var.elems.index_of(e) if e in var.elems else -1,
+                 e, opk)
+                for e in terms
+            ]
+        else:
+            raise ValueError(f"update_batch: unsupported op {op!r}")
+        if phases and phases[-1][0] == kind:
+            phases[-1][1].extend(items)
+        else:
+            phases.append((kind, items))
+    return phases
+
+
+def _encode_orset_packed(rt, var, states, ops):
+    """Packed-mode twin: same phase walk over per-ROW word overlays,
+    emitting exact per-(row, word) DELTA masks. Mint bits target free
+    slots and tombstone deltas exclude already-set bits, so every
+    emitted bit is new — the grouped kernel applies them with a
+    uint32 add-scatter (disjoint bits never carry), which is exactly
+    bitwise-or here."""
+    pspec = rt._packed_specs[var.id]
+    d = pspec.dense
+    k = d.tokens_per_actor
+    elem_masks = rt._elem_word_masks(var.id)
+    phases = _orset_phases(var, ops, k)
+
+    ex_rows: dict = {}
+    rm_rows: dict = {}
+
+    def fetch(rows):
+        need = sorted({int(r) for r in rows if int(r) not in ex_rows})
+        if not need:
+            return
+        rs = np.asarray(need, dtype=np.int32)
+        got_ex = take_rows(states.exists, rs)
+        got_rm = take_rows(states.removed, rs)
+        for i, r in enumerate(need):
+            ex_rows[r] = np.array(got_ex[i])
+            rm_rows[r] = np.array(got_rm[i])
+
+    # one up-front pull of every touched row's word planes (pre-batch
+    # state; the overlays carry all intra-batch evolution)
+    fetch([it[0] for _kind, items in phases for it in items])
+    mint: dict = {}  # (row, word) -> uint32 delta mask
+    tomb: dict = {}
+    err = None
+    for kind, items in phases:
+        if kind == "add":
+            elems = np.asarray([it[1] for it in items], dtype=np.int64)
+            bases = np.asarray([it[2] for it in items], dtype=np.int64)
+            bits = (
+                elems[:, None] * d.n_tokens + bases[:, None] + np.arange(k)
+            )
+            words, shifts = bits // 32, bits % 32
+            pools = np.stack([
+                ((ex_rows[int(it[0])][words[i]]
+                  >> shifts[i].astype(np.uint32)) & 1).astype(bool)
+                for i, it in enumerate(items)
+            ]) if items else np.zeros((0, k), bool)
+            allocs, err = rt._alloc_pool_slots(var.id, items, pools, k)
+            allocs = allocs[: rt._atomic_prefix(items, len(allocs), err)]
+            for i, slot in allocs:
+                b = int(bits[i, slot])
+                r = int(items[i][0])
+                w, m = b // 32, np.uint32(1) << np.uint32(b % 32)
+                ex_rows[r][w] |= m
+                mint[(r, w)] = np.uint32(mint.get((r, w), 0) | m)
+            if err is not None:
+                break
+        else:
+            live = np.asarray([
+                bool((((ex_rows[int(r)] & ~rm_rows[int(r)])
+                       & elem_masks[int(e)]) != 0).any())
+                if e >= 0 else False
+                for r, e, _term, _opk in items
+            ])
+            n_ok, err = rt._check_removes(items, live)
+            ok_count = rt._atomic_prefix(items, n_ok, err)
+            for r, e, _term, _opk in items[:ok_count]:
+                r = int(r)
+                new = (ex_rows[r] & ~rm_rows[r]) & elem_masks[int(e)]
+                for w in np.flatnonzero(new):
+                    tomb[(r, int(w))] = np.uint32(
+                        tomb.get((r, int(w)), 0) | new[w]
+                    )
+                rm_rows[r] |= ex_rows[r] & elem_masks[int(e)]
+            if err is not None:
+                break
+
+    def unzip(dct):
+        rows = np.asarray([p[0] for p in dct], dtype=np.int32)
+        words = np.asarray([p[1] for p in dct], dtype=np.int32)
+        masks = np.asarray(list(dct.values()), dtype=np.uint32)
+        return rows, words, masks
+
+    m_r, m_w, m_m = unzip(mint)
+    t_r, t_w, t_m = unzip(tomb)
+    table = IngestTable("orset_packed", var.id, len(ops), {
+        "m_rows": m_r, "m_words": m_w, "m_masks": m_m,
+        "t_rows": t_r, "t_words": t_w, "t_masks": t_m,
+    })
+    return table, err
+
+
+# ---------------------------------------------------------------------------
+# apply kernels: one vmapped scatter pass per family
+# ---------------------------------------------------------------------------
+
+
+def _changed_into(changed, rows, vals=True):
+    return changed.at[rows].max(vals, mode="drop")
+
+
+def _apply_gset(state, tab):
+    rows, elems = tab["rows"], tab["elems"]
+    old = state.mask[rows, elems]  # pad gathers clip; masked by drop below
+    mask = state.mask.at[rows, elems].set(True, mode="drop")
+    changed = _changed_into(
+        jnp.zeros(state.mask.shape[0], bool), rows, ~old
+    )
+    return state._replace(mask=mask), changed
+
+
+def _apply_gcounter(state, tab):
+    counts = state.counts.at[tab["rows"], tab["lanes"]].add(
+        tab["amounts"], mode="drop"
+    )
+    changed = _changed_into(
+        jnp.zeros(state.counts.shape[0], bool), tab["rows"]
+    )
+    return state._replace(counts=counts), changed
+
+
+def _apply_ivar(state, tab):
+    rows = tab["rows"]
+    defined = state.defined.at[rows].set(True, mode="drop")
+    value = state.value.at[rows].set(tab["vals"], mode="drop")
+    changed = _changed_into(jnp.zeros(state.defined.shape[0], bool), rows)
+    return state._replace(defined=defined, value=value), changed
+
+
+def _apply_orset(state, tab):
+    mr, me, ms = tab["m_rows"], tab["m_elems"], tab["m_slots"]
+    exists = state.exists.at[mr, me, ms].set(True, mode="drop")
+    removed = state.removed.at[mr, me, ms].set(False, mode="drop")
+    # tombstone rows OR in (removed |= exists at remove time, the
+    # host-resolved value); mints-then-tombs reproduces any op
+    # interleaving because a tomb row can only include a minted slot
+    # when the remove FOLLOWED the mint (the encode walked in order)
+    removed = removed.at[tab["t_rows"], tab["t_elems"]].max(
+        tab["t_vals"], mode="drop"
+    )
+    changed = _changed_into(
+        _changed_into(jnp.zeros(state.exists.shape[0], bool), mr),
+        tab["t_rows"],
+    )
+    return state._replace(exists=exists, removed=removed), changed
+
+
+def _apply_orset_packed(state, tab):
+    # delta masks carry only NEW bits (encode contract), so the uint32
+    # add never carries and equals bitwise-or
+    exists = state.exists.at[tab["m_rows"], tab["m_words"]].add(
+        tab["m_masks"], mode="drop"
+    )
+    removed = state.removed.at[tab["t_rows"], tab["t_words"]].add(
+        tab["t_masks"], mode="drop"
+    )
+    changed = _changed_into(
+        _changed_into(jnp.zeros(state.exists.shape[0], bool),
+                      tab["m_rows"]),
+        tab["t_rows"],
+    )
+    return state._replace(exists=exists, removed=removed), changed
+
+
+def _apply_orswot(state, tab):
+    dots = state.dots.at[tab["d_rows"], tab["d_elems"]].set(
+        tab["d_vals"], mode="drop"
+    )
+    clock = state.clock.at[tab["c_rows"], tab["c_lanes"]].set(
+        tab["c_vals"], mode="drop"
+    )
+    changed = _changed_into(
+        _changed_into(jnp.zeros(state.dots.shape[0], bool),
+                      tab["d_rows"]),
+        tab["c_rows"],
+    )
+    return state._replace(dots=dots, clock=clock), changed
+
+
+_APPLIERS = {
+    "gset": _apply_gset,
+    "gcounter": _apply_gcounter,
+    "ivar": _apply_ivar,
+    "orset": _apply_orset,
+    "orset_packed": _apply_orset_packed,
+    "orswot": _apply_orswot,
+}
+
+
+# ---------------------------------------------------------------------------
+# grouping + stacked dispatch
+# ---------------------------------------------------------------------------
+
+
+def group_key(rt, var_id: str):
+    """The grouping signature of one variable's table — the SAME rule
+    gossip dispatch groups under (``plan.signature_of``); unhashable
+    specs ride singleton groups keyed by identity."""
+    sig = signature_of(rt, var_id)
+    return sig if sig is not None else ("singleton", var_id)
+
+
+def stack_tables(tables, n_replicas: int):
+    """Pad each member's columns to shared power-of-two buckets and
+    stack to ``[G, B, ...]``. Row-index columns pad with ``n_replicas``
+    — out of range, so ``mode="drop"`` scatters ignore the slot —
+    and value columns pad with zeros. Returns ``(stacked: dict,
+    buckets: tuple, pad_slots: int)``."""
+    names = list(tables[0].arrays)
+    stacked = {}
+    buckets = []
+    pad_slots = 0
+    for name in names:
+        width = max(int(t.arrays[name].shape[0]) for t in tables)
+        b = bucket_of(width)
+        buckets.append((name, b))
+        cols = []
+        for t in tables:
+            a = t.arrays[name]
+            pad = b - int(a.shape[0])
+            if name in _ROW_COLS:
+                pad_slots += pad
+            if pad:
+                fill = np.zeros((pad,) + a.shape[1:], dtype=a.dtype)
+                if name in _ROW_COLS:
+                    fill[:] = n_replicas
+                a = np.concatenate([a, fill])
+            cols.append(a)
+        stacked[name] = np.stack(cols) if cols else None
+    return stacked, tuple(buckets), pad_slots
+
+
+def _leaf_sig(state) -> tuple:
+    return tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree_util.tree_leaves(state)
+    )
+
+
+def kernel_for(kind: str, g: int, buckets: tuple, state_sig: tuple,
+               donate: bool):
+    """The compiled grouped apply for one (family, group width,
+    buckets, member leaf shapes) signature — module-level cache so
+    bench arms and twin runtimes share warm executables (FIFO-bounded;
+    shifting batch sizes hit their bucket's entry)."""
+    key = (kind, g, buckets, state_sig, donate)
+    fn = _kernel_cache.get(key)
+    if fn is not None:
+        return fn
+    applier = _APPLIERS[kind]
+
+    def run(member_states, tables):
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *member_states
+        )
+        out, changed = jax.vmap(applier)(stacked, tables)
+        members = tuple(
+            jax.tree_util.tree_map(lambda x, _i=i: x[_i], out)
+            for i in range(len(member_states))
+        )
+        return members, changed
+
+    fn = jax.jit(run, donate_argnums=(0,) if donate else ())
+    if len(_kernel_cache) >= _KERNEL_CACHE_MAX:
+        _kernel_cache.pop(next(iter(_kernel_cache)))
+    _kernel_cache[key] = fn
+    return fn
+
+
+def kernel_cache_stats() -> dict:
+    return {"entries": len(_kernel_cache)}

@@ -123,6 +123,35 @@ class _CapacityWalk:
         return None
 
 
+class _PendingBatch:
+    """One variable's prepared-but-unapplied op batch inside an ingest
+    cycle (``ReplicatedRuntime.ingest_cycle``): the host phases ran
+    (``_batch_begin``), the dispatch outcome and bookkeeping inputs
+    accumulate here until ``_batch_finalize``."""
+
+    __slots__ = ("var", "var_id", "tn", "ops", "states", "cap_err",
+                 "guard_actors", "table", "err", "marks", "seconds",
+                 "encode_failed")
+
+    def __init__(self, var, var_id, tn, ops, states, cap_err, guard_actors):
+        self.var = var
+        self.var_id = var_id
+        self.tn = tn
+        self.ops = ops
+        self.states = states
+        self.cap_err = cap_err
+        self.guard_actors = guard_actors
+        #: resolved op table (mesh.ingest), None = legacy per-var arm
+        self.table = None
+        #: dispatch/encode error (cap_err stays separate: it defers)
+        self.err = None
+        #: EXACT changed-row marks from the grouped kernel; None =
+        #: legacy arm (superset marking)
+        self.marks = None
+        self.seconds = 0.0
+        self.encode_failed = False
+
+
 class FusedBlockHandle:
     """A dispatched-but-unsynced fused block (``begin_fused_steps``):
     :meth:`finish` blocks on the device result and performs the round
@@ -787,26 +816,57 @@ class ReplicatedRuntime:
         device dispatches — the client-op kernel that makes realistic
         workloads (millions of writes between gossip rounds) feasible.
 
+        Under ``plan="auto"`` (the default) the batch rides the GROUPED
+        ingest arm (``mesh.ingest``): ops resolve into a dense op table
+        and apply through one vmapped kernel — shared, when the caller
+        batches several variables through :meth:`ingest_cycle`, with
+        every same-signature variable of the cycle (one dispatch per
+        dispatch-plan group per cycle). Types without a tensorized
+        encode (``riak_dt_map``) and ``plan="off"`` runtimes take the
+        historical per-var arm; both arms are bit-identical to
+        sequential per-op ``update_at`` application.
+
         Supports the monotone ops of the set/counter types (add / add_all /
         increment) plus OR-Set remove/remove_all. Adds and increments are
         always inflations, so the bind gate (``src/lasp_core.erl:301-311``)
         is vacuous for them; removes check the not_present precondition
         against the target row exactly like ``store.update`` does."""
-        # materialize multi-term payloads ONCE: the capacity walk and the
-        # dispatch both iterate them, and a one-shot iterator would arrive
-        # at the dispatch already drained (silent data loss)
-        ops = [
-            (
-                r,
-                (op[0], list(op[1]), *op[2:])
-                if isinstance(op, tuple)
+        self.ingest_cycle(((var_id, ops),))
+
+    @staticmethod
+    def _normalize_ops(ops) -> list:
+        """Materialize the op list ONCE, rebuilding only entries whose
+        multi-term payload must be copied (add_all / remove_all): the
+        capacity walk and the dispatch both iterate payloads, and a
+        one-shot iterator would arrive at the dispatch already drained
+        (silent data loss). Scalar ops keep their ORIGINAL tuples —
+        copy-on-write, so a 1M-op batch of adds/increments allocates
+        O(1) list scaffolding instead of one rebuilt tuple per op (pure
+        churn; the ingest_storm bench's allocation check pins it)."""
+        ops = ops if isinstance(ops, list) else list(ops)
+        out = None
+        for i, item in enumerate(ops):
+            op = item[1]
+            if (
+                isinstance(op, tuple)
                 and len(op) > 1
                 and op[0] in ("add_all", "remove_all")
-                else op,
-                actor,
-            )
-            for r, op, actor in ops
-        ]
+            ):
+                if out is None:
+                    out = ops[:i]
+                out.append((item[0], (op[0], list(op[1]), *op[2:]), item[2]))
+            elif out is not None:
+                out.append(item)
+        return ops if out is None else out
+
+    def _batch_begin(self, var_id: str, ops) -> "_PendingBatch | None":
+        """Host-side phases shared by every batched-write entry
+        (``update_batch`` / ``ingest_cycle``): normalize, map
+        late-declare sync + field admission, capacity prefix, actor
+        guard staging. Returns None for an empty batch (nothing owed —
+        the legacy early-return), raises batch-level errors
+        (``ActorCollisionError``) with nothing applied."""
+        ops = self._normalize_ops(ops)
         var = self.store.variable(var_id)
         tn = var.type_name
         if tn == "riak_dt_map":
@@ -830,7 +890,7 @@ class ReplicatedRuntime:
                 self._grow_map_population(var)
         states = self._population(var_id)
         if not ops:
-            return
+            return None
         # interner overflow must follow the same per-op prefix semantics as
         # pool/precondition failures: find the longest op prefix whose NEW
         # terms/actors fit, apply only that, then raise. Walked BEFORE the
@@ -872,8 +932,8 @@ class ReplicatedRuntime:
         guard_actors = None
         if self.debug_actors and tn in self._ACTOR_LANE_TYPES:
             # sites register only for the capacity-validated prefix, and
-            # only after the dispatch reports how far it got (below) — a
-            # failed batch extends nothing past its failure point, so a
+            # only after the dispatch reports how far it got — a failed
+            # batch extends nothing past its failure point, so a
             # caught-and-retried suffix is judged afresh rather than
             # against phantom sites
             guard_actors = [
@@ -881,74 +941,337 @@ class ReplicatedRuntime:
                 for k, (r, op, actor) in enumerate(ops)
                 if self._op_mints_lane(var, op)
             ]
-        dispatch_exc = None
-        bt = Timer()
-        bt.__enter__()
-        try:
-            if ops:
-                with span("mesh.update_batch", type=tn, ops=len(ops)):
-                    self._dispatch_batch(var, tn, states, ops)
-        except BaseException as exc:
-            dispatch_exc = exc
-            raise
-        finally:
-            bt.__exit__()
-            # timings land for failed dispatches too (a slow failing batch
-            # is exactly what an operator is hunting)
-            histogram(
-                "update_batch_seconds",
-                help="batched client-op dispatch wall time by type",
-                type=tn,
-            ).observe(bt.elapsed)
-            counter(
-                "update_batch_ops_total",
-                help="client ops submitted through update_batch",
-            ).inc(len(ops))
-            # ONE coarse causal record per batch (hot-path rule); the
-            # deep tier logs per-op provenance when an operator turned
-            # it on (events.set_deep)
-            tel_events.emit(
-                "update", var=var_id, ops=len(ops), type=tn,
-                failed=dispatch_exc is not None,
-            )
-            if tel_events.deep_enabled():
-                for r, op, actor in ops:
-                    tel_events.emit_deep(
-                        "update", var=var_id, replica=r, op=str(op[0]),
-                        actor=repr(actor),
-                    )
-            # frontier bookkeeping: the rows the batch touched are a
-            # SUPERSET of the rows it changed (non-inflations over-mark
-            # — a dirty-but-unchanged row costs one wasted gather next
-            # round, never a missed delivery); failed batches applied a
-            # prefix, still covered by the superset
-            self._mark_dirty_rows(var_id, [r for r, _op, _a in ops])
-            # a mid-batch CapacityError/PreconditionError persists the ops
-            # before the failure (sequential semantics) — their interned
-            # terms must still fold into the edge tables, or a caller that
-            # catches the error sweeps with stale projections
-            self.graph.refresh()
-            if guard_actors is not None:
-                # register write sites only for ops that actually APPLIED:
-                # the batch kernels stamp the failing op's index on the
-                # error (err.batch_index), so ops at/after it commit
-                # nothing. An error without the stamp (unexpected shape)
-                # falls back to committing the whole checked prefix —
-                # erring toward a false collision error, never a silent
-                # miss.
-                fail_idx = (
-                    getattr(dispatch_exc, "batch_index", len(ops))
-                    if dispatch_exc is not None
-                    else len(ops)
+        return _PendingBatch(var, var_id, tn, ops, states, cap_err,
+                             guard_actors)
+
+    def ingest_cycle(self, ops_by_var, isolate_errors: bool = False) -> dict:
+        """Apply one CYCLE of client writes across variables:
+        ``ops_by_var`` maps ``var_id -> [(replica, op, actor), ...]``
+        (a dict or an iterable of pairs; per-variable submission order
+        is preserved — the bit-identity precondition).
+
+        Under ``plan="auto"`` every encodable variable's ops resolve
+        into a dense op table (``mesh.ingest``) and same-signature
+        variables apply through ONE vmapped kernel per dispatch-plan
+        group — the whole cycle lands in O(plan groups) device
+        dispatches instead of O(vars), with kernel-computed changed
+        flags feeding the frontier scheduler and AAE dirty marks
+        exactly (no host-side re-diff; the marks equal per-op
+        ``update_at``'s inflation marks). Non-encodable variables
+        (``riak_dt_map``) and ``plan="off"`` runtimes ride the
+        historical per-var arm.
+
+        Error semantics per variable are ``update_batch``'s: a
+        mid-batch data failure persists the op prefix before it and
+        raises typed. With ``isolate_errors=False`` (default) the first
+        failing variable's error re-raises after every variable's
+        bookkeeping lands (for one variable this is exactly
+        ``update_batch``); ``isolate_errors=True`` (the serving
+        front-end) returns them in the report instead. Returns
+        ``{"errors", "ops", "dispatches", "groups", "grouped_vars",
+        "fallback_vars"}``."""
+        from . import ingest as ingest_mod
+
+        items = (
+            ops_by_var.items() if hasattr(ops_by_var, "items")
+            else ops_by_var
+        )
+        pendings: list = []
+        errors: dict = {}
+        seen: set = set()
+        for var_id, ops in items:
+            if var_id in seen:
+                # a second batch for one var would encode against the
+                # pre-first-batch population — merge upstream instead
+                raise ValueError(
+                    f"ingest_cycle: variable {var_id!r} appears twice "
+                    "in one cycle (merge its op lists)"
                 )
-                for actor, r, k in guard_actors:
-                    if k >= fail_idx:
-                        continue
-                    self._guard_actor_commit(
-                        self._actor_guard_keys(var, actor), r
+            seen.add(var_id)
+            try:
+                p = self._batch_begin(var_id, ops)
+            except Exception as exc:
+                if not isolate_errors:
+                    raise
+                errors[var_id] = exc
+                continue
+            if p is not None:
+                pendings.append(p)
+        # encode phase: resolve each encodable batch into its op table
+        # (host work — overlappable with an in-flight gossip window)
+        tabled: list = []
+        for p in pendings:
+            if self.plan_mode != "auto":
+                continue
+            bt = Timer()
+            bt.__enter__()
+            try:
+                with span("mesh.update_batch", type=p.tn, ops=len(p.ops)):
+                    p.table, enc_err = ingest_mod.encode_batch(
+                        self, p.var, p.tn, p.states, p.ops
                     )
-        if cap_err is not None:
-            raise cap_err
+                if enc_err is not None:
+                    p.err = enc_err
+            except Exception as exc:
+                # batch-level error (malformed shape): nothing applied,
+                # terms interned so far still fold into the edge tables
+                # at finalize — the legacy kernels' exact contract
+                p.err = exc
+                p.table = None
+                p.encode_failed = True
+            except BaseException as exc:
+                # KeyboardInterrupt/SystemExit: land THIS batch's owed
+                # bookkeeping (the legacy finally ran on these too),
+                # then propagate — never swallowed into the report
+                p.err = exc
+                p.table = None
+                p.encode_failed = True
+                self._batch_finalize(p)
+                raise
+            finally:
+                bt.__exit__()
+                p.seconds += bt.elapsed
+            if p.table is not None:
+                tabled.append(p)
+        # legacy per-var arm: plan="off", riak_dt_map, unstackable shapes
+        for p in pendings:
+            if p.table is not None or p.encode_failed:
+                continue
+            if self.plan_mode == "auto":
+                counter(
+                    "ingest_fallback_total",
+                    help="ingest batches routed to the per-var arm "
+                         "(no tensorized op-table encode for the type)",
+                    type=p.tn,
+                ).inc()
+            bt = Timer()
+            bt.__enter__()
+            try:
+                with span("mesh.update_batch", type=p.tn, ops=len(p.ops)):
+                    self._dispatch_batch(p.var, p.tn, p.states, p.ops)
+            except Exception as exc:
+                p.err = exc
+            except BaseException as exc:
+                # interrupts land this batch's bookkeeping, then propagate
+                p.err = exc
+                self._batch_finalize(p)
+                raise
+            finally:
+                bt.__exit__()
+                p.seconds += bt.elapsed
+        # grouped apply: one vmapped dispatch per plan group
+        report = self._ingest_apply_groups(tabled)
+        for p in pendings:
+            self._batch_finalize(p)
+            final = p.err if p.err is not None else p.cap_err
+            if final is not None:
+                errors[p.var_id] = final
+        report["errors"] = errors
+        report["ops"] = sum(len(p.ops) for p in pendings)
+        report["fallback_vars"] = [
+            p.var_id for p in pendings
+            if p.table is None and not p.encode_failed
+        ]
+        if tabled or report["ops"]:
+            self._observe_ingest(report)
+        if errors and not isolate_errors:
+            raise next(iter(errors.values()))
+        return report
+
+    def _ingest_apply_groups(self, tabled: list) -> dict:
+        """Dispatch the cycle's op tables: group by the gossip plan's
+        signature rule, stack members' tables to shared buckets, and
+        land each group in ONE vmapped kernel (``mesh.ingest``).
+        Changed flags come back per member as ``bool[G, R]`` and become
+        the pendings' exact dirty marks."""
+        from . import ingest as ingest_mod
+
+        groups: dict = {}
+        order: list = []
+        for p in tabled:
+            if p.table.slots == 0:
+                # nothing survived the trims: no dispatch, no marks
+                p.marks = ()
+                continue
+            key = (ingest_mod.group_key(self, p.var_id), p.table.kind)
+            if key not in groups:
+                groups[key] = []
+                order.append(key)
+            groups[key].append(p)
+        n_groups = slots = padded = 0
+        aborted: "Exception | None" = None
+        for key in order:
+            members = groups[key]
+            if aborted is not None:
+                # a poisoned runtime cannot dispatch further groups;
+                # their batches surface the abort typed (marks stay
+                # None -> conservative superset marking at finalize)
+                for p in members:
+                    p.err = RuntimeError(
+                        "ingest cycle aborted by a prior group's "
+                        f"dispatch failure: {aborted}"
+                    )
+                continue
+            g = len(members)
+            stacked, buckets, pad_slots = ingest_mod.stack_tables(
+                [p.table for p in members], self.n_replicas
+            )
+            donate = bool(self._donate_argnums())
+            fn = ingest_mod.kernel_for(
+                members[0].table.kind, g, buckets,
+                ingest_mod._leaf_sig(self.states[members[0].var_id]),
+                donate,
+            )
+            states_in = tuple(self.states[p.var_id] for p in members)
+            with span("gossip.ingest_apply", kind=key[1], vars=g):
+                with Timer() as t:
+                    try:
+                        # sync per group on purpose: the ledger's
+                        # timing-fence rule (each dispatch's record
+                        # reuses its own sync; deferring all syncs to
+                        # the end would misattribute per-signature
+                        # seconds)
+                        outs, changed = fn(states_in, stacked)
+                        changed = np.asarray(changed)  # device sync
+                    except Exception as exc:
+                        # the shared donated-dispatch failure rule; a
+                        # failed group fails ITS batches typed and must
+                        # not strand the cycle's other batches before
+                        # their finalize bookkeeping (dirty marks,
+                        # refresh) lands
+                        if donate and any(
+                            getattr(leaf, "is_deleted", lambda: False)()
+                            for state in states_in
+                            for leaf in jax.tree_util.tree_leaves(state)
+                        ):
+                            self._poisoned = (
+                                f"{type(exc).__name__}: {str(exc)[:200]}"
+                            )
+                            aborted = exc
+                        for p in members:
+                            p.err = exc
+                        continue
+            for i, p in enumerate(members):
+                self.states[p.var_id] = outs[i]
+                p.marks = np.flatnonzero(changed[i])
+                p.seconds += t.elapsed / g
+            n_groups += 1
+            gslots = sum(b for _n, b in buckets) * g
+            slots += gslots
+            padded += pad_slots
+            self._ledger_record_var(
+                "ingest_apply", members[0].var_id, t.elapsed,
+                rows=max(b for _n, b in buckets), g_active=g,
+            )
+        if n_groups:
+            counter(
+                "ingest_apply_dispatches_total",
+                help="grouped ingest kernel dispatches (one per active "
+                     "dispatch-plan group per cycle)",
+            ).inc(n_groups)
+            counter(
+                "ingest_ops_total",
+                help="client ops applied through the grouped ingest arm",
+            ).inc(sum(p.table.n_ops for p in tabled))
+            counter(
+                "ingest_pad_slots_total",
+                help="bucket-padding waste of stacked ingest tables "
+                     "(pad scatter slots, dropped in-kernel)",
+            ).inc(padded)
+            gauge(
+                "ingest_group_occupancy",
+                help="variables served per grouped ingest dispatch in "
+                     "the last cycle (mean)",
+            ).set(round(len([p for p in tabled if p.table.slots])
+                        / n_groups, 3))
+        return {
+            "dispatches": n_groups,
+            "groups": n_groups,
+            "grouped_vars": len(tabled),
+            "pad_slots": padded,
+            "table_slots": slots,
+        }
+
+    def _observe_ingest(self, report: dict) -> None:
+        """Fold the cycle's ingest accounting into the convergence
+        observatory (``health()["ingest"]``) — cheap dict update, the
+        hot-path rule."""
+        if self._instruments() is None:  # telemetry disabled
+            return
+        get_monitor().observe_ingest(
+            ops=report["ops"],
+            dispatches=report["dispatches"],
+            grouped_vars=report["grouped_vars"],
+            fallback_vars=len(report["fallback_vars"]),
+            pad_slots=report.get("pad_slots", 0),
+            table_slots=report.get("table_slots", 0),
+        )
+
+    def _batch_finalize(self, p: "_PendingBatch") -> None:
+        """Per-variable bookkeeping every batch owes whether its
+        dispatch succeeded or failed (the legacy ``finally`` block):
+        timings, the coarse causal record, frontier/AAE marks, edge-
+        table refresh, actor-guard site commits."""
+        # timings land for failed dispatches too (a slow failing batch
+        # is exactly what an operator is hunting)
+        histogram(
+            "update_batch_seconds",
+            help="batched client-op dispatch wall time by type",
+            type=p.tn,
+        ).observe(p.seconds)
+        counter(
+            "update_batch_ops_total",
+            help="client ops submitted through update_batch",
+        ).inc(len(p.ops))
+        # ONE coarse causal record per batch (hot-path rule); the
+        # deep tier logs per-op provenance when an operator turned
+        # it on (events.set_deep)
+        tel_events.emit(
+            "update", var=p.var_id, ops=len(p.ops), type=p.tn,
+            failed=p.err is not None,
+        )
+        if tel_events.deep_enabled():
+            for r, op, actor in p.ops:
+                tel_events.emit_deep(
+                    "update", var=p.var_id, replica=r, op=str(op[0]),
+                    actor=repr(actor),
+                )
+        # frontier bookkeeping. Grouped arm: the kernel-computed changed
+        # flags are EXACT (equal to per-op update_at's inflation marks —
+        # no host-side re-diff). Legacy arm: the rows the batch touched
+        # are a SUPERSET of the rows it changed (non-inflations
+        # over-mark — a dirty-but-unchanged row costs one wasted gather
+        # next round, never a missed delivery); failed batches applied
+        # a prefix, still covered by either rule.
+        if p.marks is not None:
+            if len(p.marks):
+                self._mark_dirty_rows(p.var_id, p.marks)
+        else:
+            self._mark_dirty_rows(p.var_id, [r for r, _op, _a in p.ops])
+        # a mid-batch CapacityError/PreconditionError persists the ops
+        # before the failure (sequential semantics) — their interned
+        # terms must still fold into the edge tables, or a caller that
+        # catches the error sweeps with stale projections
+        self.graph.refresh()
+        if p.guard_actors is not None:
+            # register write sites only for ops that actually APPLIED:
+            # the batch kernels stamp the failing op's index on the
+            # error (err.batch_index), so ops at/after it commit
+            # nothing. An error without the stamp (unexpected shape)
+            # falls back to committing the whole checked prefix —
+            # erring toward a false collision error, never a silent
+            # miss.
+            fail_idx = (
+                getattr(p.err, "batch_index", len(p.ops))
+                if p.err is not None
+                else len(p.ops)
+            )
+            for actor, r, k in p.guard_actors:
+                if k >= fail_idx:
+                    continue
+                self._guard_actor_commit(
+                    self._actor_guard_keys(p.var, actor), r
+                )
 
     @staticmethod
     def _capacity_prefix(var, tn, ops):
@@ -1570,7 +1893,12 @@ class ReplicatedRuntime:
             es = np.asarray(
                 [var.elems.index_of(p[1]) for p in probe], dtype=np.int32
             )
-            present = np.asarray((states.dots[rs, es] > 0).any(axis=-1))
+            # flat-take gather (ingest.take_pairs): Python advanced
+            # indexing would pay the _index_to_gather rewrite per var
+            # per cycle on the grouped encode hot path
+            from .ingest import take_pairs
+
+            present = (take_pairs(states.dots, rs, es) > 0).any(axis=-1)
             live = {p: bool(v) for p, v in zip(probe, present)}
         else:
             live = {}

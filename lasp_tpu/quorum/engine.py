@@ -304,37 +304,116 @@ class QuorumRuntime:
         self._comp_cache = (key, mask, comp)
         return comp
 
-    def _prepare(self, rid: int, rnd: int) -> None:
-        """PREPARE → WAITING_R: pick the preflist, apply a put's op at
-        the coordinator. A crashed coordinator routes to the next live
-        replica first (the preflist routing the reference gets from
-        riak_core)."""
-        req = self._reqs[rid]
-        coord = int(self._coord[rid])
-        if self.ch.crashed[coord]:
-            nxt = fsm.next_live_coordinator(coord, self.ch.crashed)
-            if nxt is None:
-                self._fail(rid, rnd, "no live replica to coordinate")
-                return
-            coord = nxt
-            self._coord[rid] = coord
-        picks = fsm.preflist(coord, req.n, self.rt.n_replicas)
-        self._picks[rid, : req.n] = picks
-        self._picks[rid, req.n:] = 0
-        self._pick_valid[rid] = False
-        self._pick_valid[rid, : req.n] = True
-        self._acks[rid] = False
-        self._deadline[rid] = rnd + req.timeout
-        if req.kind == "put" and req.put_row is None:
+    def _prepare_batch(self, rnd: int) -> None:
+        """PREPARE → WAITING_R for every pending request in ONE pass:
+        preflists pick per request (a crashed coordinator routes to the
+        next live replica first — the riak_core routing), then ALL
+        puts' coordinator deltas mint through one grouped ingest cycle
+        (``ReplicatedRuntime.ingest_cycle`` / ``mesh.ingest``: one
+        vmapped dispatch per dispatch-plan group instead of one
+        ``update_at`` per put) and their minted rows gather in one
+        batched pull per variable.
+
+        Puts hitting the SAME (var, coordinator row) in one round mint
+        in sequential WAVES: each put's recorded delta row must reflect
+        exactly the ops at or before it (the per-op gather contract —
+        a later same-row put's delta must not widen an earlier put's
+        pushes), so duplicate-row rounds degrade gracefully toward the
+        sequential path; the common unique-row round is one wave.
+
+        Mint failures keep their request in PREPARE (retried — and
+        re-raised — next round) and re-raise after the round's other
+        mints issue: an applied mint MUST transition, or its retry
+        would double-apply. The only deviation from the historical
+        per-request loop is that a mint error no longer blocks OTHER
+        variables' puts submitted after it in the same round."""
+        prep = [
+            rid for rid in self._active
+            if self._state[rid] == fsm.PREPARE
+        ]
+        staged: list = []  # (rid, coord, picks)
+        for rid in prep:
+            req = self._reqs[rid]
+            coord = int(self._coord[rid])
+            if self.ch.crashed[coord]:
+                nxt = fsm.next_live_coordinator(coord, self.ch.crashed)
+                if nxt is None:
+                    self._fail(rid, rnd, "no live replica to coordinate")
+                    continue
+                coord = nxt
+                self._coord[rid] = coord
+            picks = fsm.preflist(coord, req.n, self.rt.n_replicas)
+            self._picks[rid, : req.n] = picks
+            self._picks[rid, req.n:] = 0
+            self._pick_valid[rid] = False
+            self._pick_valid[rid, : req.n] = True
+            self._acks[rid] = False
+            self._deadline[rid] = rnd + req.timeout
+            staged.append((rid, coord, picks))
+        # wave assignment: occurrence index of (var, coord-row) this round
+        waves: list = []
+        occurrence: dict = {}
+        need_mint: set = set()
+        for rid, coord, _picks in staged:
+            req = self._reqs[rid]
+            if req.kind == "put" and req.put_row is None:
+                need_mint.add(rid)
+                key = (req.var, coord)
+                w = occurrence.get(key, 0)
+                occurrence[key] = w + 1
+                while len(waves) <= w:
+                    waves.append({})
+                waves[w].setdefault(req.var, []).append(rid)
+        minted: set = set()
+        mint_exc = None
+        for wave in waves:
+            if mint_exc is not None:
+                break  # unminted requests stay PREPARE and retry
+            batches = {
+                var: [
+                    (int(self._coord[rid]), self._reqs[rid].op,
+                     self._reqs[rid].actor)
+                    for rid in rids
+                ]
+                for var, rids in wave.items()
+            }
+            report = self.rt.ingest_cycle(batches, isolate_errors=True)
             import jax
 
-            self.rt.update_at(coord, req.var, req.op, req.actor)
-            req.put_row = jax.tree_util.tree_map(
-                lambda x: x[coord], self.rt._population(req.var)
-            )
-            req.applied_row = coord
-        self._state[rid] = fsm.WAITING_R
-        self.trace.append((rnd, rid, "issue", (coord, picks.tolist())))
+            for var, rids in wave.items():
+                exc = report["errors"].get(var)
+                applied = len(rids)
+                if exc is not None:
+                    # sequential prefix semantics: ops before the
+                    # failure applied (batch_index marks the boundary;
+                    # a batch-level error applied nothing)
+                    applied = min(
+                        int(getattr(exc, "batch_index", 0)), len(rids)
+                    )
+                    if mint_exc is None:
+                        mint_exc = exc
+                if not applied:
+                    continue
+                pop = self.rt._population(var)
+                rows = np.asarray(
+                    [int(self._coord[rid]) for rid in rids[:applied]],
+                    dtype=np.int64,
+                )
+                got = jax.tree_util.tree_map(lambda x: x[rows], pop)
+                for i, rid in enumerate(rids[:applied]):
+                    req = self._reqs[rid]
+                    req.put_row = jax.tree_util.tree_map(
+                        lambda x, _i=i: x[_i], got
+                    )
+                    req.applied_row = int(self._coord[rid])
+                    minted.add(rid)
+        for rid, coord, picks in staged:
+            if rid in need_mint and rid not in minted:
+                continue  # mint failed/aborted: stays PREPARE, retries
+            self._state[rid] = fsm.WAITING_R
+            self.trace.append((rnd, rid, "issue", (coord, picks.tolist())))
+        if mint_exc is not None:
+            raise mint_exc
 
     def _fail(self, rid: int, rnd: int, why: str) -> None:
         req = self._reqs[rid]
@@ -426,9 +505,8 @@ class QuorumRuntime:
     def _fsm_step(self, rnd: int) -> dict:
         # PREPARE processing first: a request submitted before this round
         # issues now, so this round's reachability already counts replies
-        for rid in self._active:
-            if self._state[rid] == fsm.PREPARE:
-                self._prepare(rid, rnd)
+        # (put mints ride one grouped ingest dispatch per plan group)
+        self._prepare_batch(rnd)
         active = [
             rid for rid in self._active
             if self._state[rid] in (fsm.WAITING_R, fsm.WAITING_N)

@@ -21,13 +21,17 @@ One serving cycle:
    it; this host-side work (dequeue, op grouping, interning) OVERLAPS
    the in-flight device window — the async-runtime-loop claim,
    measured by ``serve_ingest_overlap_seconds``;
-3. **sync the window**, then apply the write megabatches — one
-   ``update_batch`` dispatch per variable, in submission order per
-   variable, which is BIT-IDENTICAL to sequential per-request
-   application (ops on one variable apply in order; ops on different
-   variables commute because every op touches only its own variable's
-   planes — the same two-phase argument as the quorum layer's batched
-   rounds, asserted by tools/serve_smoke.py and tests/serve/);
+3. **sync the window**, then apply the write megabatches through ONE
+   grouped ingest cycle (``ReplicatedRuntime.ingest_cycle`` /
+   ``mesh.ingest``): the whole drained cycle's ops resolve into dense
+   op tables and every same-signature variable lands in one vmapped
+   dispatch per dispatch-plan group — O(plan groups), not O(vars),
+   device dispatches per cycle — in submission order per variable,
+   which is BIT-IDENTICAL to sequential per-request application (ops
+   on one variable apply in order; ops on different variables commute
+   because every op touches only its own variable's planes — the same
+   two-phase argument as the quorum layer's batched rounds, asserted
+   by tools/serve_smoke.py, tools/ingest_smoke.py and tests/serve/);
 4. **resolve reads** (threshold-less reads answer from the post-write
    population; threshold reads park as subscriptions) and **register
    watches**;
@@ -139,6 +143,10 @@ class ServeFrontend:
         self._lock = threading.Lock()
         self._overlap_seconds = 0.0
         self._gossip_rounds = 0
+        #: grouped-ingest accounting (mesh.ingest via ingest_cycle):
+        #: device dispatches and ops landed through the grouped arm
+        self._ingest_dispatches = 0
+        self._ingest_grouped_ops = 0
 
     # -- submission (any thread) ---------------------------------------------
     def submit_write(self, var_id: str, op: tuple, actor, *,
@@ -375,6 +383,8 @@ class ServeFrontend:
         applied = 0
         now = self.clock()
         with span("serve.flush"):
+            batches: dict = {}
+            kept_by_var: dict = {}
             for var_id, ops in groups.items():
                 # route per op: an unroutable op (crashed target, lane-
                 # minting — see _route) fails ITS ticket only, never
@@ -389,14 +399,26 @@ class ServeFrontend:
                     except Exception as exc:
                         t.fail(f"{type(exc).__name__}: {exc}", now)
                         self._account(t)
-                if not batch:
-                    continue
-                try:
-                    self.rt.update_batch(var_id, batch)
-                except Exception as exc:
-                    # the kernels' prefix semantics may have applied a
-                    # leading slice; the tickets get a typed error (the
-                    # outcome is the caller's to re-issue), never a hang
+                if batch:
+                    batches[var_id] = batch
+                    kept_by_var[var_id] = kept
+            if not batches:
+                return 0
+            # the WHOLE drained cycle lands in one grouped ingest:
+            # same-signature variables share one vmapped dispatch per
+            # plan group (O(groups), not O(vars), device dispatches per
+            # cycle — mesh.ingest), with per-variable error isolation:
+            # a failing variable's tickets get a typed error (its
+            # kernels' prefix semantics may have applied a leading
+            # slice; the outcome is the caller's to re-issue), never a
+            # hang, and never another variable's outcome
+            report = self.rt.ingest_cycle(batches, isolate_errors=True)
+            self._ingest_dispatches += report["dispatches"]
+            self._ingest_grouped_ops += report["ops"]
+            for var_id, batch in batches.items():
+                kept = kept_by_var[var_id]
+                exc = report["errors"].get(var_id)
+                if exc is not None:
                     for t in kept:
                         t.fail(f"{type(exc).__name__}: {exc}", now)
                         self._account(t)
@@ -665,6 +687,8 @@ class ServeFrontend:
                 "latency": latency,
                 "overlap_seconds": round(self._overlap_seconds, 6),
                 "gossip_rounds": self._gossip_rounds,
+                "ingest_dispatches": self._ingest_dispatches,
+                "ingest_grouped_ops": self._ingest_grouped_ops,
                 "admission": self.admission.snapshot(),
             }
         get_monitor().observe_serve(**{

@@ -401,6 +401,20 @@ def run_load(
         "overlap_seconds": rep["overlap_seconds"],
         "gossip_rounds": rep["gossip_rounds"],
         "cycles": rep["cycles"],
+        # the grouped-ingest rate line: how many client ops landed
+        # through the plan-grouped arm and in how many device
+        # dispatches (mesh.ingest — one per codec group per cycle)
+        "ingest": {
+            "grouped_ops": rep["ingest_grouped_ops"],
+            "dispatches": rep["ingest_dispatches"],
+            "ops_per_dispatch": round(
+                rep["ingest_grouped_ops"]
+                / max(rep["ingest_dispatches"], 1), 2
+            ),
+            "dispatches_per_cycle": round(
+                rep["ingest_dispatches"] / max(rep["cycles"], 1), 3
+            ),
+        },
         "acked_writes": sum(len(ts) for ts in fe.acked_terms.values()),
         "no_write_lost": True,
         "threshold_parity": parity,

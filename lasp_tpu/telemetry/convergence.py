@@ -104,6 +104,10 @@ class ConvergenceMonitor:
         #: repair traffic, hash work — aae.AAEScrubber.report); empty
         #: until a scrubber reports
         self.aae: dict = {}
+        #: CUMULATIVE grouped-ingest accounting (ops, dispatches,
+        #: grouped vs fallback vars, bucket-pad waste — fed per
+        #: ``ReplicatedRuntime.ingest_cycle``); empty until a cycle runs
+        self.ingest: dict = {}
         self._tel: "dict | None" = None
 
     def _check_generation(self) -> None:
@@ -242,6 +246,42 @@ class ConvergenceMonitor:
             self._check_generation()
             self.aae.update(report)
             self.aae["round"] = self.round
+
+    def observe_ingest(self, *, ops: int, dispatches: int,
+                       grouped_vars: int, fallback_vars: int,
+                       pad_slots: int = 0, table_slots: int = 0) -> None:
+        """Fold one grouped-ingest cycle's accounting into the health
+        surface (``ReplicatedRuntime.ingest_cycle``). CUMULATIVE on
+        purpose — unlike the latest-report sections, ingest is a
+        per-cycle hot path and operators want rates, so the snapshot
+        carries running totals plus the derived occupancy/pad views
+        under the ``ingest`` key (the ``{health}`` verb and ``lasp_tpu
+        top`` read it alongside ``serve``)."""
+        with self._lock:
+            self._check_generation()
+            ing = self.ingest
+            ing["cycles"] = ing.get("cycles", 0) + 1
+            ing["ops"] = ing.get("ops", 0) + int(ops)
+            ing["dispatches"] = ing.get("dispatches", 0) + int(dispatches)
+            ing["grouped_vars"] = (
+                ing.get("grouped_vars", 0) + int(grouped_vars)
+            )
+            ing["fallback_vars"] = (
+                ing.get("fallback_vars", 0) + int(fallback_vars)
+            )
+            ing["pad_slots"] = ing.get("pad_slots", 0) + int(pad_slots)
+            ing["table_slots"] = (
+                ing.get("table_slots", 0) + int(table_slots)
+            )
+            if ing["dispatches"]:
+                ing["vars_per_dispatch"] = round(
+                    ing["grouped_vars"] / ing["dispatches"], 3
+                )
+            if ing["table_slots"]:
+                ing["pad_frac"] = round(
+                    ing["pad_slots"] / ing["table_slots"], 4
+                )
+            ing["round"] = self.round
 
     def observe_membership(self, kind: str, old_n: int, new_n: int) -> None:
         with self._lock:
@@ -534,6 +574,7 @@ class ConvergenceMonitor:
                 "quorum": dict(self.quorum),
                 "serve": dict(self.serve),
                 "aae": dict(self.aae),
+                "ingest": dict(self.ingest),
                 "residual_curve": curve[-64:],
                 "memberships": list(self.memberships),
                 "probe": self.last_probe,

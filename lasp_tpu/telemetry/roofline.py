@@ -86,6 +86,7 @@ FAMILIES = (
     "dataflow_fused",
     "quorum_step",
     "aae_hash",
+    "ingest_apply",
 )
 
 
@@ -283,6 +284,23 @@ def kernel_traffic(
         lo = G * F * int(row_bytes)
         hi = 3 * G * F * (int(row_bytes) + 4) + pad
         return TrafficEstimate(moved, lo, hi, 0)
+
+    if family == "ingest_apply":
+        # the grouped client-op apply kernel (mesh.ingest): per table
+        # slot the scatter indices/payload stream in (~4 int32-ish
+        # columns) and the targeted state entries read+write — bounded
+        # above by one full row per slot (an OR-Set tombstone rewrites
+        # a [T] token row; a counter bump touches one lane), plus the
+        # [G, R] changed-flag plane out. Coarse by design, like
+        # ``quorum_step``: the ledger row exists to show ingest's
+        # device cost next to the gossip rounds it feeds, not to chase
+        # an HBM bound (the kernel is scatter-latency-, not
+        # bandwidth-, limited). No calibrated xla bounds.
+        F = int(rows or 0)
+        moved = G * F * (4 * _IDX_BYTES + 2 * int(row_bytes)) + G * R
+        lo = G * F * 4 * _IDX_BYTES
+        hi = 4 * moved + 2 * G * S + pad
+        return TrafficEstimate(moved, lo, hi, G * F)
 
     if family == "shard_exchange":
         # the SPARSE partitioned frontier round (shard_gossip.

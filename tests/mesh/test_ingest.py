@@ -1,0 +1,395 @@
+"""Plan-grouped device-resident ingest (mesh.ingest): the grouped
+op-table arm must be indistinguishable from sequential per-op
+``update_at`` application — final states, error surfaces, frontier and
+AAE dirty marks — across codecs × plan modes × failure edges, and the
+cycle-level dispatch contract (one vmapped kernel per active plan group
+per cycle) must hold."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import Store, PreconditionError
+from lasp_tpu.utils.interning import CapacityError
+
+N = 6
+
+
+def _declare_all(store, packed_shapes=False):
+    ids = {
+        "g": store.declare(id="g", type="lasp_gset", n_elems=16),
+        "c": store.declare(id="c", type="riak_dt_gcounter", n_actors=4),
+        "o": store.declare(id="o", type="lasp_orset", n_elems=8,
+                           n_actors=4, tokens_per_actor=4),
+        "w": store.declare(id="w", type="riak_dt_orswot", n_elems=8,
+                           n_actors=4),
+        "i": store.declare(id="i", type="lasp_ivar"),
+        "m": store.declare(
+            id="m", type="riak_dt_map",
+            fields=[("tags", "lasp_gset", {"n_elems": 8}),
+                    ("hits", "riak_dt_gcounter", {})],
+            n_actors=4,
+        ),
+    }
+    return ids
+
+
+def _build(plan="auto", packed=False, debug_actors=False):
+    store = Store(n_actors=4)
+    _declare_all(store)
+    rt = ReplicatedRuntime(store, Graph(store), N, ring(N, 2),
+                           plan=plan, packed=packed,
+                           debug_actors=debug_actors)
+    rt._aae_dirty = {}  # activate the AAE dirty accumulator (forest feed)
+    return rt
+
+
+_OPS = {
+    "g": [(0, ("add", "a"), "x"), (1, ("add_all", ["b", "c"]), "x"),
+          (0, ("add", "a"), "x"), (2, ("add", "b"), "x")],
+    "c": [(0, ("increment",), "a0"), (1, ("increment", 3), "a1"),
+          (0, ("increment", 2), "a0")],
+    "o": [(0, ("add", "e1"), "a0"), (0, ("add_all", ["e2", "e3"]), "a0"),
+          (0, ("remove", "e1"), "a0"), (0, ("add", "e1"), "a1"),
+          (3, ("add", "e2"), "a3"), (0, ("remove_all", ["e2", "e3"]), "a0")],
+    "w": [(2, ("add", "s1"), "a2"), (2, ("add_all", ["s2", "s3"]), "a2"),
+          (2, ("remove", "s1"), "a2"), (4, ("add", "s1"), "a0"),
+          (2, ("add", "s1"), "a2")],
+    "i": [(0, ("set", "v1"), "x"), (0, ("set", "v2"), "x"),
+          (3, ("set", "v3"), "x")],
+    "m": [(0, ("update", "tags", ("add", "t1")), "w0"),
+          (1, ("update", "hits", ("increment", 2)), "w1"),
+          (0, ("remove", "tags"), "w0"),
+          (0, ("update", "tags", ("add", "t2")), "w0")],
+}
+
+
+def _states_np(rt, v):
+    return jax.tree_util.tree_map(np.asarray, rt.states[v])
+
+
+def _assert_same_var(rt_a, rt_b, v):
+    a, b = _states_np(rt_a, v), _states_np(rt_b, v)
+    same = jax.tree_util.tree_map(
+        lambda x, y: bool(np.array_equal(x, y)), a, b
+    )
+    assert all(jax.tree_util.tree_leaves(same)), f"{v}: states diverged"
+    fa = rt_a._frontier.get(v)
+    fb = rt_b._frontier.get(v)
+    assert np.array_equal(
+        fa if fa is not None else np.zeros(N, bool),
+        fb if fb is not None else np.zeros(N, bool),
+    ), f"{v}: frontier marks diverged"
+    da = rt_a._aae_dirty.get(v)
+    db = rt_b._aae_dirty.get(v)
+    assert np.array_equal(
+        da if da is not None else np.zeros(N, bool),
+        db if db is not None else np.zeros(N, bool),
+    ), f"{v}: AAE dirty marks diverged"
+
+
+@pytest.mark.parametrize("var", ["g", "c", "o", "w", "i", "m"])
+@pytest.mark.parametrize("packed", [False, True])
+def test_grouped_matches_per_op(var, packed):
+    """THE bit-identity matrix: grouped op-table application ==
+    sequential per-op update_at — states, frontier, AAE marks — for
+    every codec (map via the per-var fallback) in dense and packed
+    mode."""
+    grouped = _build("auto", packed=packed)
+    ref = _build("auto", packed=packed)
+    grouped.update_batch(var, list(_OPS[var]))
+    for r, op, actor in _OPS[var]:
+        try:
+            ref.update_at(r, var, op, actor)
+        except Exception:
+            pass  # non-inflations etc. never raise here by construction
+    _assert_same_var(grouped, ref, var)
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_grouped_matches_plan_off(packed):
+    """Whole-store sweep: plan=auto vs plan=off land bit-identical
+    states (the legacy arm is the per_var bench arm)."""
+    a = _build("auto", packed=packed)
+    b = _build("off", packed=packed)
+    for var, ops in _OPS.items():
+        a.update_batch(var, list(ops))
+        b.update_batch(var, list(ops))
+    for var in _OPS:
+        sa, sb = _states_np(a, var), _states_np(b, var)
+        same = jax.tree_util.tree_map(
+            lambda x, y: bool(np.array_equal(x, y)), sa, sb
+        )
+        assert all(jax.tree_util.tree_leaves(same)), var
+
+
+def _dispatch_total():
+    from lasp_tpu.telemetry.registry import get_registry
+
+    ent = get_registry().snapshot().get("ingest_apply_dispatches_total")
+    return sum(s["value"] for s in ent["series"]) if ent else 0
+
+
+def test_ingest_cycle_one_dispatch_per_group():
+    """A multi-var cycle lands in ONE kernel dispatch per plan group:
+    here 2 gset vars share a signature (one dispatch), the counter is
+    its own group, and the map rides the per-var fallback (zero grouped
+    dispatches)."""
+    store = Store(n_actors=4)
+    g1 = store.declare(id="g1", type="lasp_gset", n_elems=16)
+    g2 = store.declare(id="g2", type="lasp_gset", n_elems=16)
+    c1 = store.declare(id="c1", type="riak_dt_gcounter", n_actors=4)
+    m1 = store.declare(
+        id="m1", type="riak_dt_map",
+        fields=[("hits", "riak_dt_gcounter", {})], n_actors=4,
+    )
+    rt = ReplicatedRuntime(store, Graph(store), N, ring(N, 2))
+    before = _dispatch_total()
+    report = rt.ingest_cycle({
+        g1: [(0, ("add", "a"), "x")],
+        g2: [(1, ("add", "b"), "x"), (2, ("add", "c"), "x")],
+        c1: [(0, ("increment",), "a0")],
+        m1: [(0, ("update", "hits", ("increment",)), "w0")],
+    })
+    assert report["dispatches"] == 2  # {g1, g2} stacked + {c1}
+    assert _dispatch_total() - before == 2
+    assert report["errors"] == {}
+    assert report["fallback_vars"] == [m1]
+    assert rt.coverage_value(g1) == {"a"}
+    assert rt.coverage_value(g2) == {"b", "c"}
+    assert rt.coverage_value(c1) == 1
+    assert rt.coverage_value(m1) == {"hits": 1}
+    # grouped marks are EXACT: only the written rows are dirty
+    assert np.flatnonzero(rt._frontier[g2]).tolist() == [1, 2]
+
+
+def test_orset_remove_not_present_identical():
+    """The failure-edge contract: OR-Set remove of an absent element
+    fails at its position with the prefix persisted — error type,
+    final state, and marks identical between the grouped arm and the
+    per-op update_at loop."""
+    grouped = _build("auto")
+    ref = _build("auto")
+    ops = [(0, ("add", "e1"), "a0"), (1, ("remove", "missing"), "a1"),
+           (2, ("add", "e2"), "a2")]
+    with pytest.raises(PreconditionError) as gexc:
+        grouped.update_batch("o", list(ops))
+    assert gexc.value.batch_index == 1
+    ref_exc = None
+    for r, op, actor in ops:
+        try:
+            ref.update_at(r, "o", op, actor)
+        except PreconditionError as exc:
+            ref_exc = exc
+            break  # sequential semantics: stop at the failure
+    assert type(ref_exc).__name__ == type(gexc.value).__name__
+    assert str(ref_exc) == str(gexc.value)
+    _assert_same_var(grouped, ref, "o")
+
+
+def test_map_late_declared_fields_identical():
+    """riak_dt_map fields admitted mid-batch (dynamic {Name, Type}
+    keys): identical result between the grouped arm's fallback and
+    per-op update_at, including the late-declare spec/population
+    sync."""
+    KEY = ("S", "lasp_gset")
+    KEY2 = ("C", "riak_dt_gcounter")
+
+    def fresh():
+        store = Store(n_actors=4)
+        rt = ReplicatedRuntime(store, Graph(store), N, ring(N, 2))
+        # declared AFTER the runtime was built: no population row yet —
+        # the late-declare sync must run before field admission
+        m = store.declare(id="m", type="riak_dt_map", n_actors=4)
+        rt._aae_dirty = {}
+        return rt, m
+
+    ops = [
+        (0, ("update", [("update", KEY, ("add", "x"))]), "w0"),
+        (1, ("update", [("update", KEY2, ("increment", 2))]), "w1"),
+        (0, ("update", [("update", KEY2, ("increment",))]), "w0"),
+    ]
+    grouped, m = fresh()
+    grouped.update_batch(m, list(ops))
+    ref, m2 = fresh()
+    for r, op, actor in ops:
+        ref.update_at(r, m2, op, actor)
+    ga, rb = grouped.coverage_value(m), ref.coverage_value(m2)
+    assert ga == rb
+    a, b = _states_np(grouped, m), _states_np(ref, m2)
+    same = jax.tree_util.tree_map(
+        lambda x, y: bool(np.array_equal(x, y)), a, b
+    )
+    assert all(jax.tree_util.tree_leaves(same))
+
+
+def test_chaos_crashed_replica_refusal_identical():
+    """ChaosRuntime.write_batch == a per-op write_at loop: ops before
+    the first one targeting a crashed replica apply, the refused op
+    raises ReplicaDownError, nothing after applies."""
+    from lasp_tpu.chaos.engine import ChaosRuntime, ReplicaDownError
+    from lasp_tpu.chaos.schedule import ChaosSchedule, Crash
+
+    def fresh():
+        store = Store(n_actors=4)
+        store.declare(id="g", type="lasp_gset", n_elems=16)
+        rt = ReplicatedRuntime(store, Graph(store), N, ring(N, 2))
+        ch = ChaosRuntime(rt, ChaosSchedule(
+            N, ring(N, 2), [Crash(0, 2)], seed=3,
+        ))
+        ch.step()  # executes the crash
+        assert ch.crashed[2]
+        return rt, ch
+
+    ops = [(0, ("add", "a"), "x"), (1, ("add", "b"), "x"),
+           (2, ("add", "c"), "x"), (3, ("add", "d"), "x")]
+    rt_b, ch_b = fresh()
+    with pytest.raises(ReplicaDownError) as bexc:
+        ch_b.write_batch("g", list(ops))
+    assert bexc.value.batch_index == 2
+    rt_s, ch_s = fresh()
+    seq_exc = None
+    for r, op, actor in ops:
+        try:
+            ch_s.write_at(r, "g", op, actor)
+        except ReplicaDownError as exc:
+            seq_exc = exc
+            break
+    assert seq_exc is not None
+    assert str(seq_exc) == str(bexc.value)
+    sa, sb = _states_np(rt_b, "g"), _states_np(rt_s, "g")
+    same = jax.tree_util.tree_map(
+        lambda x, y: bool(np.array_equal(x, y)), sa, sb
+    )
+    assert all(jax.tree_util.tree_leaves(same))
+    assert rt_b.coverage_value("g") == {"a", "b"}
+
+
+def test_actor_collision_refusal_identical():
+    """debug_actors: a lane collision inside one batch refuses
+    all-or-nothing under both arms — same error, nothing applied."""
+    from lasp_tpu.mesh.runtime import ActorCollisionError
+
+    for plan in ("auto", "off"):
+        rt = _build(plan, debug_actors=True)
+        with pytest.raises(ActorCollisionError):
+            rt.update_batch("w", [(0, ("add", "x"), "a0"),
+                                  (1, ("add", "y"), "a0")])
+        assert rt.coverage_value("w") == frozenset()
+        f = rt._frontier.get("w")
+        assert f is None or not f.any()
+
+
+def test_capacity_prefix_identical():
+    """Interner overflow mid-batch: the grouped arm persists exactly
+    the fitting prefix and raises CapacityError, like per-op."""
+    grouped = _build("auto")
+    ref = _build("auto")
+    ops = [(0, ("add", f"t{i}"), "a0") for i in range(20)]
+    with pytest.raises(CapacityError):
+        grouped.update_batch("g", list(ops))
+    for r, op, actor in ops:
+        try:
+            ref.update_at(r, "g", op, actor)
+        except CapacityError:
+            break
+    _assert_same_var(grouped, ref, "g")
+
+
+def test_ivar_first_set_wins_and_exact_marks():
+    """IVar single-assignment under the grouped arm: per row the first
+    set wins, an already-defined row's set is a NON-inflation and marks
+    nothing (the exact-changed-flags contract)."""
+    rt = _build("auto")
+    rt.update_batch("i", [(0, ("set", "v1"), "x")])
+    rt._frontier["i"][:] = False
+    rt._aae_dirty["i"][:] = False
+    rt.update_batch("i", [(0, ("set", "v2"), "x"),
+                          (1, ("set", "v3"), "x")])
+    # row 0 was already defined: no state change, no mark; row 1 fresh
+    assert np.flatnonzero(rt._frontier["i"]).tolist() == [1]
+    assert np.flatnonzero(rt._aae_dirty["i"]).tolist() == [1]
+    assert rt.replica_value("i", 0) == "v1"
+    assert rt.replica_value("i", 1) == "v3"
+
+
+def test_isolate_errors_per_var():
+    """ingest_cycle(isolate_errors=True): a failing variable's error is
+    reported, the other variables' ops land (the serving front-end's
+    per-variable isolation contract)."""
+    rt = _build("auto")
+    report = rt.ingest_cycle({
+        "o": [(0, ("remove", "absent"), "a0")],
+        "g": [(1, ("add", "ok"), "x")],
+    }, isolate_errors=True)
+    assert set(report["errors"]) == {"o"}
+    assert isinstance(report["errors"]["o"], PreconditionError)
+    assert rt.coverage_value("g") == {"ok"}
+
+
+def test_group_dispatch_failure_does_not_strand_cycle(monkeypatch):
+    """Review regression: a grouped kernel failure fails ITS batches
+    typed but must not skip the cycle's other bookkeeping — the other
+    group still applies, every batch still lands its dirty marks /
+    telemetry, and errors surface per variable (the serve layer's
+    no-silent-drop contract depends on this)."""
+    from lasp_tpu.mesh import ingest as ingest_mod
+
+    store = Store(n_actors=4)
+    g1 = store.declare(id="g1", type="lasp_gset", n_elems=16)
+    c1 = store.declare(id="c1", type="riak_dt_gcounter", n_actors=4)
+    rt = ReplicatedRuntime(store, Graph(store), N, ring(N, 2))
+
+    real_kernel_for = ingest_mod.kernel_for
+
+    def failing_kernel_for(kind, g, buckets, state_sig, donate):
+        if kind == "gcounter":
+            def boom(states, tables):
+                raise RuntimeError("injected kernel failure")
+            return boom
+        return real_kernel_for(kind, g, buckets, state_sig, donate)
+
+    monkeypatch.setattr(ingest_mod, "kernel_for", failing_kernel_for)
+    report = rt.ingest_cycle({
+        c1: [(0, ("increment",), "a0")],
+        g1: [(1, ("add", "ok"), "x")],
+    }, isolate_errors=True)
+    assert "injected kernel failure" in str(report["errors"][c1])
+    assert g1 not in report["errors"]
+    assert rt.coverage_value(g1) == {"ok"}  # the healthy group applied
+    # the failed batch's conservative bookkeeping still landed
+    # (superset marking: its touched row is dirty even though the
+    # kernel never ran — over-marking is sound, stranding is not)
+    assert rt._frontier[c1][0]
+    assert np.flatnonzero(rt._frontier[g1]).tolist() == [1]
+
+
+def test_quorum_put_mints_ride_grouped_ingest():
+    """The quorum put path mints coordinator deltas through the grouped
+    arm: a round's puts across same-signature vars cost one grouped
+    dispatch (plus gathers), and results match the historical
+    behavior."""
+    from lasp_tpu.quorum import QuorumRuntime
+
+    store = Store(n_actors=8)
+    a = store.declare(id="qa", type="lasp_gset", n_elems=16)
+    b = store.declare(id="qb", type="lasp_gset", n_elems=16)
+    rt = ReplicatedRuntime(store, Graph(store), N, ring(N, 2))
+    q = QuorumRuntime(rt)
+    before = _dispatch_total()
+    r1 = q.submit_put(a, ("add", "x"), "w0", coordinator=0)
+    r2 = q.submit_put(b, ("add", "y"), "w1", coordinator=1)
+    q.step()  # both PREPARE puts mint in one ingest cycle
+    assert _dispatch_total() - before == 1  # same signature: one group
+    for _ in range(16):
+        if q.result(r1)["status"] == "done" and \
+                q.result(r2)["status"] == "done":
+            break
+        q.step()
+    assert q.result(r1)["status"] == "done"
+    assert q.result(r2)["status"] == "done"
+    assert rt.coverage_value(a) == {"x"}
+    assert rt.coverage_value(b) == {"y"}

@@ -28,6 +28,7 @@ def test_scenario_registry_complete():
         "mesh_scale",
         "frontier_sparse",
         "many_vars",
+        "ingest_storm",
         "dataflow_chain",
         "quorum_kv",
         "chaos_heal",
@@ -133,6 +134,26 @@ def test_many_vars_small():
     assert out["plan"]["groups"] == 3 and out["plan"]["vars"] == 12
     assert out["rounds"] >= 1 and out["plan_speedup"] > 0
     _assert_pallas_arm(out)
+
+
+def test_ingest_storm_small():
+    """The plan-grouped ingest A/B at CI shape: bit-identical final
+    states and the one-dispatch-per-active-group-per-cycle contract are
+    asserted INSIDE the scenario; here we pin the artifact shape —
+    per-arm timings, non-null rooflines against the shared ingest_apply
+    numerator, the dispatch-count record, and the _normalize_ops
+    allocation check (the copy-on-write micro-fix)."""
+    from lasp_tpu.bench_scenarios import ingest_storm
+
+    out = ingest_storm(n_replicas=32, n_vars=15, cycles=3,
+                       ops_per_cycle=150, reps=1, gate=None)
+    assert set(out["impl_block_seconds"]) == {"per_var", "grouped"}
+    assert out["dispatches"]["got"] == out["dispatches"]["expected"] > 0
+    assert out["impl_roofline"]["grouped"]["roofline_frac"] is not None
+    assert out["impl_roofline"]["per_var"]["roofline_frac"] is not None
+    assert out["normalize_alloc_bytes"] < 65536
+    assert out["ingest_speedup"] > 0
+    assert out["check"].startswith("bit-identical final states")
 
 
 def _assert_pallas_arm(out):
@@ -244,6 +265,11 @@ def test_serve_load_small():
     assert set(out["queue_high_water"]) == {"write", "read", "watch"}
     assert out["latency_ticks"]["write"]["p99"] is not None
     assert out["max_inflight"] >= 80  # the standing-watch floor
+    # the grouped-ingest rate line (writes landed through mesh.ingest:
+    # one dispatch per codec group per cycle)
+    assert out["ingest"]["dispatches"] > 0
+    assert out["ingest"]["grouped_ops"] > 0
+    assert out["ingest"]["ops_per_dispatch"] > 0
     # the shed breakdown is typed kind:reason pairs (may be empty at
     # this scale); accounting never loses a request
     offered = sum(out["offered"].values())
