@@ -50,7 +50,12 @@ test-slow:
 # the dense partitioned round AND the unsharded reference across
 # ring/random x leafwise/vclock/packed x both wire modes, plus the
 # hierarchical converge's exact-round-count contract (docs/PERF.md
-# "Sharded frontier")
+# "Sharded frontier"), and a membership smoke guards the staged
+# join/rebalance/leave round-trip's static-twin bit-equality across
+# ring/random x leafwise/vclock/packed, the no-acked-write-lost
+# contract under rolling-crash mid-rebalance, and membership_* /
+# handoff_transfer telemetry liveness (docs/RESILIENCE.md
+# "Membership & handoff")
 verify:
 	python tools/check_metrics_catalog.py
 	python tools/frontier_smoke.py
@@ -64,6 +69,7 @@ verify:
 	python tools/serve_smoke.py
 	python tools/aae_smoke.py
 	python tools/ingest_smoke.py
+	python tools/membership_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

@@ -564,6 +564,18 @@ def _child(label: str) -> int:
     except Exception as exc:
         detail["serve_load"] = {"error": f"{type(exc).__name__}: {exc}"}
 
+    # -- elastic rebalance: staged membership vs legacy full-resync,
+    # serving sustained through the transfer window; bit-equality,
+    # per-cycle caps, and the wire gate asserted in-scenario ----------------
+    try:
+        from lasp_tpu.bench_scenarios import elastic_rebalance
+
+        detail["elastic_rebalance"] = elastic_rebalance()
+    except Exception as exc:
+        detail["elastic_rebalance"] = {
+            "error": f"{type(exc).__name__}: {exc}"
+        }
+
     # -- north-star: 10M-replica engine-path ad counter ---------------------
     ns0 = cfg.bench_northstar_replicas or (
         10 * (1 << 20) if on_tpu else (1 << 13)

@@ -32,8 +32,8 @@ __version__ = "0.1.0"
 # without paying jax's import cost or risking any backend touch.
 _SUBMODULES = frozenset({
     "aae", "api", "bridge", "chaos", "config", "dataflow", "lattice",
-    "mesh", "ops", "programs", "quorum", "serve", "store", "telemetry",
-    "utils",
+    "membership", "mesh", "ops", "programs", "quorum", "serve", "store",
+    "telemetry", "utils",
 })
 _ATTRS = {
     "Session": ("api", "Session"),

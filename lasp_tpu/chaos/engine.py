@@ -78,6 +78,25 @@ class ChaosRuntime:
         self.schedule = schedule
         #: runtime checkpoint path backing Restore(source="checkpoint")
         self.checkpoint = checkpoint
+        # graceful-leave handoff guard (the PR4 degraded-read confinement
+        # rule applied to membership): a resize merge under this wrapper
+        # must not move departing state across an active partition or
+        # out of a crashed row — a host-side tree_map bypassing the very
+        # edge mask the nemesis installed. The staged membership path
+        # (lasp_tpu.membership) parks such transfers instead. A
+        # FAULT-FREE wrapper (the QuorumRuntime/MembershipCoordinator
+        # convenience wrap: no events, so no masks and no crashes ever)
+        # installs nothing — its guard would be vacuous, and overwriting
+        # here would silently neuter the guard of a real nemesis wrapper
+        # sharing the runtime.
+        if schedule.events:
+            runtime._handoff_guard = self._check_handoff
+        #: the membership epoch this wrapper's bookkeeping is based on —
+        #: the O(1) staleness guard of :meth:`sync_membership` (every
+        #: membership commit, topology swaps included, advances the
+        #: runtime's epoch; a full neighbor-table compare per round
+        #: would tax every large chaos run for nothing)
+        self._synced_epoch = runtime.membership_epoch
         if runtime.donate_steps:
             runtime.donate_steps = False
             runtime._step = None
@@ -107,6 +126,91 @@ class ChaosRuntime:
         self.crashes = 0
         self.restores = 0
         self._fused_cache: dict = {}
+
+    # -- membership -----------------------------------------------------------
+    def _check_handoff(self, sources, targets) -> None:
+        """Refuse a graceful-leave handoff that would bypass the active
+        fault state (installed as ``rt._handoff_guard``): a crashed
+        departer's frozen row cannot be read gracefully, and a
+        source→target pair spanning a partition cut would tunnel state
+        through the mask host-side. Raises the typed
+        :class:`~lasp_tpu.membership.errors.HandoffPartitionError`;
+        callers either wait for heal, crash-leave explicitly, or run
+        the staged coordinator (whose transfers park instead)."""
+        from ..membership.errors import HandoffPartitionError
+
+        # the runtime may have resized since the last round (a grow
+        # commits without consulting this guard): judge against
+        # bookkeeping re-based onto the CURRENT extent, never a stale
+        # crashed vector / schedule
+        self.sync_membership()
+        down = [int(s) for s in sources if self.crashed[int(s)]]
+        if down:
+            raise HandoffPartitionError(
+                f"graceful leave refused: departing replica(s) "
+                f"{down[:4]} are crashed — their frozen rows cannot be "
+                "handed off; restore them first or take "
+                "graceful=False (crash-leave) semantics"
+            )
+        down = [int(t) for t in targets if self.crashed[int(t)]]
+        if down:
+            raise HandoffPartitionError(
+                f"graceful leave refused: claim target(s) {down[:4]} "
+                "are crashed — a handoff cannot land on a down row"
+            )
+        mask = self.schedule.mask_at(self.round)
+        if mask is None:
+            return
+        from ..quorum.fsm import components
+
+        comp = components(
+            self.rt._host_neighbors, mask, ~self.crashed
+        )
+        bad = [
+            (int(s), int(t)) for s, t in zip(sources, targets)
+            if comp[int(s)] != comp[int(t)]
+        ]
+        if bad:
+            raise HandoffPartitionError(
+                f"graceful leave refused: handoff pair(s) {bad[:4]} "
+                "span a partition under the active chaos mask — the "
+                "merge would be a host-side side channel through the "
+                "cut; wait for heal or run the staged "
+                "MembershipCoordinator (transfers park until reachable)"
+            )
+
+    def sync_membership(self) -> bool:
+        """Re-base this wrapper's fault bookkeeping onto the runtime's
+        CURRENT membership (after a resize / staged commit): the
+        crashed vector resizes (surviving rows keep their flags;
+        dropped rows leave with theirs), and the schedule re-compiles
+        against the new extent/topology (events naming departed
+        replicas are dropped — their crash/restore can no longer
+        apply). Returns True when anything changed. Called by the
+        membership coordinator at commit and defensively by
+        :meth:`step` — a stale [R, K] mask against a resized population
+        would otherwise fail shapes rounds later."""
+        if self.rt.membership_epoch == self._synced_epoch:
+            return False
+        self._synced_epoch = self.rt.membership_epoch
+        R = self.rt.n_replicas
+        nbrs = self.rt._host_neighbors
+        if (
+            self.crashed.shape[0] == R
+            and self.schedule.n_replicas == R
+            and np.array_equal(np.asarray(self.schedule.neighbors), nbrs)
+        ):
+            return False
+        old = self.crashed
+        keep = min(old.shape[0], R)
+        crashed = np.zeros(R, dtype=bool)
+        crashed[:keep] = old[:keep]
+        self.crashed = crashed
+        self.schedule = self.schedule.rebase(R, nbrs)
+        # mask-identity entries and fused-window executables both bake
+        # the old [R, K] shapes
+        self._fused_cache.clear()
+        return True
 
     # -- fault actions --------------------------------------------------------
     def _crash(self, replica: int) -> None:
@@ -329,6 +433,9 @@ class ChaosRuntime:
         rows across it. Returns the step's residual (the engine
         contract). Deterministic in ``(seed, schedule, state)``."""
         rnd = self.round
+        # a membership commit may have changed the extent since the last
+        # round: re-base the fault bookkeeping before compiling masks
+        self.sync_membership()
         self._apply_actions(rnd)
         if self.aae is not None:
             # detect/repair BEFORE the dispatch: a corrupt row caught

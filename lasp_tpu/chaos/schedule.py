@@ -443,6 +443,27 @@ class ChaosSchedule:
                 out[t] = m
         return out
 
+    def rebase(self, n_replicas: int, neighbors) -> "ChaosSchedule":
+        """The same timeline re-compiled for a CHANGED membership
+        (``ChaosRuntime.sync_membership``): crash/restore events naming
+        a replica outside the new extent are dropped as pairs (a
+        departed replica can neither crash nor restore), windowed
+        events re-derive their masks from the new topology naturally.
+        Determinism is preserved — the same seed drives the new extent's
+        draws, so a replay that re-bases at the same round reproduces
+        the same masks."""
+        n = int(n_replicas)
+        dropped = {
+            ev.replica for ev in self.events
+            if isinstance(ev, (Crash, Restore)) and ev.replica >= n
+        }
+        events = tuple(
+            ev for ev in self.events
+            if not (isinstance(ev, (Crash, Restore))
+                    and ev.replica in dropped)
+        )
+        return ChaosSchedule(n, neighbors, events, seed=self.seed)
+
     def describe(self) -> dict:
         """Plain-data timeline summary (CLI / bench artifact embedding)."""
         return {

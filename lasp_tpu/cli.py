@@ -860,7 +860,8 @@ def main(argv=None) -> int:
         "name",
         choices=["aae_scrub", "adcounter_10m", "adcounter_6",
                  "bridge_throughput",
-                 "chaos_heal", "dataflow_chain", "frontier_sparse",
+                 "chaos_heal", "dataflow_chain", "elastic_rebalance",
+                 "frontier_sparse",
                  "gset_1k", "ingest_storm", "many_vars", "mesh_scale",
                  "orset_100k",
                  "packed_vs_dense",

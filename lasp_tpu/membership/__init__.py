@@ -1,0 +1,75 @@
+"""Epoch-fenced live membership: staged join/leave/rebalance with
+vectorized ownership handoff (riak_core claim/plan/commit +
+vnode-handoff rebuilt; ``src/lasp_console.erl:31-94``,
+``src/lasp_vnode.erl:454-472``).
+
+Modules:
+
+- :mod:`.plan` — the staging console: deterministic claim function
+  (ring-fold successors, not row 0), seed sources for joins, the
+  row-scoped frontier set, :class:`MembershipStaging` /
+  :class:`MembershipPlan`;
+- :mod:`.handoff` — :class:`HandoffEngine`: per-cycle-capped,
+  chaos-aware (component-confined, partition-parked) transfer cycles,
+  one vmapped gather–merge–scatter dispatch per dispatch-plan codec
+  group (the PR5 grouping, DrJAX-style mapped ownership transfer);
+- :mod:`.coordinator` — :class:`MembershipCoordinator`: stage → plan →
+  commit → interleaved rebalance → finalize (idempotent sweep,
+  crashed-departer hint fallback, serve watch re-homing);
+- :mod:`.harness` — :func:`run_membership_harness`: no-acked-write-
+  lost × static-twin bit-equality × typed fencing × replay determinism
+  under every nemesis preset;
+- :mod:`.errors` — :class:`StaleEpochError` (the epoch fence's typed
+  surface, raised by the quorum engine for requests spanning a
+  membership change) and :class:`HandoffPartitionError` (a graceful
+  leave refused across a partition).
+
+docs/RESILIENCE.md "Membership & handoff" documents the staged plan
+format, the claim rule, the epoch-fencing contract, and the honest
+deviations from riak_core; ``tools/membership_smoke.py`` (Makefile
+``verify``) guards the round-trip bit-equality and no-write-lost
+contracts.
+"""
+
+from .errors import HandoffPartitionError, StaleEpochError
+from .plan import (
+    MembershipPlan,
+    MembershipStaging,
+    changed_delivery_rows,
+    claim_targets,
+    seed_sources,
+)
+
+__all__ = [
+    "HandoffEngine",
+    "HandoffPartitionError",
+    "MembershipCoordinator",
+    "MembershipPlan",
+    "MembershipStaging",
+    "StaleEpochError",
+    "changed_delivery_rows",
+    "claim_targets",
+    "grouped_transfer",
+    "run_membership_harness",
+    "seed_sources",
+]
+
+#: lazily resolved (PEP 562): the coordinator/handoff/harness pull in
+#: chaos + quorum machinery; importing the package for the error types
+#: alone (the quorum engine's fence) must stay cycle- and jax-free
+_LAZY = {
+    "HandoffEngine": ("handoff", "HandoffEngine"),
+    "grouped_transfer": ("handoff", "grouped_transfer"),
+    "MembershipCoordinator": ("coordinator", "MembershipCoordinator"),
+    "run_membership_harness": ("harness", "run_membership_harness"),
+}
+
+
+def __getattr__(name: str):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(name)
+    import importlib
+
+    mod = importlib.import_module(f".{entry[0]}", __name__)
+    return getattr(mod, entry[1])

@@ -1,0 +1,340 @@
+"""Incremental vectorized ownership handoff — the transfer engine.
+
+A committed membership plan's ``transfers`` (``(source_row,
+target_row)`` pairs) execute INCREMENTALLY, interleaved with live
+gossip/serve cycles, never as a stop-the-world merge:
+
+- **capped**: at most ``per_cycle`` transfers dispatch per cycle (the
+  bounded-queue / no-global-pause contract the ``elastic_rebalance``
+  bench asserts — Tascade's barrier-free discipline applied to
+  rebalancing);
+- **grouped**: a cycle's transfers batch into ONE vmapped
+  gather–merge–scatter dispatch per dispatch-plan codec group (the PR5
+  grouping rule, ``mesh.plan.signature_of``): same-signature variables
+  stack ``[G, T, ...]`` and one kernel moves every pair for the whole
+  group — the DrJAX move, ownership transfer as a traceable mapped op;
+- **chaos-aware**: a pair dispatches only when source and target are
+  live and share a reachable component under the CURRENT chaos mask
+  (``quorum.fsm.components`` — the same labeling the quorum FSMs
+  draw). Unreachable pairs PARK and resume when the partition heals
+  (the AAE pending-rows pattern); a crashed source parks until restore
+  or the coordinator's finalize declares it lost and falls back to
+  hints + AAE;
+- **idempotent**: a transfer is a masked partial join — re-running a
+  pair is a bit-exact no-op, so the coordinator's finalize SWEEP
+  (re-join every pair until a clean pass) catches writes that landed on
+  a source after its first transfer without any freeze window.
+
+Pad contract: a cycle's pair batch bucket-pads to a power of two with
+OUT-OF-RANGE target indices; the scatter runs ``mode="drop"`` (the
+PR12/PR13 rule), so pad slots move bytes but never write.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..telemetry import counter, events as tel_events, gauge, span
+from ..telemetry.roofline import get_ledger, state_row_bytes
+from ..utils.metrics import Timer
+
+_BUCKET_MIN = 4
+
+#: compiled transfer kernels per (codec, spec-key, group width, bucket)
+#: — FIFO-bounded like the ingest kernel cache (mesh/ingest.py): a
+#: long-lived process churning runtimes must not accumulate jitted
+#: executables (and their closure-held specs) without bound
+_TRANSFER_KERNELS: dict = {}
+_TRANSFER_KERNELS_MAX = 128
+
+
+def _bucket_of(n: int) -> int:
+    b = _BUCKET_MIN
+    while b < n:
+        b *= 2
+    return b
+
+
+def _spec_key(spec):
+    try:
+        hash(spec)
+        return spec
+    except TypeError:
+        return id(spec)
+
+
+def _transfer_kernel(codec, spec, g: int, bucket: int):
+    """The jitted grouped transfer: gather source rows and target rows
+    of a ``[G, R, ...]`` stacked group, merge pairwise, scatter the
+    merged rows back at the targets (``mode="drop"`` pads), and report
+    which targets actually changed."""
+    key = (codec, _spec_key(spec), g, bucket)
+    fn = _TRANSFER_KERNELS.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    def step(stacked, srcs, dsts):
+        n = next(iter(jax.tree_util.tree_leaves(stacked))).shape[1]
+        safe_dst = jnp.minimum(dsts, n - 1)  # gather clamp for pad slots
+        src_rows = jax.tree_util.tree_map(
+            lambda x: jnp.take(x, srcs, axis=1), stacked
+        )
+        dst_rows = jax.tree_util.tree_map(
+            lambda x: jnp.take(x, safe_dst, axis=1), stacked
+        )
+        merged = jax.vmap(
+            jax.vmap(lambda a, b: codec.merge(spec, a, b))
+        )(dst_rows, src_rows)
+        changed = jax.vmap(
+            jax.vmap(lambda a, b: ~codec.equal(spec, a, b))
+        )(dst_rows, merged)
+        out = jax.tree_util.tree_map(
+            lambda x, m: x.at[:, dsts].set(m, mode="drop"), stacked, merged
+        )
+        return out, changed
+
+    fn = jax.jit(step)
+    if len(_TRANSFER_KERNELS) >= _TRANSFER_KERNELS_MAX:
+        _TRANSFER_KERNELS.pop(next(iter(_TRANSFER_KERNELS)))
+    _TRANSFER_KERNELS[key] = fn
+    return fn
+
+
+def grouped_transfer(rt, pairs) -> int:
+    """Join each pair's source row into its target row for EVERY
+    variable — one vmapped dispatch per dispatch-plan codec group.
+    ``pairs``: ``[(src, dst), ...]`` with UNIQUE targets (the scatter
+    would race otherwise — the engine's cycle selection defers
+    duplicate targets). Changed target rows mark frontier/AAE-dirty
+    exactly. Returns total rows actually changed across variables."""
+    import jax.numpy as jnp
+
+    from ..mesh.plan import signature_of
+
+    if not pairs:
+        return 0
+    srcs = np.asarray([p[0] for p in pairs], dtype=np.int64)
+    dsts = np.asarray([p[1] for p in pairs], dtype=np.int64)
+    if np.unique(dsts).size != dsts.size:
+        raise ValueError(
+            "grouped_transfer: duplicate target rows in one cycle — "
+            "the scatter would race; defer the duplicates"
+        )
+    t = len(pairs)
+    bucket = _bucket_of(t)
+    src_pad = np.zeros(bucket, dtype=np.int32)
+    src_pad[:t] = srcs
+    dst_pad = np.full(bucket, rt.n_replicas, dtype=np.int32)  # dropped
+    dst_pad[:t] = dsts
+    # group by mesh signature, var_ids order (the PR5 grouping rule);
+    # unhashable specs degrade to singletons, same as the gossip plan
+    by_sig: dict = {}
+    order: list = []
+    for v in rt.var_ids:
+        sig = signature_of(rt, v)
+        key = sig if sig is not None else ("__singleton__", v)
+        if key not in by_sig:
+            by_sig[key] = []
+            order.append(key)
+        by_sig[key].append(v)
+    total_changed = 0
+    with span("membership.transfer", rows=t, groups=len(order)):
+        for key in order:
+            members = by_sig[key]
+            codec, spec = rt._mesh_meta(members[0])
+            pops = [rt._population(v) for v in members]
+            from ..mesh.plan import stack_group, unstack_group
+
+            stacked = stack_group(pops)
+            fn = _transfer_kernel(codec, spec, len(members), bucket)
+            with Timer() as tm:
+                out, changed = fn(
+                    stacked, jnp.asarray(src_pad), jnp.asarray(dst_pad)
+                )
+                changed = np.asarray(changed)
+            views = unstack_group(out, len(members))
+            for g, v in enumerate(members):
+                rt.states[v] = views[g]
+                ch_rows = dsts[changed[g, :t]]
+                if ch_rows.size:
+                    rt._mark_dirty_rows(v, ch_rows)
+                    total_changed += int(ch_rows.size)
+            get_ledger().record(
+                "handoff_transfer",
+                getattr(codec, "name", type(codec).__name__),
+                n_replicas=rt.n_replicas,
+                fanout=1,
+                seconds=tm.elapsed,
+                row_bytes=state_row_bytes(pops[0], rt.n_replicas),
+                rows=bucket,
+                g_active=len(members),
+            )
+    return total_changed
+
+
+class HandoffEngine:
+    """Executes one plan's transfer schedule incrementally; see the
+    module doc. Owned/driven by ``MembershipCoordinator`` (one
+    :meth:`cycle` per interleaved gossip round)."""
+
+    def __init__(self, ch, transfers, *, per_cycle: int = 8,
+                 old_n: "int | None" = None, new_n: "int | None" = None):
+        self.ch = ch
+        self.rt = ch.rt
+        self.per_cycle = max(1, int(per_cycle))
+        #: the plan's transition extents (telemetry provenance: a
+        #: transfer_cycle event must say WHICH transition it serves —
+        #: the live population reads the same on both sides of a drain)
+        self.old_n = int(old_n if old_n is not None else ch.rt.n_replicas)
+        self.new_n = int(new_n if new_n is not None else ch.rt.n_replicas)
+        #: per-var single-row wire footprint, computed lazily once per
+        #: variable (constant for the life of the plan; re-walking the
+        #: population tree per dispatched batch would tax the
+        #: interleaved serve/gossip path)
+        self._row_bytes: dict = {}
+        #: pending (src, dst) pairs, deterministic schedule order
+        self.pending: list = list(transfers)
+        self.completed: list = []
+        self.cycles = 0
+        self.parked_events = 0
+        self.transferred = 0
+        self.changed_rows = 0
+        self.transfer_bytes = 0
+        self.max_batch = 0
+        self.pending_high_water = len(self.pending)
+
+    @property
+    def outstanding(self) -> int:
+        return len(self.pending)
+
+    def _reachable(self, comp, src: int, dst: int) -> bool:
+        return (
+            not self.ch.crashed[src]
+            and not self.ch.crashed[dst]
+            and comp[src] == comp[dst]
+        )
+
+    def _components(self):
+        from ..quorum.fsm import components
+
+        mask = self.ch.schedule.mask_at(self.ch.round)
+        if mask is None and not self.ch.crashed.any():
+            # fault-free round: one component, everything reachable —
+            # skip the O(E·log R) labeling (the common convenience-wrap
+            # case pays it every cycle otherwise)
+            return np.zeros(self.rt.n_replicas, dtype=np.int32)
+        return components(
+            self.rt._host_neighbors, mask, ~self.ch.crashed
+        )
+
+    def _select_and_dispatch(self, pairs, cap) -> tuple:
+        """THE selection rule, written once for :meth:`cycle` and the
+        finalize sweep (:meth:`dispatch_pairs`): dispatch up to ``cap``
+        mutually-reachable pairs with DISTINCT targets (the scatter
+        would race on duplicates) in one grouped call; everything else
+        stays in schedule order. Returns ``(batch, rest, parked,
+        changed_rows)`` — ``parked`` counts the unreachable pairs left
+        in ``rest`` (beyond-cap / duplicate-target deferrals are in
+        ``rest`` too, but reachable)."""
+        comp = self._components()
+        batch, rest, parked, seen = [], [], 0, set()
+        for src, dst in pairs:
+            ok = self._reachable(comp, src, dst)
+            if ok and (cap is None or len(batch) < cap) and dst not in seen:
+                batch.append((src, dst))
+                seen.add(dst)
+            else:
+                if not ok:
+                    parked += 1
+                rest.append((src, dst))
+        changed = self._dispatch(batch) if batch else 0
+        return batch, rest, parked, changed
+
+    def dispatch_pairs(self, pairs) -> "tuple[int, int, list]":
+        """Uncapped sweep: dispatch EVERY reachable pair (duplicate
+        targets in successive waves). Returns ``(dispatched,
+        changed_rows, parked_pairs)``."""
+        dispatched = changed = 0
+        remaining = list(pairs)
+        while True:
+            batch, remaining, parked, ch = self._select_and_dispatch(
+                remaining, None
+            )
+            dispatched += len(batch)
+            changed += ch
+            if not batch or len(remaining) == parked:
+                return dispatched, changed, remaining
+
+    def _dispatch(self, batch) -> int:
+        changed = grouped_transfer(self.rt, batch)
+        for v in self.rt.var_ids:
+            if v not in self._row_bytes:
+                self._row_bytes[v] = _row_bytes_of(self.rt, v)
+        bytes_ = sum(self._row_bytes.values()) * len(batch)
+        self.transfer_bytes += bytes_
+        self.changed_rows += changed
+        counter(
+            "membership_transfer_bytes_total",
+            help="estimated bytes moved by staged ownership-transfer "
+                 "partial joins",
+        ).inc(bytes_)
+        return changed
+
+    def cycle(self) -> dict:
+        """One transfer cycle: take up to ``per_cycle`` pending pairs
+        whose endpoints are mutually reachable this round, dispatch them
+        grouped, park the rest. Returns the cycle's accounting."""
+        self.cycles += 1
+        out = {"transfers": 0, "parked": 0, "changed_rows": 0,
+               "outstanding": len(self.pending)}
+        if not self.pending:
+            return out
+        batch, rest, parked, changed = self._select_and_dispatch(
+            self.pending, self.per_cycle
+        )
+        self.pending = rest
+        self.completed.extend(batch)
+        self.transferred += len(batch)
+        self.parked_events += parked
+        self.max_batch = max(self.max_batch, len(batch))
+        counter(
+            "membership_transfers_total",
+            help="staged ownership transfers, by outcome (done = "
+                 "dispatched this cycle, parked = deferred unreachable, "
+                 "lost_src = departer crashed at finalize)",
+            outcome="done",
+        ).inc(len(batch))
+        if parked:
+            counter(
+                "membership_transfers_total",
+                help="staged ownership transfers, by outcome (done = "
+                     "dispatched this cycle, parked = deferred "
+                     "unreachable, lost_src = departer crashed at "
+                     "finalize)",
+                outcome="parked",
+            ).inc(parked)
+        gauge(
+            "membership_pending_transfers",
+            help="ownership transfers still pending in the active "
+                 "membership plan",
+        ).set(len(self.pending))
+        if batch or parked:
+            tel_events.emit(
+                "membership", kind="transfer_cycle",
+                old_n=self.old_n, new_n=self.new_n,
+                transfers=len(batch), parked=parked,
+                changed_rows=changed, outstanding=len(self.pending),
+            )
+        out.update({
+            "transfers": len(batch), "parked": parked,
+            "changed_rows": changed, "outstanding": len(self.pending),
+        })
+        return out
+
+
+def _row_bytes_of(rt, var_id: str) -> int:
+    from ..mesh.gossip import rows_traffic_bytes
+
+    return rows_traffic_bytes(rt._population(var_id), 1)

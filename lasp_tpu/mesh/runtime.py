@@ -272,6 +272,25 @@ class ReplicatedRuntime:
         #: registry maps (var_id, actor-identity) -> home replica
         self.debug_actors = debug_actors
         self._actor_sites: dict = {}
+        #: monotone MEMBERSHIP EPOCH — the riak_core ring-epoch analogue:
+        #: advanced by every membership commit (resize, staged grow/drop),
+        #: never by row surgery that keeps the extent (reseed, restore).
+        #: Consumers that cache population-relative indices (quorum
+        #: preflists, coverage plans, serve watch homes) fence on it: a
+        #: request carrying a stale epoch must re-pick or fail typed
+        #: (``membership.errors.StaleEpochError``) instead of silently
+        #: reading rows whose meaning changed (the quorum_value clamp
+        #: note below) — see docs/RESILIENCE.md "Membership & handoff".
+        self.membership_epoch = 0
+        #: optional graceful-leave handoff guard (``ChaosRuntime``
+        #: installs its reachability check here): called with
+        #: ``(source_rows, target_rows)`` before a graceful shrink's
+        #: claim merge; raises ``HandoffPartitionError`` when the merge
+        #: would move state across a partition or out of a crashed row —
+        #: a host-side side channel through the very cut the nemesis
+        #: installed (the degraded-read confinement rule, applied to
+        #: membership).
+        self._handoff_guard = None
         self._step = None
         self._fused_steps_cache: dict[int, object] = {}
         self._n_edges = -1
@@ -4906,26 +4925,8 @@ class ReplicatedRuntime:
         self._invalidate_plan("restore")
 
     # -- elastic membership ---------------------------------------------------
-    def resize(self, new_n: int, new_neighbors, graceful: bool = True) -> None:
-        """Grow or shrink the replica population mid-run — the rebuild of
-        riak_core staged membership (``src/lasp_console.erl:31-94``:
-        staged_join / leave / down + plan/commit).
-
-        Join (``new_n > n_replicas``): new rows start at the lattice BOTTOM
-        and catch up by gossip over the new topology — exactly how a fresh
-        vnode is reconstructed by read-repair in the reference (handoff is
-        stubbed there, ``src/lasp_vnode.erl:454-472``).
-
-        Leave (``new_n < n_replicas``): with ``graceful=True`` the join of
-        the departing rows is merged into surviving row 0 first (the
-        staged-leave handoff: no acknowledged write may be lost even if it
-        never gossiped), then the rows are dropped. ``graceful=False``
-        models crash/``down``: departing state is simply lost unless it
-        already gossiped — the reference's failure semantics.
-
-        The topology must be re-supplied (``new_neighbors: int[new_n, K]``)
-        because neighbor indices are population-relative. The compiled step
-        is invalidated (shapes changed); the next step re-jits."""
+    @staticmethod
+    def _validate_topology(new_n: int, new_neighbors) -> np.ndarray:
         new_neighbors = np.asarray(new_neighbors)
         if new_neighbors.ndim != 2 or new_neighbors.shape[0] != new_n:
             raise ValueError(
@@ -4936,20 +4937,79 @@ class ReplicatedRuntime:
             new_neighbors.min() < 0 or new_neighbors.max() >= new_n
         ):
             raise ValueError("new_neighbors indices out of range")
+        return new_neighbors
+
+    def resize(self, new_n: int, new_neighbors, graceful: bool = True) -> None:
+        """Grow or shrink the replica population mid-run — the ONE-SHOT
+        commit of riak_core staged membership (``src/lasp_console.erl:
+        31-94``: staged_join / leave / down + plan/commit). The staged,
+        incremental path — transfer schedules interleaved with live
+        serve/gossip cycles, chaos-aware parking — is
+        ``lasp_tpu.membership.MembershipCoordinator``; this verb applies
+        the whole plan in one host call.
+
+        Join (``new_n > n_replicas``): new rows start at the lattice BOTTOM
+        and catch up by gossip over the new topology — exactly how a fresh
+        vnode is reconstructed by read-repair in the reference (handoff is
+        stubbed there, ``src/lasp_vnode.erl:454-472``).
+
+        Leave (``new_n < n_replicas``): with ``graceful=True`` each
+        departing row's state joins into its CLAIM SUCCESSOR — the
+        ring-fold row ``r % new_n`` (``membership.plan.claim_targets``),
+        the deterministic claim rule riak_core's ring fold plays — before
+        the rows drop (the staged-leave handoff: no acknowledged write may
+        be lost even if it never gossiped; ownership spreads over the
+        surviving ring instead of piling onto row 0). Under an active
+        chaos wrapper the merge is GUARDED: pairs spanning a partition or
+        reading a crashed departer refuse with a typed
+        ``HandoffPartitionError`` instead of tunneling state through the
+        cut. ``graceful=False`` models crash/``down``: departing state is
+        simply lost unless it already gossiped — the reference's failure
+        semantics.
+
+        The topology must be re-supplied (``new_neighbors: int[new_n, K]``)
+        because neighbor indices are population-relative. The compiled step
+        is invalidated (shapes changed); the next step re-jits — and the
+        MEMBERSHIP EPOCH advances, fencing every consumer that cached
+        population-relative indices."""
+        new_neighbors = self._validate_topology(new_n, new_neighbors)
         old_n = self.n_replicas
+        actor_targets = None
+        if new_n < old_n and graceful:
+            # the ONE claim definition (membership.plan): routing here
+            # must match the staged transfer schedule / watch re-homing
+            from ..membership.plan import claim_targets
+
+            sources = np.arange(new_n, old_n, dtype=np.int64)
+            targets = claim_targets(old_n, new_n)
+            if self._handoff_guard is not None:
+                self._handoff_guard(sources, targets)
+            actor_targets = {int(s): int(t) for s, t in zip(sources, targets)}
         for v in self.var_ids:
             codec, spec = self._mesh_meta(v)
             st = self.states[v]
             if new_n < old_n:
                 head = jax.tree_util.tree_map(lambda x: x[:new_n], st)
                 if graceful:
+                    # fold the departing tail into the claim successors:
+                    # one join_all per distinct target (each target's
+                    # sources are the rows that ring-fold onto it)
                     tail = jax.tree_util.tree_map(lambda x: x[new_n:], st)
-                    handoff = join_all(codec, spec, tail)
-                    row0 = jax.tree_util.tree_map(lambda x: x[0], head)
-                    merged = codec.merge(spec, row0, handoff)
-                    head = jax.tree_util.tree_map(
-                        lambda x, r: x.at[0].set(r), head, merged
-                    )
+                    for t in np.unique(targets):
+                        src_local = np.flatnonzero(targets == t)
+                        handoff = join_all(
+                            codec, spec,
+                            jax.tree_util.tree_map(
+                                lambda x: x[src_local], tail
+                            ),
+                        )
+                        cur = jax.tree_util.tree_map(
+                            lambda x: x[int(t)], head
+                        )
+                        merged = codec.merge(spec, cur, handoff)
+                        head = jax.tree_util.tree_map(
+                            lambda x, r: x.at[int(t)].set(r), head, merged
+                        )
                 self.states[v] = head
             elif new_n > old_n:
                 # _mesh_meta already resolves packed vars to (FlatORSet,
@@ -4959,26 +5019,108 @@ class ReplicatedRuntime:
                     lambda a, b: jnp.concatenate([a, b], axis=0), st, fresh
                 )
         if new_n > old_n:
-            record_membership("join", old_n, new_n)
+            kind = "join"
         elif new_n < old_n:
-            record_membership(
-                "leave_graceful" if graceful else "leave_crash",
-                old_n, new_n,
-            )
+            kind = "leave_graceful" if graceful else "leave_crash"
         else:
-            record_membership("topology_swap", old_n, new_n)
+            kind = "topology_swap"
+        self._finish_membership(
+            kind, old_n, new_n, new_neighbors,
+            dirty_rows=None, actor_targets=actor_targets,
+        )
+
+    def membership_grow(self, new_n: int, new_neighbors,
+                        dirty_rows=None) -> None:
+        """Staged-JOIN commit primitive (the ``MembershipCoordinator``'s
+        grow arm): append ``new_n - n_replicas`` lattice-bottom rows and
+        advance the membership epoch. Unlike :meth:`resize`, the caller
+        may supply ``dirty_rows`` — the ROW-SCOPED frontier degrade
+        (``membership.plan.changed_delivery_rows``: the new rows plus
+        every row a pull list newly references) instead of the blanket
+        all-dirty, because the staged transfer schedule seeds the new
+        rows directly and surviving pairs' delivery knowledge stays
+        valid. ``dirty_rows=None`` keeps the conservative blanket."""
+        new_neighbors = self._validate_topology(new_n, new_neighbors)
+        old_n = self.n_replicas
+        if new_n <= old_n:
+            raise ValueError(
+                f"membership_grow: new_n={new_n} must exceed the current "
+                f"{old_n}-replica population"
+            )
+        for v in self.var_ids:
+            codec, spec = self._mesh_meta(v)
+            fresh = replicate(codec.new(spec), new_n - old_n)
+            self.states[v] = jax.tree_util.tree_map(
+                lambda a, b: jnp.concatenate([a, b], axis=0),
+                self.states[v], fresh,
+            )
+        self._finish_membership(
+            "join_staged", old_n, new_n, new_neighbors,
+            dirty_rows=dirty_rows, actor_targets=None,
+        )
+
+    def membership_drop_tail(self, new_n: int, new_neighbors, *,
+                             dirty_rows=None, actor_targets=None,
+                             kind: str = "leave_staged") -> None:
+        """Staged-LEAVE commit primitive: truncate the departing tail
+        WITHOUT any merge — ownership was already handed off row by row
+        by the staged transfer schedule (``membership.HandoffEngine``),
+        so the drop is pure bookkeeping. ``actor_targets`` maps a
+        departing row index to the claim successor that received its
+        handoff join (the actor may continue there; missing/None entries
+        retire to ``-1``, the crash incarnation rule). ``dirty_rows``
+        is the row-scoped frontier degrade (claim targets + newly
+        referenced neighbors); None keeps the blanket."""
+        new_neighbors = self._validate_topology(new_n, new_neighbors)
+        old_n = self.n_replicas
+        if new_n >= old_n:
+            raise ValueError(
+                f"membership_drop_tail: new_n={new_n} must be below the "
+                f"current {old_n}-replica population"
+            )
+        for v in self.var_ids:
+            self.states[v] = jax.tree_util.tree_map(
+                lambda x: x[:new_n], self.states[v]
+            )
+        self._finish_membership(
+            kind, old_n, new_n, new_neighbors,
+            dirty_rows=dirty_rows, actor_targets=actor_targets,
+        )
+
+    def _finish_membership(self, kind: str, old_n: int, new_n: int,
+                           new_neighbors, *, dirty_rows,
+                           actor_targets) -> None:
+        """Shared membership-commit epilogue: record the event, advance
+        the epoch, swap the topology, degrade frontiers (blanket when
+        ``dirty_rows`` is None, row-scoped otherwise — surviving rows'
+        existing dirty bits are PRESERVED either way), drop the
+        topology-bound partition plan, remap/retire departed actor
+        sites, and invalidate compiled steps + the dispatch plan."""
+        record_membership(kind, old_n, new_n)
         self.n_replicas = new_n
         self.neighbors = jnp.asarray(new_neighbors)
         self._host_neighbors = np.asarray(new_neighbors)
         self._shift_offsets = shift_offsets(new_neighbors, new_n)
-        # membership changed: fresh rows start at bottom and must be
-        # caught up by gossip even from QUIESCENT peers, and the handoff
-        # merge dirtied row 0 — row-level knowledge is gone either way,
-        # so every frontier degrades to all-dirty (conservative: the
-        # frontier engine then behaves exactly like dense until the
-        # dirty set re-collapses)
+        keep = min(old_n, new_n)
         for v in list(self._frontier):
-            self._frontier[v] = np.ones(new_n, dtype=bool)
+            old_f = self._frontier[v]
+            if dirty_rows is None:
+                # membership changed with no transfer knowledge: fresh
+                # rows start at bottom and must be caught up by gossip
+                # even from QUIESCENT peers — every frontier degrades to
+                # all-dirty (conservative, the legacy resize rule)
+                self._frontier[v] = np.ones(new_n, dtype=bool)
+                continue
+            # row-scoped degrade (the staged path): only rows whose
+            # delivery obligations actually changed re-dirty; a
+            # surviving row's pre-commit dirty bit is kept
+            f = np.zeros(new_n, dtype=bool)
+            if old_f.shape[0] >= keep:
+                f[:keep] |= old_f[:keep]
+            rows = np.asarray(dirty_rows, dtype=np.int64)
+            if rows.size:
+                f[rows] = True
+            self._frontier[v] = f
         # a boundary-exchange plan is topology-specific: drop it (re-apply
         # shard(partition=True) after the membership change); the
         # per-shard frontier gauges go with it (stale shard extents
@@ -4990,20 +5132,31 @@ class ReplicatedRuntime:
         # a DEPARTED actor's tokens may still circulate via gossip, so a
         # fresh incarnation minting under the same name risks row-local
         # slot reuse against them (the silent loss the mesh statem
-        # caught). Graceful leave joins the departing rows into row 0,
-        # which then sees ALL their tokens — the actor may continue
-        # there; a crash leaves circulating orphans, so the dead-row
-        # binding stays and any future write site collides loudly (the
-        # riak_dt never-reuse-an-actor incarnation rule).
+        # caught). A graceful/staged leave joined the departing row into
+        # its CLAIM SUCCESSOR, which then sees ALL its tokens — the
+        # actor may continue there (``actor_targets``); a crash leaves
+        # circulating orphans, so the binding retires to -1, a site no
+        # row can ever match (a later GROW would otherwise reuse the
+        # dead index and silently re-legitimize the binding against the
+        # orphaned circulating tokens — the riak_dt never-reuse-an-actor
+        # incarnation rule).
         if new_n < old_n:
             for key, site in list(self._actor_sites.items()):
                 if site >= new_n:
-                    # graceful -> row 0 (it received the handoff join and
-                    # sees all the actor's tokens); crash -> -1, a site no
-                    # row can ever match (a later GROW would otherwise
-                    # reuse the dead index and silently re-legitimize the
-                    # binding against the orphaned circulating tokens)
-                    self._actor_sites[key] = 0 if graceful else -1
+                    target = (
+                        actor_targets.get(site)
+                        if actor_targets is not None else None
+                    )
+                    self._actor_sites[key] = (
+                        int(target) if target is not None else -1
+                    )
+        self.membership_epoch += 1
+        gauge(
+            "membership_epoch",
+            help="monotone membership epoch of the replica population "
+                 "(advanced by every resize / staged commit; consumers "
+                 "holding population-relative indices fence on it)",
+        ).set(self.membership_epoch)
         self._step = None
         self._fused_steps_cache.clear()
         # the replica extent is part of every grouping signature

@@ -37,6 +37,7 @@ reference; ``tools/quorum_smoke.py`` (Makefile ``verify``) guards the
 batched-vs-sequential bit-identity contract.
 """
 
+from ..membership.errors import StaleEpochError
 from .engine import PartialQuorumError, QuorumRuntime
 from .fsm import DONE, FAILED, PREPARE, REPAIR, STATE_NAMES, WAITING_N, WAITING_R
 from .hints import HintLog
@@ -45,6 +46,7 @@ from .coverage import coverage_sweep, ring_coverage_execute
 __all__ = [
     "QuorumRuntime",
     "PartialQuorumError",
+    "StaleEpochError",
     "HintLog",
     "coverage_sweep",
     "ring_coverage_execute",

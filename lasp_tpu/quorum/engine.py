@@ -49,6 +49,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..mesh.gossip import quorum_read, rows_traffic_bytes
+from ..membership.errors import StaleEpochError
 from ..telemetry import counter, events as tel_events, gauge, histogram, span
 from ..telemetry.convergence import get_monitor
 from ..utils.metrics import Timer
@@ -171,6 +172,15 @@ class QuorumRuntime:
         #: record the bit-identity assertions compare across engines
         self.trace: list = []
         self._comp_cache: "tuple | None" = None
+        #: the membership epoch the live picks/preflists were minted
+        #: under — a runtime resize/staged commit advances the
+        #: runtime's epoch and every in-flight request FENCES
+        #: (:meth:`_epoch_fence`): re-prepare against the new ring with
+        #: a retry budget, typed ``StaleEpochError`` without one.
+        #: Without the fence a request would keep preflist indices whose
+        #: meaning changed (runtime.py ``quorum_value``: a stale index
+        #: after a resize silently reads the wrong quorum).
+        self._fence_epoch = self.rt.membership_epoch
         # aggregate accounting (the report / bench surface)
         self.completed = 0
         self.failed = 0
@@ -415,6 +425,141 @@ class QuorumRuntime:
         if mint_exc is not None:
             raise mint_exc
 
+    def _epoch_fence(self, rnd: int) -> None:
+        """The membership epoch advanced under this batch's feet —
+        riak_core's ``{error, ring_changed}`` surface, typed. A request
+        fences only when the change actually INVALIDATED it: its
+        coordinator or a valid pick no longer exists, or its preflist
+        width no longer fits the population. Surviving rows keep their
+        indices in this membership model, so a pure GROW (and a shrink
+        that spares the whole preflist) leaves in-flight requests
+        untouched — no spurious retry burn, no dropped phase-B pushes
+        at still-valid replicas.
+
+        For AFFECTED requests:
+
+        - WAITING_N finalizes: the client already has its answer;
+          chasing straggler acks at departed rows would push state at
+          rows that no longer exist;
+        - WAITING_R with retries left RE-PREPARES against the new ring
+          (one retry consumed): a departed coordinator routes to its
+          ring-fold claim successor, acks reset, and a put's
+          already-minted delta rides to the fresh picks (a mint at a
+          departed row was handed to the claim successor by the staged
+          transfer/graceful merge, so nothing re-applies);
+        - WAITING_R without retries (or a preflist width the population
+          can no longer hold) FAILS with the typed ``stale_epoch``
+          status — :meth:`result` raises
+          :class:`~lasp_tpu.membership.errors.StaleEpochError`."""
+        cur = self.rt.membership_epoch
+        prev = self._fence_epoch
+        self._fence_epoch = cur
+        R = self.rt.n_replicas
+        refenced = failed = 0
+        for rid in list(self._active):
+            st = self._state[rid]
+            req = self._reqs[rid]
+            if st == fsm.PREPARE:
+                # not yet issued: nothing stale in flight, but a staged
+                # coordinator index may have departed — remap to its
+                # claim successor before the preflist pick. A preflist
+                # width the shrunken population can no longer hold must
+                # fail typed HERE: _prepare_batch's pick would raise an
+                # untyped ValueError and strand the whole step
+                if req.n > R:
+                    self._fence_fail(rid, req, rnd, prev, cur, R)
+                    failed += 1
+                    continue
+                coord = int(self._coord[rid])
+                if coord >= R:
+                    self._coord[rid] = coord % R
+                    self.trace.append(
+                        (rnd, rid, "epoch_fence", ("remapped", cur))
+                    )
+                continue
+            if st not in (fsm.WAITING_R, fsm.WAITING_N):
+                continue
+            affected = (
+                req.n > R
+                or int(self._coord[rid]) >= R
+                or bool(
+                    (self._picks[rid][self._pick_valid[rid]] >= R).any()
+                )
+            )
+            if not affected:
+                continue
+            if st == fsm.WAITING_N:
+                self._finalize(rid, rnd)
+                self.trace.append(
+                    (rnd, rid, "epoch_fence", ("finalized", cur))
+                )
+                continue
+            if req.n <= R and req.retries_left > 0:
+                req.retries_left -= 1
+                req.retries_used += 1
+                self.retries += 1
+                coord = int(self._coord[rid])
+                if coord >= R:
+                    coord = coord % R  # the claim successor's row
+                self._coord[rid] = coord
+                self._acks[rid] = False
+                self._state[rid] = fsm.PREPARE
+                if req.applied_row is not None and req.applied_row >= R:
+                    # the mint row departed: every fresh pick must
+                    # receive the delta (the claim successor holds the
+                    # handed-off tokens, and re-joining is idempotent)
+                    req.applied_row = -1
+                refenced += 1
+                self.trace.append(
+                    (rnd, rid, "epoch_fence", ("refenced", cur))
+                )
+            else:
+                self._fence_fail(rid, req, rnd, prev, cur, R)
+                failed += 1
+        if refenced:
+            counter(
+                "quorum_epoch_fences_total",
+                help="in-flight quorum requests fenced by a membership "
+                     "epoch change, by outcome (refenced = re-prepared "
+                     "on the new ring, failed = typed StaleEpochError)",
+                outcome="refenced",
+            ).inc(refenced)
+        if failed:
+            counter(
+                "quorum_epoch_fences_total",
+                help="in-flight quorum requests fenced by a membership "
+                     "epoch change, by outcome (refenced = re-prepared "
+                     "on the new ring, failed = typed StaleEpochError)",
+                outcome="failed",
+            ).inc(failed)
+        self._active = [
+            rid for rid in self._active
+            if self._state[rid] not in (fsm.DONE, fsm.FAILED)
+        ]
+
+    def _fence_fail(self, rid, req, rnd: int, prev: int, cur: int,
+                    R: int) -> None:
+        """Resolve one fenced request as typed ``stale_epoch`` (the
+        shared terminal arm of :meth:`_epoch_fence`)."""
+        self._state[rid] = fsm.FAILED
+        req.status = "stale_epoch"
+        req.error = (
+            f"membership epoch advanced {prev} -> {cur} mid-flight "
+            f"(population now {R} replicas"
+            + (f", below the request's preflist width n={req.n}"
+               if req.n > R else "")
+            + ") and no retry can fit it — re-submit against the "
+            "current ring"
+        )
+        req.final_round = rnd
+        self.failed += 1
+        counter(
+            "quorum_completions_total",
+            help="quorum requests resolved, by kind and outcome",
+            kind=req.kind, outcome="stale_epoch",
+        ).inc()
+        self.trace.append((rnd, rid, "epoch_fence", ("failed", cur)))
+
     def _fail(self, rid: int, rnd: int, why: str) -> None:
         req = self._reqs[rid]
         self._state[rid] = fsm.FAILED
@@ -477,6 +622,9 @@ class QuorumRuntime:
         Returns ``{"round", "residual", "fired", "failed", "pushed",
         "repaired"}`` for the round."""
         rnd = self.ch.round
+        self.ch.sync_membership()
+        if self.rt.membership_epoch != self._fence_epoch:
+            self._epoch_fence(rnd)
         residual = self.ch.step(mode=self.mode)
         for replica in self.ch.last_restored:
             handed = self.hints.replay(self.rt, replica)
@@ -762,6 +910,11 @@ class QuorumRuntime:
         FAILED requests raise :class:`PartialQuorumError` unless
         ``raise_on_error=False``."""
         req = self._reqs[rid]
+        if req.status == "stale_epoch" and raise_on_error:
+            raise StaleEpochError(
+                f"request {rid} ({req.kind} {req.var!r}): {req.error}",
+                current_epoch=self.rt.membership_epoch,
+            )
         if req.status == "failed" and raise_on_error:
             raise PartialQuorumError(
                 f"request {rid} ({req.kind} {req.var!r}): {req.error}"

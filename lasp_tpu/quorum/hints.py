@@ -100,19 +100,25 @@ class HintLog:
             for i in self._by_replica.get(int(replica), ())
         ]
 
-    def replay(self, runtime, replica: int) -> int:
-        """Hand off every pending hint to a restored replica row: each
-        record's row joins into ``states[var][replica]`` (an exact no-op
-        where gossip already caught the row up — idempotence). Returns
-        the number of rows actually changed. The caller (the quorum
-        engine's restore hook) runs this BEFORE the replica serves
-        another quorum — the ordering hinted handoff promises."""
+    def replay(self, runtime, replica: int,
+               target: "int | None" = None) -> int:
+        """Hand off every pending hint naming ``replica``: each
+        record's row joins into ``states[var][target]`` — ``target``
+        defaults to ``replica`` itself (the restore path); a membership
+        finalize passes the departed replica's CLAIM SUCCESSOR instead
+        (the lost_src fallback: the replica will never restore, so its
+        acked writes land where its ownership went). An exact no-op
+        where gossip already caught the target up — idempotence.
+        Returns the number of rows actually changed. The restore caller
+        (the quorum engine's restore hook) runs this BEFORE the replica
+        serves another quorum — the ordering hinted handoff promises."""
+        tgt = int(replica if target is None else target)
         changed = 0
         for var_id, _picks, row, _rid in self.pending_for(replica):
             if var_id not in runtime.var_ids:
                 continue
             changed += runtime.join_rows(
-                var_id, np.asarray([int(replica)], dtype=np.int64), [row]
+                var_id, np.asarray([tgt], dtype=np.int64), [row]
             )
         self.replays += 1
         if changed:

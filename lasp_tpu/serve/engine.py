@@ -605,6 +605,47 @@ class ServeFrontend:
                 n += 1
         return n
 
+    def on_membership(self, claim_of=None, expire: bool = False) -> dict:
+        """Membership-commit hook (called by
+        ``membership.MembershipCoordinator`` at finalize, or directly
+        after a ``resize``): re-home parked watches whose replica row
+        departed to their claim successor (``claim_of``, default ring
+        fold), or — ``expire=True``, the crash/down semantics — retire
+        them typed: their tickets expire through the normal accounting
+        (the client sees a deadline-style cancellation, never a stale
+        fire). Returns ``{"rehomed", "expired"}`` counts."""
+        res = self.subs.rehome(
+            self.rt.n_replicas, claim_of, expire=expire
+        )
+        now = self.clock()
+        # every claimed watch counts as expired (the claim is the
+        # retirement); ticket expiry accounting is best-effort on top —
+        # a non-Ticket payload or an already-terminal ticket still left
+        # the table, and the metric must agree with the return value
+        n_expired = len(res["expired"])
+        for _sub_id, t in res["expired"]:
+            if isinstance(t, rq.Ticket) and t.expire(now):
+                self._account(t)
+        if res["rehomed"]:
+            counter(
+                "membership_rehomed_watches_total",
+                help="parked threshold watches moved off a departed "
+                     "replica by a membership commit, by outcome "
+                     "(rehomed = moved to the claim successor, "
+                     "expired = retired typed under crash semantics)",
+                outcome="rehomed",
+            ).inc(res["rehomed"])
+        if n_expired:
+            counter(
+                "membership_rehomed_watches_total",
+                help="parked threshold watches moved off a departed "
+                     "replica by a membership commit, by outcome "
+                     "(rehomed = moved to the claim successor, "
+                     "expired = retired typed under crash semantics)",
+                outcome="expired",
+            ).inc(n_expired)
+        return {"rehomed": res["rehomed"], "expired": n_expired}
+
     def _account(self, t: rq.Ticket) -> None:
         with self._lock:
             if t.status == "done":

@@ -278,6 +278,58 @@ class SubscriptionTable:
         for slot in range(n):
             self._index[int(group.sub_ids[slot])] = (var_id, slot)
 
+    def rehome(self, n_replicas: int, claim_of=None,
+               expire: bool = False) -> dict:
+        """Membership-shrink re-homing: every ACTIVE watch whose home
+        replica departed (``replica >= n_replicas``) either RE-HOMES to
+        its claim successor — ``claim_of(old_row)``, defaulting to the
+        ring fold ``old_row % n_replicas`` (``membership.plan.
+        claim_targets`` rule: the row that received the departer's
+        handoff join, so a threshold the departed row met stays met
+        there) — or, with ``expire=True`` (crash/down semantics: the
+        departed state is gone), retires typed through the
+        exactly-once claim point.
+
+        Returns ``{"rehomed": count, "expired": [(sub_id, payload),
+        ...]}`` — expired watches are CANCELLED, never fired, and the
+        caller owns their typed notifications. Never fires stale: a
+        re-homed watch's next verdict reads the successor's live row,
+        and evaluation's clamp-to-last-row fallback remains only a
+        safety net for watches registered after this pass raced a
+        shrink."""
+        n_replicas = int(n_replicas)
+        rehomed = 0
+        expired: list = []
+        with self._lock:
+            for _var_id, group in self._groups.items():
+                for slot in range(group.n):
+                    if not group.active[slot]:
+                        continue
+                    old_row = int(group.replica[slot])
+                    if old_row < n_replicas:
+                        continue
+                    if expire:
+                        sub_id = int(group.sub_ids[slot])
+                        payload = self._claim(sub_id)
+                        if payload is not _MISSING:
+                            expired.append((sub_id, payload))
+                        continue
+                    if claim_of is not None:
+                        group.replica[slot] = int(claim_of(old_row))
+                    else:
+                        from ..membership.plan import claim_row
+
+                        group.replica[slot] = claim_row(
+                            old_row, n_replicas
+                        )
+                    rehomed += 1
+        gauge(
+            "serve_watch_subscriptions",
+            help="threshold watches currently registered in the "
+                 "subscription table",
+        ).set(len(self._index))
+        return {"rehomed": rehomed, "expired": expired}
+
     def expire(self, now: float) -> list:
         """Retire every watch whose deadline passed; returns
         ``[(sub_id, payload), ...]`` for the caller's cancellation

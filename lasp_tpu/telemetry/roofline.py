@@ -87,6 +87,7 @@ FAMILIES = (
     "quorum_step",
     "aae_hash",
     "ingest_apply",
+    "handoff_transfer",
 )
 
 
@@ -300,6 +301,22 @@ def kernel_traffic(
         moved = G * F * (4 * _IDX_BYTES + 2 * int(row_bytes)) + G * R
         lo = G * F * 4 * _IDX_BYTES
         hi = 4 * moved + 2 * G * S + pad
+        return TrafficEstimate(moved, lo, hi, G * F)
+
+    if family == "handoff_transfer":
+        # the grouped ownership-transfer join (membership.handoff.
+        # grouped_transfer): per bucket-padded transfer pair one
+        # source-row gather, one target-row gather, and the merged
+        # target-row scatter, stacked G-wide across the dispatch-plan
+        # group (pad slots gather real bytes and DROP at the scatter —
+        # the out-of-range pad contract). Coarse like quorum_step /
+        # ingest_apply: the row exists to show rebalancing's device
+        # cost next to the gossip it interleaves with, not to chase an
+        # HBM bound. ``rows`` is the pair bucket.
+        F = int(rows or 0)
+        moved = G * F * 3 * int(row_bytes)
+        lo = G * F * 2 * int(row_bytes)
+        hi = 4 * moved + pad
         return TrafficEstimate(moved, lo, hi, G * F)
 
     if family == "shard_exchange":

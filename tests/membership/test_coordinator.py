@@ -1,0 +1,200 @@
+"""The staged flow end to end: commit semantics, epoch advance,
+row-scoped frontier degrade, twin bit-equality, crashed-departer hint
+fallback, partition-deferred finalize, and serve watch re-homing."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from lasp_tpu.chaos import ChaosRuntime, ChaosSchedule, Crash, Partition
+from lasp_tpu.chaos.invariants import snapshot_states, states_equal
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.membership import MembershipCoordinator
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import Store
+
+
+def _build(n=8, packed=False):
+    store = Store(n_actors=8)
+    store.declare(id="g", type="lasp_gset", n_elems=16)
+    store.declare(id="o", type="lasp_orset", n_elems=16)
+    store.declare(id="w", type="riak_dt_orswot", n_elems=16)
+    return store, ReplicatedRuntime(store, Graph(store), n, ring(n, 2),
+                                    packed=packed)
+
+
+WRITES1 = [(0, "g", ("add", "a"), "p"), (3, "o", ("add", "b"), "q"),
+           (5, "w", ("add", "c"), "r")]
+WRITES2 = [(1, "g", ("add", "d"), "p2"), (2, "o", ("add", "e"), "q2")]
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_round_trip_bit_identical_to_static_twin(packed):
+    """join 8->12, writes, leave 12->8: the settled population is
+    BIT-IDENTICAL to a twin built statically at 8 with the same writes
+    (the acceptance criterion, across leafwise/vclock/packed)."""
+    _store, rt = _build(packed=packed)
+    for r, v, op, a in WRITES1:
+        rt.update_at(r, v, op, a)
+    rt.run_to_convergence()
+    mc = MembershipCoordinator(rt, per_cycle=3)
+    mc.stage_join(12)
+    plan = mc.commit()
+    assert rt.membership_epoch == plan.epoch == 1
+    mc.run_to_settled()
+    for r, v, op, a in WRITES2:
+        rt.update_at(r, v, op, a)
+    mc.stage_leave(8)
+    mc.commit()
+    mc.run_to_settled()
+    rt.run_to_convergence()
+    assert rt.membership_epoch == 2
+
+    _s2, twin = _build(packed=packed)
+    for r, v, op, a in WRITES1 + WRITES2:
+        twin.update_at(r, v, op, a)
+    twin.run_to_convergence()
+    assert states_equal(snapshot_states(rt), snapshot_states(twin))
+
+
+def test_join_seeds_new_rows_from_claim_predecessors():
+    _store, rt = _build()
+    rt.update_at(2, "g", ("add", "seeded"), "p")
+    rt.run_to_convergence()
+    mc = MembershipCoordinator(rt, per_cycle=8)
+    mc.stage_join(12)
+    mc.commit()
+    # one transfer cycle seeds every new row directly — before any
+    # further gossip delivery could have reached them
+    mc.cycle()
+    assert rt.replica_value("g", 10) == {"seeded"}  # src = 10 % 8 = 2
+
+
+def test_row_scoped_frontier_degrade_on_staged_join():
+    _store, rt = _build()
+    rt.update_at(0, "g", ("add", "x"), "p")
+    rt.run_to_convergence()
+    for v in rt.var_ids:
+        assert rt._frontier[v].sum() == 0  # quiescent
+    mc = MembershipCoordinator(rt)
+    mc.stage_join(12)
+    plan = mc.commit()
+    dirty = set(np.flatnonzero(rt._frontier["g"]).tolist())
+    # row-scoped: exactly the plan's changed-delivery set, NOT all 12
+    assert dirty == set(int(r) for r in plan.dirty_rows)
+    assert len(dirty) < 12
+    # and the run still converges to the full join everywhere
+    mc.run_to_settled()
+    rt.run_to_convergence()
+    assert rt.replica_value("g", 11) == {"x"}
+    assert rt.divergence("g") == 0
+
+
+def test_leave_keeps_serving_while_transfers_drain():
+    """During a staged leave the population stays intact and gossip
+    keeps flowing — no stop-the-world window."""
+    _store, rt = _build()
+    rt.update_at(7, "g", ("add", "late"), "p")
+    mc = MembershipCoordinator(rt, per_cycle=1)
+    mc.stage_leave(6)
+    mc.commit()
+    assert rt.n_replicas == 8  # not dropped yet
+    out = mc.step()
+    assert rt.n_replicas == 8 and mc.rebalancing
+    assert out["transfers"] == 1  # capped at per_cycle
+    # a write lands on a departing row mid-rebalance; the finalize
+    # sweep re-joins it (idempotent), so it survives the drop
+    rt.update_at(6, "o", ("add", "mid"), "q")
+    mc.run_to_settled()
+    assert rt.n_replicas == 6
+    assert "late" in rt.coverage_value("g")
+    assert "mid" in rt.coverage_value("o")
+
+
+def test_down_drops_immediately_with_crash_semantics():
+    _store, rt = _build()
+    rt.update_at(7, "g", ("add", "doomed"), "p")  # never gossips
+    mc = MembershipCoordinator(rt)
+    mc.stage_down(6)
+    mc.commit()
+    assert rt.n_replicas == 6 and not mc.rebalancing
+    rt.run_to_convergence()
+    assert "doomed" not in rt.coverage_value("g")
+
+
+def test_finalize_defers_while_partitioned_then_completes():
+    _store, rt = _build()
+    rt.update_at(6, "g", ("add", "held"), "p")
+    sched = ChaosSchedule(8, ring(8, 2), [Partition(0, 5, 2)])
+    ch = ChaosRuntime(rt, sched)
+    ch.step()  # partition live: rows {0..3} | {4..7}
+    mc = MembershipCoordinator(ch, per_cycle=8)
+    mc.stage_leave(6)  # (6 -> 0) crosses the cut, (7 -> 1) too
+    mc.commit()
+    out = mc.cycle()
+    assert out["parked"] == 2 and mc.rebalancing
+    assert rt.n_replicas == 8  # finalize deferred, nothing dropped
+    mc.run_to_settled()
+    assert rt.n_replicas == 6
+    assert "held" in rt.coverage_value("g")
+    assert rt.membership_epoch == 1
+
+
+def test_crashed_departer_falls_back_to_hints():
+    """A departing replica that crashes before its transfer: its acked
+    (hint-logged) writes replay into the claim successor at finalize —
+    no acknowledged write lost; unlogged state takes crash semantics."""
+    from lasp_tpu.quorum import HintLog
+
+    _store, rt = _build()
+    rt.update_at(6, "g", ("add", "acked"), "p")
+    rt.update_at(6, "o", ("add", "unacked"), "q")
+    hints = HintLog()
+    row = jax.tree_util.tree_map(
+        lambda x: np.asarray(x[6]), rt._population("g")
+    )
+    hints.append("g", np.asarray([6], dtype=np.int64), row, rid=0)
+    sched = ChaosSchedule(8, ring(8, 2), [Crash(0, 6)])
+    ch = ChaosRuntime(rt, sched)
+    mc = MembershipCoordinator(ch, per_cycle=8, hints=hints)
+    ch.step()  # crash lands before any gossip moves row 6's state
+    mc.stage_leave(6)
+    mc.commit()
+    mc.run_to_settled()
+    assert rt.n_replicas == 6
+    assert 6 in mc.lost_sources
+    assert "acked" in rt.coverage_value("g")  # hint fallback
+    rt.run_to_convergence()
+    assert "unacked" not in rt.coverage_value("o")  # honest crash loss
+
+
+def test_serve_watches_rehome_at_finalize():
+    from lasp_tpu.lattice import Threshold
+    from lasp_tpu.serve import ServeFrontend
+
+    store, rt = _build()
+    fe = ServeFrontend(rt)
+    gvar = store.variable("g")
+    bottom = gvar.codec.new(gvar.spec)
+    sid = fe.subs.register("g", gvar.codec, gvar.spec,
+                           Threshold(bottom, True), replica=7,
+                           payload="park")
+    mc = MembershipCoordinator(rt, serve=fe)
+    mc.stage_leave(6)
+    mc.commit()
+    mc.run_to_settled()
+    # the watch re-homed to 7 % 6 == 1 (the claim successor)
+    _var, slot = fe.subs._index[sid]
+    group = fe.subs._groups["g"]
+    assert int(group.replica[slot]) == 1
+
+
+def test_commit_refused_while_rebalancing():
+    _store, rt = _build()
+    mc = MembershipCoordinator(rt, per_cycle=1)
+    mc.stage_join(12)
+    mc.commit()
+    mc.staging.stage_join(16)
+    with pytest.raises(RuntimeError, match="still rebalancing"):
+        mc.commit()

@@ -1,0 +1,124 @@
+"""The transfer engine: grouped dispatch bit-identity vs per-pair
+partial joins, per-cycle capping, duplicate-target deferral, and
+partition parking/resume."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from lasp_tpu.chaos import ChaosRuntime, ChaosSchedule, Crash, Partition
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.membership import HandoffEngine, grouped_transfer
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import Store
+
+
+def _build(n=8, packed=False):
+    store = Store(n_actors=8)
+    store.declare(id="g", type="lasp_gset", n_elems=16)
+    store.declare(id="g2", type="lasp_gset", n_elems=16)
+    store.declare(id="o", type="lasp_orset", n_elems=16)
+    store.declare(id="w", type="riak_dt_orswot", n_elems=16)
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, 2),
+                           packed=packed)
+    rt.update_at(5, "g", ("add", "a"), "p")
+    rt.update_at(6, "g2", ("add", "b"), "p2")
+    rt.update_at(6, "o", ("add", "c"), "q")
+    rt.update_at(7, "w", ("add", "d"), "r")
+    return rt
+
+
+@pytest.mark.parametrize("packed", [False, True])
+def test_grouped_transfer_bit_identical_to_per_pair_joins(packed):
+    pairs = [(5, 0), (6, 1), (7, 2)]
+    rt = _build(packed=packed)
+    ref = _build(packed=packed)
+    # reference: one join_rows per pair per var, source row gathered
+    for src, dst in pairs:
+        for v in ref.var_ids:
+            row = jax.tree_util.tree_map(
+                lambda x: x[src], ref._population(v)
+            )
+            ref.join_rows(v, np.asarray([dst], dtype=np.int64), [row])
+    changed = grouped_transfer(rt, pairs)
+    assert changed > 0
+    for v in rt.var_ids:
+        for a, b in zip(
+            jax.tree_util.tree_leaves(rt.states[v]),
+            jax.tree_util.tree_leaves(ref.states[v]),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), v
+        # changed targets carry the exact frontier marks
+        assert np.array_equal(rt._frontier[v], ref._frontier[v]), v
+
+
+def test_grouped_transfer_refuses_duplicate_targets():
+    rt = _build()
+    with pytest.raises(ValueError, match="duplicate target"):
+        grouped_transfer(rt, [(5, 0), (6, 0)])
+
+
+def test_engine_caps_per_cycle_and_defers_duplicate_targets():
+    rt = _build()
+    sched = ChaosSchedule(8, ring(8, 2), events=())
+    ch = ChaosRuntime(rt, sched)
+    # two transfers share target 0: the second defers a cycle even
+    # though the cap would admit it (the scatter would race)
+    eng = HandoffEngine(ch, [(5, 0), (6, 0), (7, 2)], per_cycle=2)
+    out1 = eng.cycle()
+    assert out1["transfers"] == 2  # (5,0) and (7,2); (6,0) deferred
+    assert eng.outstanding == 1
+    out2 = eng.cycle()
+    assert out2["transfers"] == 1 and eng.outstanding == 0
+    assert eng.max_batch <= 2
+
+
+def test_transfers_park_across_partition_and_resume_after_heal():
+    rt = _build()
+    # rows {0..3} | {4..7} split for rounds [0, 4)
+    sched = ChaosSchedule(8, ring(8, 2), [Partition(0, 4, 2)])
+    ch = ChaosRuntime(rt, sched)
+    eng = HandoffEngine(ch, [(5, 0), (6, 5)], per_cycle=4)
+    ch.step()
+    out = eng.cycle()
+    # (5, 0) crosses the cut: parked; (6, 5) is intra-component: done
+    assert out["transfers"] == 1 and out["parked"] == 1
+    assert eng.outstanding == 1
+    # parked while the cut holds; resumes the first cycle whose mask
+    # has healed (the window closing), without any re-submission
+    while eng.outstanding:
+        assert ch.round < 12, "parked transfer never resumed"
+        ch.step()
+        out = eng.cycle()
+        if out["transfers"]:
+            assert ch.round >= 4, "dispatched across the live cut"
+    assert rt.replica_value("g", 0) == {"a"}
+
+
+def test_crashed_source_parks():
+    rt = _build()
+    sched = ChaosSchedule(8, ring(8, 2), [Crash(0, 5)])
+    ch = ChaosRuntime(rt, sched)
+    ch.step()
+    eng = HandoffEngine(ch, [(5, 0)], per_cycle=4)
+    out = eng.cycle()
+    assert out["transfers"] == 0 and out["parked"] == 1
+    assert eng.outstanding == 1
+
+
+def test_transfer_is_idempotent():
+    rt = _build()
+    pairs = [(5, 0), (6, 1)]
+    assert grouped_transfer(rt, pairs) > 0
+    snap = {
+        v: jax.tree_util.tree_map(np.asarray, rt.states[v])
+        for v in rt.var_ids
+    }
+    assert grouped_transfer(rt, pairs) == 0  # exact no-op re-run
+    for v in rt.var_ids:
+        for a, b in zip(
+            jax.tree_util.tree_leaves(rt.states[v]),
+            jax.tree_util.tree_leaves(snap[v]),
+        ):
+            assert np.array_equal(np.asarray(a), b)

@@ -1,0 +1,129 @@
+"""The staging console: claim rule, seed rule, row-scoped delivery
+sets, and staging semantics (one direction per plan)."""
+
+import numpy as np
+import pytest
+
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.membership import (
+    MembershipStaging,
+    changed_delivery_rows,
+    claim_targets,
+    seed_sources,
+)
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.store import Store
+
+
+def _rt(n=8):
+    store = Store(n_actors=4)
+    store.declare(id="s", type="lasp_gset", n_elems=8)
+    return ReplicatedRuntime(store, Graph(store), n, ring(n, 2))
+
+
+class TestClaimRule:
+    def test_ring_fold_spreads_over_survivors(self):
+        # 12 -> 8: departing rows 8..11 fold onto 0..3 — never all row 0
+        t = claim_targets(12, 8)
+        assert t.tolist() == [0, 1, 2, 3]
+
+    def test_shrink_by_more_than_half_wraps(self):
+        t = claim_targets(8, 3)
+        assert t.tolist() == [0, 1, 2, 0, 1]
+
+    def test_seed_sources_mirror(self):
+        s = seed_sources(8, 12)
+        assert s.tolist() == [0, 1, 2, 3]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            claim_targets(8, 8)
+        with pytest.raises(ValueError):
+            seed_sources(8, 8)
+        with pytest.raises(ValueError):
+            claim_targets(8, 0)
+
+
+class TestChangedDeliveryRows:
+    def test_grow_marks_new_rows_and_fresh_references_only(self):
+        old = ring(8, 2)
+        new = ring(12, 2)
+        dirty = set(changed_delivery_rows(old, new, 8, 12).tolist())
+        # new rows always re-deliver
+        assert {8, 9, 10, 11} <= dirty
+        # ring(12)'s surviving prefix only rewires rows 0 and 7 (their
+        # wrap edges now point at 11 and 8); interior rows 1..6 keep
+        # identical pull lists and must NOT be marked
+        assert not ({1, 2, 3, 4, 5, 6} & dirty)
+
+    def test_shrink_marks_rewired_references(self):
+        old = ring(12, 2)
+        new = ring(8, 2)
+        dirty = set(changed_delivery_rows(old, new, 12, 8).tolist())
+        # rows 0 and 7's wrap edges change (7 and 0 newly reference
+        # each other); interior pairs keep their knowledge
+        assert dirty <= {0, 7}
+        assert not ({2, 3, 4, 5} & dirty)
+
+    def test_identical_topology_is_empty(self):
+        old = ring(8, 2)
+        assert changed_delivery_rows(old, old, 8, 8).size == 0
+
+
+class TestStaging:
+    def test_plan_join_has_seed_transfers_and_next_epoch(self):
+        rt = _rt(8)
+        st = MembershipStaging(rt)
+        st.stage_join(12)
+        plan = st.plan()
+        assert plan.kind == "join"
+        assert plan.epoch == rt.membership_epoch + 1
+        assert plan.transfers == ((0, 8), (1, 9), (2, 10), (3, 11))
+        d = plan.describe()
+        assert d["old_n"] == 8 and d["new_n"] == 12
+
+    def test_plan_leave_claims_ring_successors(self):
+        rt = _rt(8)
+        st = MembershipStaging(rt)
+        st.stage_leave(6)
+        plan = st.plan()
+        assert plan.transfers == ((6, 0), (7, 1))
+
+    def test_down_plans_no_transfers(self):
+        rt = _rt(8)
+        st = MembershipStaging(rt)
+        st.stage_down(6)
+        assert st.plan().transfers == ()
+
+    def test_chained_same_direction_collapses(self):
+        rt = _rt(8)
+        st = MembershipStaging(rt)
+        st.stage_join(10)
+        st.stage_join(12)
+        assert st.plan().new_n == 12
+
+    def test_opposite_directions_refused(self):
+        rt = _rt(8)
+        st = MembershipStaging(rt)
+        st.stage_join(12)
+        with pytest.raises(ValueError, match="one direction"):
+            st.stage_leave(6)
+        st.clear()
+        st.stage_leave(6)
+        with pytest.raises(ValueError, match="one direction"):
+            st.stage_join(12)
+
+    def test_empty_staging_refuses_plan(self):
+        rt = _rt(8)
+        with pytest.raises(ValueError, match="nothing staged"):
+            MembershipStaging(rt).plan()
+
+    def test_stage_bounds(self):
+        rt = _rt(8)
+        st = MembershipStaging(rt)
+        with pytest.raises(ValueError):
+            st.stage_join(8)
+        with pytest.raises(ValueError):
+            st.stage_leave(8)
+        with pytest.raises(ValueError):
+            st.stage_down(0)

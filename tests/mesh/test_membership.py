@@ -135,3 +135,151 @@ def test_resize_then_device_driver_and_device_read():
     rt.resize(6, ring(6, 2))  # graceful shrink
     assert rt.converge_on_device() >= 1
     assert int(rt.coverage_value("c")) == 3
+
+
+class TestClaimSuccessorLeave:
+    """The graceful-leave claim rule: departing rows fold onto their
+    ring successors (row % new_n), not row 0."""
+
+    def test_departing_state_lands_at_claim_successor(self):
+        rt = _runtime(8, with_edge=False)
+        # ungossiped writes at two departing rows
+        rt.update_batch("a", [(5, ("add", "only-5"), "p")])
+        rt.update_batch("a", [(7, ("add", "only-7"), "q")])
+        rt.resize(4, ring(4, 2), graceful=True)
+        # BEFORE any gossip: each departer's write sits at row r % 4
+        assert "only-5" in rt.replica_value("a", 1)
+        assert "only-7" in rt.replica_value("a", 3)
+        # ...and row 0 did not absorb them (the legacy rule is gone)
+        assert "only-5" not in rt.replica_value("a", 0)
+        assert "only-7" not in rt.replica_value("a", 0)
+        rt.run_to_convergence()
+        assert rt.divergence("a") == 0
+
+    def test_epoch_advances_on_every_membership_change(self):
+        rt = _runtime(8)
+        assert rt.membership_epoch == 0
+        rt.resize(12, ring(12, 2))
+        rt.resize(6, ring(6, 2), graceful=True)
+        rt.resize(4, ring(4, 2), graceful=False)
+        rt.resize(4, ring(4, 2))  # topology swap fences too
+        assert rt.membership_epoch == 4
+
+
+class TestGracefulLeaveChaosGuard:
+    """Regression (confirmed repro): the graceful-leave merge is a
+    host-side tree_map that historically IGNORED any active chaos edge
+    mask — a partition bypass (the same class as the degraded-read
+    confinement fix). The guard must refuse typed; crash-leave and
+    post-heal leaves stay allowed."""
+
+    def _partitioned(self, rounds=6):
+        import numpy as np
+
+        from lasp_tpu.chaos import ChaosRuntime, ChaosSchedule, Partition
+
+        rt = _runtime(8, with_edge=False)
+        # a write at row 7 that never crossed the cut
+        rt.update_batch("a", [(7, ("add", "sealed"), "w7")])
+        sched = ChaosSchedule(
+            8, np.asarray(rt._host_neighbors), [Partition(0, rounds, 2)]
+        )
+        ch = ChaosRuntime(rt, sched)
+        ch.step()  # the cut is live: rows {0..3} | {4..7}
+        return rt, ch
+
+    def test_repro_unguarded_merge_tunnels_through_the_cut(self):
+        """The bypass, demonstrated: with the guard disabled (the old
+        behavior), a graceful shrink moves row 7's sealed write into
+        the OTHER side of a live partition — state crossed a cut no
+        gossip round could cross."""
+        rt, ch = self._partitioned()
+        rt._handoff_guard = None  # the pre-fix behavior
+        rt.resize(4, ring(4, 2), graceful=True)
+        assert "sealed" in rt.replica_value("a", 3)  # 7 % 4: side A!
+
+    def test_guard_refuses_typed_while_partitioned(self):
+        from lasp_tpu.membership import HandoffPartitionError
+
+        rt, ch = self._partitioned()
+        with pytest.raises(HandoffPartitionError, match="partition"):
+            rt.resize(4, ring(4, 2), graceful=True)
+        # nothing moved, nothing dropped
+        assert rt.n_replicas == 8 and rt.membership_epoch == 0
+
+    def test_crash_leave_still_allowed_under_partition(self):
+        rt, ch = self._partitioned()
+        rt.resize(4, ring(4, 2), graceful=False)
+        assert rt.n_replicas == 4
+        rt.run_to_convergence()
+        assert "sealed" not in rt.coverage_value("a")  # crash semantics
+
+    def test_graceful_leave_allowed_after_heal(self):
+        rt, ch = self._partitioned(rounds=3)
+        while ch.round <= ch.schedule.horizon:
+            ch.step()
+        rt.resize(4, ring(4, 2), graceful=True)
+        rt.run_to_convergence()
+        assert "sealed" in rt.coverage_value("a")
+
+    def test_guard_refuses_crashed_departer(self):
+        import numpy as np
+
+        from lasp_tpu.chaos import ChaosRuntime, ChaosSchedule, Crash
+        from lasp_tpu.membership import HandoffPartitionError
+
+        rt = _runtime(8, with_edge=False)
+        sched = ChaosSchedule(
+            8, np.asarray(rt._host_neighbors), [Crash(0, 6)]
+        )
+        ch = ChaosRuntime(rt, sched)
+        ch.step()
+        with pytest.raises(HandoffPartitionError, match="crashed"):
+            rt.resize(4, ring(4, 2), graceful=True)
+
+
+class TestGuardHardening:
+    """Review-hardening regressions: the guard must judge against
+    bookkeeping re-based onto the CURRENT extent, and a fault-free
+    convenience wrapper must never neuter a real nemesis's guard."""
+
+    def test_guard_rebases_after_unstepped_grow(self):
+        """A grow commits without consulting the guard; a graceful
+        shrink straight after (no chaos round in between) must still
+        refuse TYPED against the rebased mask — not crash with an
+        IndexError off the stale 8-row crashed vector."""
+        import numpy as np
+
+        from lasp_tpu.chaos import ChaosRuntime, ChaosSchedule, Partition
+        from lasp_tpu.membership import HandoffPartitionError
+
+        rt = _runtime(8, with_edge=False)
+        sched = ChaosSchedule(
+            8, np.asarray(rt._host_neighbors), [Partition(0, 6, 2)]
+        )
+        ChaosRuntime(rt, sched)
+        rt.resize(12, ring(12, 2))  # grow: guard not consulted
+        with pytest.raises(HandoffPartitionError, match="partition"):
+            rt.resize(6, ring(6, 2), graceful=True)
+
+    def test_faultfree_wrapper_keeps_real_guard(self):
+        """Wrapping the same runtime in a fault-free ChaosRuntime (the
+        QuorumRuntime / MembershipCoordinator convenience wrap) must
+        not replace the nemesis wrapper's partition guard."""
+        import numpy as np
+
+        from lasp_tpu.chaos import ChaosRuntime, ChaosSchedule, Partition
+        from lasp_tpu.membership import HandoffPartitionError
+
+        rt = _runtime(8, with_edge=False)
+        sched = ChaosSchedule(
+            8, np.asarray(rt._host_neighbors), [Partition(0, 6, 2)]
+        )
+        ch = ChaosRuntime(rt, sched)
+        ch.step()  # the cut is live
+        # the fault-free convenience wrap (no events: vacuous guard)
+        ChaosRuntime(
+            rt, ChaosSchedule(8, np.asarray(rt._host_neighbors), ())
+        )
+        with pytest.raises(HandoffPartitionError, match="partition"):
+            rt.resize(4, ring(4, 2), graceful=True)

@@ -229,8 +229,10 @@ class MeshModel:
     def resize(self, new_n, new_neighbors, graceful):
         if new_n < self.n:
             if graceful:
-                for s in self.seen[new_n:]:
-                    self.seen[0] |= s
+                # the claim rule: each departing row folds onto its
+                # ring-fold successor row % new_n (not row 0)
+                for i, s in enumerate(self.seen[new_n:]):
+                    self.seen[(new_n + i) % new_n] |= s
             self.seen = self.seen[:new_n]
         else:
             self.seen += [set() for _ in range(new_n - self.n)]

@@ -34,6 +34,7 @@ def test_scenario_registry_complete():
         "chaos_heal",
         "serve_load",
         "aae_scrub",
+        "elastic_rebalance",
     }
 
 
@@ -298,3 +299,26 @@ def test_aae_scrub_small():
         assert rep["repair_frac_of_resync"] < 1.0, preset
     rh = out["rehash"]
     assert rh["incremental_seconds"] > 0 and rh["full_seconds"] > 0
+
+
+def test_elastic_rebalance_small():
+    """The elastic_rebalance artifact shape: staged-vs-legacy wire
+    figures, settle rounds, per-cycle cap evidence, during/after serve
+    latency — with the bit-equality, cap, and wire gates asserted
+    in-scenario."""
+    from lasp_tpu.bench_scenarios import elastic_rebalance
+
+    out = elastic_rebalance(n_replicas=16, grow_to=24, waves_during=4,
+                            waves_after=3, per_cycle=4)
+    assert out["scenario"] == "elastic_rebalance_16_24"
+    assert out["epoch"] == 2  # one grow + one leave, each fenced once
+    g = out["grow"]
+    assert g["max_cycle_transfers"] <= out["per_cycle_cap"]
+    assert g["pending_high_water"] <= 24 - 16
+    assert g["transfer_bytes"] > 0
+    assert g["transfer_bytes"] <= g["full_resync_bytes"]
+    assert g["full_resync_rounds"] >= 1
+    assert g["settle_rounds"] >= 1
+    assert out["leave"]["transfer_bytes"] > 0
+    lat = out["serve_tick_ms"]
+    assert lat["during_p99"] is not None and lat["after_p99"] is not None

@@ -1,0 +1,172 @@
+"""Membership-epoch fencing of in-flight quorum requests (the
+runtime.py quorum_value caveat made typed: a stale preflist after a
+resize must never silently read/push the wrong rows)."""
+
+import numpy as np
+import pytest
+
+from lasp_tpu.chaos import ChaosRuntime, ChaosSchedule, Partition
+from lasp_tpu.dataflow import Graph
+from lasp_tpu.membership import StaleEpochError
+from lasp_tpu.mesh import ReplicatedRuntime, ring
+from lasp_tpu.quorum import QuorumRuntime
+from lasp_tpu.store import Store
+
+
+def _build(n=8):
+    store = Store(n_actors=16)
+    store.declare(id="kv", type="lasp_gset", n_elems=32)
+    return ReplicatedRuntime(store, Graph(store), n, ring(n, 2))
+
+
+def _partitioned(n=8, rounds=16):
+    """A quorum runtime whose requests CANNOT complete (coordinator's
+    component too small for n=3 picks) — keeps them in WAITING_R so a
+    mid-flight resize actually catches them in flight."""
+    rt = _build(n)
+    sched = ChaosSchedule(
+        n, rt._host_neighbors, [Partition(0, rounds, 4)]
+    )
+    return rt, ChaosRuntime(rt, sched)
+
+
+def test_waiting_request_without_retries_fails_typed():
+    rt, ch = _partitioned()
+    qr = QuorumRuntime(ch, timeout=32, retries=0)
+    rid = qr.submit_get("kv", coordinator=6, r=3)
+    qr.step()  # issues; the 2-row component starves the R=3 quorum
+    assert qr.result(rid, raise_on_error=False)["status"] == "pending"
+    rt.resize(4, ring(4, 2), graceful=False)
+    qr.step()
+    res = qr.result(rid, raise_on_error=False)
+    assert res["status"] == "stale_epoch"
+    with pytest.raises(StaleEpochError) as ei:
+        qr.result(rid)
+    assert ei.value.current_epoch == rt.membership_epoch
+
+
+def test_waiting_request_with_retries_reprepares_on_new_ring():
+    rt, ch = _partitioned(rounds=4)
+    qr = QuorumRuntime(ch, timeout=32, retries=2)
+    rid = qr.submit_get("kv", coordinator=6, r=3)
+    qr.step()
+    rt.resize(4, ring(4, 2), graceful=False)
+    # heal rounds + fence: the request re-prepares (coordinator 6
+    # remaps to its claim successor 6 % 4 == 2) and completes on the
+    # new ring
+    for _ in range(12):
+        qr.step()
+        if qr.result(rid, raise_on_error=False)["status"] == "done":
+            break
+    res = qr.result(rid)
+    assert res["status"] == "done"
+    assert res["coordinator"] == 2
+    assert all(r < 4 for r in res["acks"])
+    assert res["retries"] >= 1  # the fence consumed a retry
+    assert any(
+        ev[2] == "epoch_fence" and ev[3][0] == "refenced"
+        for ev in qr.trace
+    )
+
+
+def test_prepare_request_with_departed_coordinator_remaps():
+    rt = _build(8)
+    qr = QuorumRuntime(rt, timeout=6, retries=0)
+    rid = qr.submit_put("kv", ("add", "k"), "w0", coordinator=6)
+    rt.resize(4, ring(4, 2), graceful=True)
+    while qr.inflight:
+        qr.step()
+    res = qr.result(rid)
+    assert res["status"] == "done"
+    assert res["coordinator"] == 2  # 6 % 4, the claim successor
+    assert all(r < 4 for r in res["acks"])
+    assert "k" in rt.coverage_value("kv")
+
+
+def test_grow_leaves_inflight_requests_untouched():
+    """A pure grow advances the epoch but invalidates nothing:
+    surviving rows keep their indices, so in-flight requests keep
+    their preflists — no retry burned, no spurious stale_epoch, no
+    early finalize — and complete normally once reachable."""
+    rt, ch = _partitioned(rounds=3)
+    qr = QuorumRuntime(ch, timeout=32, retries=2)
+    rid = qr.submit_get("kv", coordinator=5, r=3)
+    qr.step()
+    rt.resize(12, ring(12, 2))
+    for _ in range(12):
+        qr.step()
+        if not qr.inflight:
+            break
+    res = qr.result(rid)
+    assert res["status"] == "done"
+    assert res["retries"] == 0  # the fence consumed nothing
+    assert not any(ev[2] == "epoch_fence" for ev in qr.trace)
+
+
+def test_shrink_sparing_the_preflist_leaves_request_untouched():
+    """A shrink whose surviving extent still covers a request's whole
+    preflist does not disturb it (indices keep their meaning)."""
+    rt, ch = _partitioned(rounds=3)
+    qr = QuorumRuntime(ch, timeout=32, retries=2)
+    rid = qr.submit_get("kv", coordinator=0, r=3)  # picks [0, 1, 2]
+    qr.step()
+    rt.resize(6, ring(6, 2), graceful=False)  # picks all survive
+    for _ in range(12):
+        qr.step()
+        if not qr.inflight:
+            break
+    res = qr.result(rid)
+    assert res["status"] == "done" and res["retries"] == 0
+    assert not any(ev[2] == "epoch_fence" for ev in qr.trace)
+
+
+def test_fence_counts_metric():
+    from lasp_tpu.telemetry import registry
+
+    rt, ch = _partitioned()
+    qr = QuorumRuntime(ch, timeout=32, retries=0)
+    qr.submit_get("kv", coordinator=6, r=3)
+    qr.step()
+    rt.resize(4, ring(4, 2), graceful=False)
+    qr.step()
+    fam = registry.get_registry().snapshot().get(
+        "quorum_epoch_fences_total"
+    )
+    assert fam is not None
+    failed = [
+        s["value"] for s in fam["series"]
+        if s["labels"].get("outcome") == "failed"
+    ]
+    assert failed and failed[0] >= 1
+
+
+def test_new_submissions_after_resize_use_new_ring_unfenced():
+    rt = _build(8)
+    qr = QuorumRuntime(rt, timeout=6, retries=1)
+    rt.resize(4, ring(4, 2), graceful=True)
+    rid = qr.submit_put("kv", ("add", "fresh"), "w1", coordinator=1)
+    while qr.inflight:
+        qr.step()
+    res = qr.result(rid)
+    assert res["status"] == "done" and res["retries"] == 0
+
+
+def test_prepare_request_too_wide_for_shrunken_ring_fails_typed():
+    """A PREPARE-state request whose preflist width no longer fits the
+    shrunken population must resolve typed stale_epoch — never abort
+    the whole step with an untyped preflist ValueError."""
+    rt = _build(8)
+    qr = QuorumRuntime(rt, n=6, timeout=6, retries=2)
+    rid = qr.submit_put("kv", ("add", "wide"), "w0", coordinator=0,
+                        n=6, w=2)
+    # hold it in PREPARE: shrink lands before its first step
+    rt.resize(4, ring(4, 2), graceful=True)
+    qr.step()  # must not raise
+    with pytest.raises(StaleEpochError, match="preflist width"):
+        qr.result(rid)
+    # the engine is not stranded: fresh submissions still complete
+    rid2 = qr.submit_put("kv", ("add", "fits"), "w1", coordinator=1,
+                         n=3, w=2)
+    while qr.inflight:
+        qr.step()
+    assert qr.result(rid2)["status"] == "done"

@@ -339,3 +339,78 @@ def test_parity_at_100k_registered_thresholds():
         rt.update_at(i % R, "c", ("increment",), f"a{i % R}")
     out = threshold_parity(rt, "c", 100_000, seed=11)
     assert out["parity"] and out["n_thresholds"] == 100_000
+
+
+class TestShrinkRehoming:
+    """Satellite: serve subscription re-homing under SHRINK — a watch
+    parked on a departing replica re-homes to its CLAIM SUCCESSOR
+    (``membership.plan.claim_targets`` rule) or expires typed; it never
+    fires stale off a departed row's last state."""
+
+    def _parked_watch(self, replica, payload="park"):
+        store, rt = build_rt(g=("lasp_gset", {"n_elems": 8}))
+        table = SubscriptionTable()
+        gvar = store.variable("g")
+        thr = Threshold(gvar.codec.new(gvar.spec), True)
+        sid = table.register("g", gvar.codec, gvar.spec, thr,
+                             replica=replica, payload=payload)
+        return store, rt, table, sid
+
+    def test_rehome_moves_watch_to_claim_successor(self):
+        store, rt, table, sid = self._parked_watch(replica=6)
+        rt.resize(4, ring(4, 2))
+        res = table.rehome(4)
+        assert res == {"rehomed": 1, "expired": []}
+        pop_of, meta_of = accessors(store, rt)
+        # the successor row (6 % 4 == 2) is the ONLY row that fires it
+        rt.update_at(3, "g", ("add", "elsewhere"), "w0")
+        assert table.evaluate(pop_of, meta_of) == []
+        rt.update_at(2, "g", ("add", "home"), "w1")
+        assert table.evaluate(pop_of, meta_of) == [(sid, "park")]
+
+    def test_rehome_respects_custom_claim(self):
+        _store, rt, table, _sid = self._parked_watch(replica=7)
+        rt.resize(4, ring(4, 2))
+        table.rehome(4, claim_of=lambda r: 1)
+        group = table._groups["g"]
+        slot = table._index[_sid][1]
+        assert int(group.replica[slot]) == 1
+
+    def test_expire_retires_typed_and_never_fires(self):
+        store, rt, table, sid = self._parked_watch(replica=7,
+                                                   payload="ticket")
+        rt.resize(4, ring(4, 2), graceful=False)  # crash semantics
+        res = table.rehome(4, expire=True)
+        assert res["rehomed"] == 0
+        assert res["expired"] == [(sid, "ticket")]
+        assert len(table) == 0
+        # even a write that would have met it cannot fire a claimed watch
+        pop_of, meta_of = accessors(store, rt)
+        rt.update_at(3, "g", ("add", "x"), "w0")
+        assert table.evaluate(pop_of, meta_of) == []
+
+    def test_surviving_watches_untouched(self):
+        store, rt, table, sid = self._parked_watch(replica=1)
+        rt.resize(4, ring(4, 2))
+        res = table.rehome(4)
+        assert res == {"rehomed": 0, "expired": []}
+        pop_of, meta_of = accessors(store, rt)
+        rt.update_at(1, "g", ("add", "k"), "w0")
+        assert table.evaluate(pop_of, meta_of) == [(sid, "park")]
+
+    def test_departed_watch_never_fires_from_departed_state(self):
+        """Regression shape: the departing row's state met the watch,
+        the claim successor's does not — after re-homing the watch
+        stays parked (no stale fire off the dropped row)."""
+        store, rt = build_rt(g=("lasp_gset", {"n_elems": 8}))
+        table = SubscriptionTable()
+        gvar = store.variable("g")
+        rt.update_at(6, "g", ("add", "only-at-6"), "w0")
+        # strict watch above bottom: met at row 6, not at its successor
+        thr = Threshold(gvar.codec.new(gvar.spec), True)
+        sid = table.register("g", gvar.codec, gvar.spec, thr,
+                             replica=6, payload="p")
+        rt.resize(4, ring(4, 2), graceful=False)  # row 6's state gone
+        table.rehome(4)
+        pop_of, meta_of = accessors(store, rt)
+        assert table.evaluate(pop_of, meta_of) == []  # parked, not stale

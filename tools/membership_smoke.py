@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Membership smoke for the lint tier (Makefile ``verify``): a
+sub-minute guard on the staged-membership contracts
+(docs/RESILIENCE.md "Membership & handoff"):
+
+1. **round-trip bit-equality** — join → rebalance → leave returns a
+   population BIT-IDENTICAL to a static twin built at the base
+   membership with the same writes, across ring/random topologies ×
+   leafwise (gset) / vclock (orswot) / packed (flat OR-Set) codecs,
+   with replay determinism;
+2. **no acknowledged write lost** — quorum puts submitted while the
+   population grows and shrinks under the rolling-crash nemesis all
+   survive (epoch fencing resolves every in-flight request typed;
+   hints cover crashed departers);
+3. **metric liveness** — the ``membership_*`` metric family, the
+   ``membership.transfer`` span, and the ``handoff_transfer`` roofline
+   ledger family all record live values during the runs above.
+
+Exits 0 on agreement, 1 with the divergence."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.membership import run_membership_harness
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular, ring
+    from lasp_tpu.store import Store
+
+    N = 12
+
+    def builder(nbrs, packed):
+        def build():
+            store = Store(n_actors=32)
+            store.declare(id="g", type="lasp_gset", n_elems=64)
+            store.declare(id="w", type="riak_dt_orswot", n_elems=32)
+            store.declare(id="o", type="lasp_orset", n_elems=32,
+                          tokens_per_actor=8)
+            return ReplicatedRuntime(store, Graph(store), N, nbrs,
+                                     packed=packed)
+
+        return build
+
+    writes = [
+        (1, 0, "g", ("add", "w0"), "a0"),
+        (3, 5, "w", ("add", "w1"), "a1"),
+        (6, 2, "o", ("add", "w2"), "a2"),
+        (9, 7, "g", ("add", "w3"), "a3"),
+    ]
+
+    # -- 1. join -> rebalance -> leave round-trip bit-equality --------------
+    for topo_name, nbrs in (
+        ("ring", ring(N, 2)),
+        ("random", random_regular(N, 3, seed=7)),
+    ):
+        for packed in (False, True):
+            build = builder(nbrs, packed)
+            rep = run_membership_harness(
+                build,
+                [(2, "join", 18), (8, "leave", N)],
+                build_twin=build,
+                writes=writes,
+                per_cycle=3,
+            )
+            if not rep.get("bit_identical_to_twin"):
+                print(
+                    f"membership_smoke: round-trip NOT bit-identical "
+                    f"({topo_name}, packed={packed})"
+                )
+                return 1
+            if not rep.get("replay_identical"):
+                print(
+                    f"membership_smoke: replay diverged "
+                    f"({topo_name}, packed={packed})"
+                )
+                return 1
+            print(
+                f"round-trip ok [{topo_name} packed={packed}] "
+                f"rounds={rep['rounds']} epoch={rep['epoch']}"
+            )
+
+    # -- 2. no acked write lost under rolling-crash mid-rebalance -----------
+    rep = run_membership_harness(
+        builder(ring(N, 2), False),
+        [(3, "join", 16), (9, "leave", N)],
+        preset="rolling-crash", seed=5, nemesis_rounds=10,
+        quorum_writes=[
+            (1, "g", ("add", "q0"), "c0", 0),
+            (4, "g", ("add", "q1"), "c1", 13),
+            (8, "g", ("add", "q2"), "c2", 5),
+            (10, "g", ("add", "q3"), "c3", 14),
+        ],
+        per_cycle=2,
+    )
+    if not rep.get("no_write_lost"):
+        print("membership_smoke: acked write lost under rolling-crash")
+        return 1
+    print(
+        f"no-write-lost ok acked={rep['acked_writes']} "
+        f"fenced={rep['stale_epoch_failures']} rounds={rep['rounds']}"
+    )
+
+    # -- 3. metric / span / ledger liveness ---------------------------------
+    from lasp_tpu.telemetry.registry import get_registry
+    from lasp_tpu.telemetry.roofline import get_ledger
+
+    snap = get_registry().snapshot()
+    for name in ("membership_epoch", "membership_commits_total",
+                 "membership_transfers_total",
+                 "membership_transfer_bytes_total",
+                 "membership_pending_transfers"):
+        fam = snap.get(name)
+        if fam is None or not fam["series"]:
+            print(f"membership_smoke: metric {name} never recorded")
+            return 1
+    done = [
+        s["value"] for s in snap["membership_transfers_total"]["series"]
+        if s["labels"].get("outcome") == "done"
+    ]
+    if not done or done[0] <= 0:
+        print("membership_smoke: no transfers recorded as done")
+        return 1
+    ledger = [
+        r for r in get_ledger().snapshot()
+        if r["family"] == "handoff_transfer"
+    ]
+    if not ledger:
+        print("membership_smoke: no handoff_transfer ledger rows")
+        return 1
+    warm = [r for r in ledger if r["dispatches"] > 0]
+    if not warm:
+        print("membership_smoke: handoff_transfer rows never warmed "
+              "past the compile dispatch")
+        return 1
+    print(
+        f"telemetry ok: {int(done[0])} transfers, "
+        f"{len(ledger)} handoff_transfer ledger row(s)"
+    )
+    print("membership smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
