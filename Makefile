@@ -55,7 +55,11 @@ test-slow:
 # ring/random x leafwise/vclock/packed, the no-acked-write-lost
 # contract under rolling-crash mid-rebalance, and membership_* /
 # handoff_transfer telemetry liveness (docs/RESILIENCE.md
-# "Membership & handoff")
+# "Membership & handoff"), and a flight smoke guards the on-device
+# flight recorder: a fused converge_on_device's drained per-round
+# per-var residual records bit-identical to unfused stepping on the
+# same seed, with a monotone-plausible curve (docs/OBSERVABILITY.md
+# "Flight recorder")
 verify:
 	python tools/check_metrics_catalog.py
 	python tools/frontier_smoke.py
@@ -70,6 +74,7 @@ verify:
 	python tools/aae_smoke.py
 	python tools/ingest_smoke.py
 	python tools/membership_smoke.py
+	python tools/flight_smoke.py
 	python -m pytest tests/ -q -m 'not slow'
 
 bench:

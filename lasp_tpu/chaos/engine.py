@@ -538,20 +538,24 @@ class ChaosRuntime:
         # the degrade happens ONCE here, not per cached mask identity
         rt._frontier_sync_mask(self)
         masks = self.schedule.masks(self.round, self.round + n_rounds)
-        key = ("fused", n_rounds)
+        key = ("fused", n_rounds, rt.var_ids)
         fn = self._fused_cache.get(key)
         if fn is None:
             step = rt._step_pure
+            n_vars = len(rt.var_ids)
 
             def fused(states, neighbors, masks_, tables_):
                 def body(i, carry):
                     s, res = carry
                     out, res_vec = step(s, neighbors, masks_[i], tables_)
-                    return out, res.at[i].set(jnp.sum(res_vec))
+                    # PER-VAR per-round residual rows — the window's own
+                    # flight record (T <= the flight ring bound is moot
+                    # here: the carry is already per round, no modulo)
+                    return out, res.at[i].set(res_vec.astype(jnp.int32))
 
                 return jax.lax.fori_loop(
                     0, n_rounds, body,
-                    (states, jnp.zeros((n_rounds,), jnp.int32)),
+                    (states, jnp.zeros((n_rounds, n_vars), jnp.int32)),
                 )
 
             fn = jax.jit(fused)
@@ -563,18 +567,24 @@ class ChaosRuntime:
                 rt.states, res = rt._run_step_fn(
                     fn, jnp.asarray(masks), tables
                 )
-        res = np.asarray(res)
+        res = np.asarray(res)  # [T, V] per-round per-var residuals
+        totals = res.sum(axis=1)
         # masks varied inside the block: even a zero tail only proves a
         # MASKED fixed point — degrade (the opaque-block rule)
         rt._frontier_after_opaque(False)
-        rt.trace.record_round(int(res[-1]), t.elapsed)
+        rt.trace.record_round(int(totals[-1]), t.elapsed)
         rt._record_rounds(n_rounds)
+        # flight drain: real per-round residual curve points for the
+        # chaos window (quiescent=None — a masked zero round proves only
+        # a MASKED fixed point) plus the exact ledger join tally
+        joins = rt._drain_flight(
+            "chaos_window", res, n_rounds, None, t.elapsed,
+        )
         # ledger: the stacked-mask window is its own kernel family (the
         # bool[T,R,K] mask operand rides the dispatch; each window
         # length is its own compiled executable, hence the block key)
         rt._ledger_record_store("chaos_window", t.elapsed, n_rounds,
-                                block=n_rounds)
-        rt._observe_opaque_block(n_rounds, None, t.elapsed)
+                                block=n_rounds, joins=joins)
         # per-round duplicate accounting from the masks ALREADY compiled
         # for the dispatch (no second mask_at pass); gauges emit once for
         # the window's final round — intermediate per-round values could
@@ -587,7 +597,7 @@ class ChaosRuntime:
             # the opaque block degraded every var to all-dirty: one
             # commit refresh keeps the forest's baseline current
             self.aae.on_round_end(self.round - 1)
-        return res.tolist()
+        return totals.tolist()
 
     # -- degraded reads + read-repair -----------------------------------------
     def live_replicas(self) -> np.ndarray:

@@ -564,7 +564,7 @@ def cmd_trace(args) -> int:
             file=sys.stderr,
         )
         return 2
-    rt.run_to_convergence(max_rounds=args.max_rounds)
+    rt.run_to_convergence(max_rounds=args.max_rounds, block=args.block)
     rt.graph.propagate()  # fold the combinator edges' provenance in
     get_monitor().probe(rt)
     lineage = rt.graph.lineage(args.var)
@@ -580,6 +580,33 @@ def cmd_trace(args) -> int:
         },
         "export": args.export,
     }))
+    return 0
+
+
+def cmd_flight(args) -> int:
+    """Flight-recorder console: drive the observed mesh's fully fused
+    convergence (``converge_on_device`` — zero per-round host syncs),
+    then print the windows the on-device ring retained: per-round
+    per-variable residual records, exactly what the fused dispatch did
+    round by round. ``--export`` writes the full snapshot JSON
+    (windows + drop counters) for offline diffing."""
+    from lasp_tpu.telemetry import device as tel_flight
+
+    if args.replicas < 2:
+        print("error: --replicas must be >= 2 (nothing to record)",
+              file=sys.stderr)
+        return 2
+    rt = _observatory_runtime(args.replicas)
+    rounds = rt.converge_on_device(max_rounds=args.max_rounds)
+    rt.graph.propagate()  # the dataflow megakernel's window too
+    ws = tel_flight.windows()
+    print(tel_flight.render(ws))
+    print(f"converged in {rounds} rounds; "
+          f"{len(ws)} flight windows retained")
+    if args.export:
+        with open(args.export, "w") as fp:
+            json.dump(tel_flight.snapshot(), fp, indent=2)
+        print(f"exported -> {args.export}")
     return 0
 
 
@@ -923,6 +950,21 @@ def main(argv=None) -> int:
     tr.add_argument("--deep", action="store_true",
                     help="turn on deep tracing (per-op / per-merge / "
                          "per-edge events) for the driven workload")
+    tr.add_argument("--block", type=int, default=1,
+                    help="fused-window size for the driven convergence "
+                         "(>1 runs device-resident blocks; the flight "
+                         "recorder keeps the per-round records real)")
+
+    fl = sub.add_parser(
+        "flight",
+        help="drive a fused convergence and dump the on-device flight "
+             "recorder: per-round residual records retained by the "
+             "in-loop ring (docs/OBSERVABILITY.md)",
+    )
+    fl.add_argument("--replicas", type=int, default=64)
+    fl.add_argument("--max-rounds", type=int, default=256)
+    fl.add_argument("--export", default=None, metavar="FILE",
+                    help="write the full flight snapshot as JSON")
 
     roof = sub.add_parser(
         "roofline",
@@ -964,6 +1006,7 @@ def main(argv=None) -> int:
         "metrics": cmd_metrics,
         "top": cmd_top,
         "trace": cmd_trace,
+        "flight": cmd_flight,
         "roofline": cmd_roofline,
         "inspect": cmd_inspect,
         "bridge": cmd_bridge,

@@ -45,6 +45,13 @@ class LaspConfig:
     #: extent of the tensor-parallel "state" axis in build_mesh
     mesh_state_axis: int = 1
 
+    # -- telemetry ----------------------------------------------------------
+    #: flight-recorder ring depth K: the last K rounds of per-round
+    #: records each fused window retains on device and drains on its
+    #: sync (telemetry/device.py; windows longer than K keep the
+    #: suffix and count the lost prefix as overwritten)
+    flight_rounds: int = 64
+
     # -- bridge -------------------------------------------------------------
     #: wire codec selection: auto (native .so when present AND it passes
     #: the byte-conformance self-check, else python) | python (forced)
@@ -102,7 +109,7 @@ class LaspConfig:
         if self.etf not in ("auto", "python"):
             raise ValueError(f"etf: {self.etf!r} (auto | python)")
         for name in ("n_actors", "fanout", "fused_block", "mesh_state_axis",
-                     "bench_block"):
+                     "bench_block", "flight_rounds"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1")
         return self

@@ -456,7 +456,7 @@ class Graph:
         #: (a runaway propagate is exactly what an operator scrapes for)
         stats = {
             "rounds": 0, "executed": 0, "runs": [0] * len(self.edges),
-            "fused": False, "changed_by_dst": None,
+            "fused": False, "changed_by_dst": None, "flight": None,
         }
         t = Timer()
         try:
@@ -542,16 +542,47 @@ class Graph:
             )
             return False
         secs = time.perf_counter() - t0
-        new_states, counts, sweeps, pending = out
+        new_states, counts, sweeps, pending = out[:4]
         sweeps = int(sweeps)
         pending = bool(pending)
         counts = np.asarray(counts)
+        # flight drain: decode the per-sweep changed-flag ring (already
+        # synced above) into the window log + the per-sweep records
+        # _emit_propagate_telemetry turns into causal events
+        joins = len(idx) * sweeps
+        if len(out) > 4:
+            from ..telemetry import device as tel_flight
+            from ..telemetry import registry as _reg
+
+            if _reg.enabled():
+                records, overwritten = tel_flight.decode_ring(
+                    out[4], sweeps
+                )
+                stats["flight"] = {
+                    "records": records,
+                    "overwritten": overwritten,
+                    "dst_order": ent.dst_order,
+                }
+                if not overwritten:
+                    # exact: total (dst, sweep) inflations the window
+                    # actually performed, vs the every-edge-every-sweep
+                    # upper bound
+                    joins = sum(sum(r) for r in records)
+                tel_flight.record_window(tel_flight.FlightWindow(
+                    family="dataflow_fused",
+                    columns=tuple(ent.dst_order),
+                    rounds=sweeps,
+                    overwritten=overwritten,
+                    records=records,
+                    seconds=secs,
+                    quiescent=not pending,
+                ))
         get_ledger().record(
             "dataflow_fused", "Graph",
             n_replicas=1, fanout=len(idx), seconds=secs,
             row_bytes=ent.sweep_bytes, window=sweeps, rounds=sweeps,
             bytes_moved=ent.sweep_bytes * sweeps,
-            joins=len(idx) * sweeps, n_vars=len(idx),
+            joins=joins, n_vars=len(idx),
         )
         for i in idx:
             self._edge_ran[i] = True
@@ -677,6 +708,23 @@ class Graph:
         if stats["changed_by_dst"] is not None:
             attrs["changed_by_dst"] = stats["changed_by_dst"]
         tel_events.emit("propagate", **attrs)
+        # flight drain: the fused window's per-sweep records — real
+        # rounds in the causal log where there used to be only the
+        # collapsed summary above (overwritten sweeps stay collapsed:
+        # the modulo ring kept the last K only)
+        flight = stats.get("flight")
+        if flight is not None:
+            for i, rec in enumerate(flight["records"]):
+                tel_events.emit(
+                    "propagate_sweep",
+                    sweep=flight["overwritten"] + i,
+                    changed=int(sum(rec)),
+                    by_dst={
+                        d: int(c)
+                        for d, c in zip(flight["dst_order"], rec) if c
+                    },
+                    fused=True,
+                )
         if total_skipped:
             tel_events.emit(
                 "frontier_skip", skipped=int(total_skipped),

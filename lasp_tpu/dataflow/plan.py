@@ -376,9 +376,15 @@ def compile_fused(graph, idx, states, tables) -> FusedPropagate:
     meta = {d: graph._meta(d) for d in dst_order}
     round_fn = make_round_fn(edges, groups, meta, tuple(dst_order))
     n_dsts = len(dst_order)
+    # the stats carry: per-sweep changed flags into a modulo-K flight
+    # ring, drained on the sync the propagate already performs
+    from ..telemetry.device import flight_rounds
 
+    flight_k = flight_rounds()
     fn = jax.jit(
-        lambda s, t, lim: fused_dataflow_rounds(round_fn, s, t, n_dsts, lim)
+        lambda s, t, lim: fused_dataflow_rounds(
+            round_fn, s, t, n_dsts, lim, flight_rounds=flight_k
+        )
     )
     gauge(
         "dataflow_plan_groups",

@@ -34,6 +34,7 @@ import numpy as np
 from ..lattice.base import Threshold, replicate
 from ..ops.flatpack import FlatORSet, FlatORSetSpec
 from ..telemetry import counter, events as tel_events, gauge, histogram, span
+from ..telemetry import device as tel_flight
 from ..telemetry.convergence import get_monitor, record_membership
 from ..telemetry.roofline import get_ledger, state_row_bytes
 from ..utils.metrics import StepTrace, Timer
@@ -161,9 +162,10 @@ class FusedBlockHandle:
     (spans nest thread-locally)."""
 
     __slots__ = ("_rt", "_block", "_first_zero", "_timer", "_span",
-                 "_result", "_states_in")
+                 "_result", "_states_in", "_flight")
 
-    def __init__(self, rt, block, first_zero, timer, sp, states_in):
+    def __init__(self, rt, block, first_zero, timer, sp, states_in,
+                 flight=None):
         self._rt = rt
         self._block = block
         self._first_zero = first_zero
@@ -174,6 +176,9 @@ class FusedBlockHandle:
         #: across failures", and the window's output was already bound
         #: to rt.states at dispatch — a failed sync must restore this
         self._states_in = states_in
+        #: the window's flight ring (int32[K, V] per-round residual
+        #: records), drained on the finish() sync
+        self._flight = flight
         self._result: "int | None" = None
 
     @property
@@ -204,9 +209,23 @@ class FusedBlockHandle:
         rt._frontier_after_opaque(first_zero >= 0)
         rt.trace.record_round(-1 if first_zero < 0 else 0, t.elapsed)
         rt._record_rounds(block)  # fori always executes the whole block
+        # flight drain rides the sync above: real per-round residual
+        # records replace the single opaque delivery marker, and the
+        # exact changed-state tally (when no rounds were overwritten)
+        # replaces the ledger's joins upper bound
+        flight = self._flight
+        self._flight = None
+        joins = None
+        if flight is not None:
+            joins = rt._drain_flight(
+                "fused_block", flight, block, first_zero >= 0, t.elapsed
+            )
         rt._ledger_record_store("fused_block", t.elapsed, block,
-                                block=block)
-        rt._observe_opaque_block(block, first_zero >= 0, t.elapsed)
+                                block=block, joins=joins)
+        if flight is None:
+            # no ring (handle constructed without one): keep the
+            # historical opaque clock-advance
+            rt._observe_opaque_block(block, first_zero >= 0, t.elapsed)
         self._result = first_zero
         return first_zero
 
@@ -2340,18 +2359,21 @@ class ReplicatedRuntime:
         result inside the guarded region — jax dispatch is asynchronous,
         so a device-side failure (OOM mid-block) surfaces at the blocking
         host transfer, not at the call. Returns ``(new_states, result:
-        np.ndarray)`` — a scalar for the fused/while entry points, the
-        per-var residual vector for the plain step. On failure, the
+        np.ndarray, *rest)`` — the result is a scalar for the fused/while
+        entry points, the per-var residual vector for the plain step;
+        any FURTHER outputs (the flight ring) pass through untouched,
+        ready by the time the result sync returns. On failure, the
         runtime is marked poisoned only if donation actually consumed the
         input buffers (trace/compile-time errors leave state intact and
         recoverable)."""
         states_in = self.states  # property read: raises if already poisoned
         try:
-            new_states, scalar = fn(
+            out = fn(
                 states_in, self.neighbors, edge_mask, tables, *extra
             )
+            new_states, scalar, rest = out[0], out[1], out[2:]
             # device sync: errors land here
-            return new_states, np.asarray(scalar)
+            return (new_states, np.asarray(scalar)) + tuple(rest)
         except Exception as exc:
             self._poison_if_donated(exc)
             raise
@@ -2533,13 +2555,18 @@ class ReplicatedRuntime:
 
     def _ledger_record_store(self, family: str, seconds: float,
                              rounds: int,
-                             block: "int | None" = None) -> None:
+                             block: "int | None" = None,
+                             joins: "int | None" = None) -> None:
         """Attribute one whole-store dispatch (dense step / fused block /
         on-device while) — bytes are the exact per-round wire estimate
         the bytes counter already uses (``round_traffic_bytes``).
         ``block`` keys the signature for fixed-length fused windows
         (each block length is its own compiled executable, so its first
-        dispatch must land in that signature's compile bucket)."""
+        dispatch must land in that signature's compile bucket).
+        ``joins``, when the flight recorder drained every round of the
+        window, is the EXACT changed-state tally — it replaces the
+        ``R·fanout·V·rounds`` upper bound so the fused families' ledger
+        rows attribute what the window actually inflated."""
         from ..telemetry import registry as _reg
 
         if not _reg.enabled():
@@ -2552,7 +2579,12 @@ class ReplicatedRuntime:
             fanout=self._ledger_fanout(),
             seconds=seconds,
             bytes_moved=self._round_traffic * rounds,
-            joins=self.n_replicas * self._ledger_fanout() * n_vars * rounds,
+            joins=(
+                joins
+                if joins is not None
+                else self.n_replicas * self._ledger_fanout()
+                * n_vars * rounds
+            ),
             rounds=rounds,
             rows=block,
             n_vars=n_vars,
@@ -2647,19 +2679,28 @@ class ReplicatedRuntime:
         fn = self._fused_steps_cache.get(block)
         if fn is None:
             step = self._step_pure
+            flight_k = tel_flight.flight_rounds()
+            n_vars = len(self.var_ids)
 
             def fused(states, neighbors, mask, tables):
+                # the stats carry: per-round per-var residual vectors
+                # into a modulo-K flight ring, created INSIDE the jit so
+                # the donation signature is untouched
+                ring0 = tel_flight.ring_init(flight_k, n_vars)
+
                 def body(i, carry):
-                    s, first_zero = carry
+                    s, first_zero, ring = carry
                     out, res_vec = step(s, neighbors, mask, tables)
                     residual = jnp.sum(res_vec)
                     first_zero = jnp.where(
                         (first_zero < 0) & (residual == 0), i, first_zero
                     )
-                    return out, first_zero
+                    return out, first_zero, tel_flight.ring_write(
+                        ring, i, res_vec
+                    )
 
                 return jax.lax.fori_loop(
-                    0, block, body, (states, jnp.int32(-1))
+                    0, block, body, (states, jnp.int32(-1), ring0)
                 )
 
             fn = jax.jit(fused, donate_argnums=self._donate_argnums())
@@ -2670,7 +2711,7 @@ class ReplicatedRuntime:
         t.__enter__()
         states_in = self.states  # property read: raises if poisoned
         try:
-            new_states, first_zero = fn(
+            new_states, first_zero, flight = fn(
                 states_in, self.neighbors, edge_mask, tables
             )
         except Exception as exc:
@@ -2679,7 +2720,9 @@ class ReplicatedRuntime:
             self._poison_if_donated(exc)
             raise
         self.states = new_states
-        return FusedBlockHandle(self, block, first_zero, t, sp, states_in)
+        return FusedBlockHandle(
+            self, block, first_zero, t, sp, states_in, flight
+        )
 
     def _poison_if_donated(self, exc: Exception) -> None:
         """Shared failure rule of every donating dispatch (sync or
@@ -2711,6 +2754,66 @@ class ReplicatedRuntime:
             seconds=round(elapsed, 6),
             n_replicas=self.n_replicas,
         )
+
+    def _drain_flight(self, family: str, ring, rounds: int,
+                      quiescent: "bool | None", elapsed: float,
+                      var_ids=None, meta: "dict | None" = None,
+                      ) -> "int | None":
+        """Drain one fused window's flight ring into the host telemetry
+        plane — the replacement for :meth:`_observe_opaque_block` on
+        every path that carries the stats ring. The decode rides the
+        device sync the caller already performed (``ring`` may be a
+        device array; ``np.asarray`` here is a no-op copy of a ready
+        buffer, never a new sync point).
+
+        Feeds, per RETAINED round: ``ConvergenceMonitor.observe_round``
+        (the same per-var residual vectors the unfused step emits —
+        bit-for-bit identical curve points) and one causal ``delivery``
+        event with round provenance (the fused window's real per-round
+        records, bounded by ``flight_rounds``); the overwritten prefix
+        only advances the monitor's round clock. The window lands in
+        ``telemetry.device``'s bounded log (``lasp_tpu flight``).
+
+        Returns the exact changed-state total over the window (the
+        ledger's joins override), or None when telemetry is disabled or
+        the ring lost rounds (a partial tally must not masquerade as
+        exact)."""
+        if rounds <= 0 or self._instruments() is None:
+            return None
+        ids = self.var_ids if var_ids is None else tuple(var_ids)
+        records, overwritten = tel_flight.decode_ring(ring, rounds)
+        mon = get_monitor()
+        if overwritten:
+            # clock-advance only: the retained suffix supplies REAL
+            # curve points, so no terminal marker (whose -1/0 would
+            # pollute the curve the suffix is about to extend)
+            mon.observe_opaque_rounds(overwritten, None)
+        first_round = mon.round + 1
+        per_round = elapsed / max(rounds, 1)
+        for rec in records:
+            mon.observe_round(ids, rec, per_round, self.n_replicas)
+        tel_events.set_round(mon.round)
+        for i, rec in enumerate(records):
+            tel_events.emit(
+                "delivery",
+                round=first_round + i,
+                residual=int(sum(rec)),
+                fused=family,
+                n_replicas=self.n_replicas,
+            )
+        tel_flight.record_window(tel_flight.FlightWindow(
+            family=family,
+            columns=tuple(str(v) for v in ids),
+            rounds=int(rounds),
+            overwritten=int(overwritten),
+            records=records,
+            seconds=float(elapsed),
+            quiescent=quiescent,
+            first_round=first_round,
+            meta=dict(meta or {}),
+        ))
+        total = sum(sum(rec) for rec in records)
+        return None if overwritten else int(total)
 
     def run_to_convergence(
         self, max_rounds: int = 10_000, edge_mask=None, block: int = 1,
@@ -2829,30 +2932,39 @@ class ReplicatedRuntime:
         fn = self._fused_steps_cache.get("while")
         if fn is None:
             step = self._step_pure
+            flight_k = tel_flight.flight_rounds()
+            n_vars = len(self.var_ids)
 
             def converge(states, neighbors, mask, tables, mr):
+                ring0 = tel_flight.ring_init(flight_k, n_vars)
+
                 def cond(carry):
-                    _s, rounds, residual = carry
+                    _s, rounds, residual, _ring = carry
                     return (residual != 0) & (rounds < mr)
 
                 def body(carry):
-                    s, rounds, _residual = carry
+                    s, rounds, _residual, ring = carry
                     out, res_vec = step(s, neighbors, mask, tables)
-                    return out, rounds + 1, jnp.sum(res_vec)
+                    # `rounds` is the 0-based index of the round just
+                    # executed — the modulo ring keeps the last K
+                    return out, rounds + 1, jnp.sum(res_vec), (
+                        tel_flight.ring_write(ring, rounds, res_vec)
+                    )
 
                 # seed residual=1 so the first round always runs; the
                 # count includes the final quiescent round, exactly like
                 # run_to_convergence's per-round and block paths
-                out, rounds, residual = jax.lax.while_loop(
-                    cond, body, (states, jnp.int32(0), jnp.int32(1))
+                out, rounds, residual, ring = jax.lax.while_loop(
+                    cond, body,
+                    (states, jnp.int32(0), jnp.int32(1), ring0),
                 )
-                return out, jnp.where(residual == 0, rounds, -rounds)
+                return out, jnp.where(residual == 0, rounds, -rounds), ring
 
             fn = jax.jit(converge, donate_argnums=self._donate_argnums())
             self._fused_steps_cache["while"] = fn
         with span("gossip.converge", annotate=True):
             with Timer() as t:
-                self.states, signed_rounds = self._run_step_fn(
+                self.states, signed_rounds, flight = self._run_step_fn(
                     fn, edge_mask, tables, jnp.int32(max_rounds)
                 )
         signed_rounds = int(signed_rounds)
@@ -2861,13 +2973,14 @@ class ReplicatedRuntime:
         # (the same convention fused_steps' trace rows use)
         self.trace.record_round(0 if signed_rounds > 0 else -1, t.elapsed)
         self._record_rounds(abs(signed_rounds))
+        joins = self._drain_flight(
+            "converge", flight, abs(signed_rounds), signed_rounds > 0,
+            t.elapsed,
+        )
         if signed_rounds:
             self._ledger_record_store(
-                "converge", t.elapsed, abs(signed_rounds)
+                "converge", t.elapsed, abs(signed_rounds), joins=joins
             )
-        self._observe_opaque_block(
-            abs(signed_rounds), signed_rounds > 0, t.elapsed
-        )
         if signed_rounds > 0:
             self._record_quiescence(signed_rounds)
         if signed_rounds < 0 and strict:
@@ -2906,6 +3019,7 @@ class ReplicatedRuntime:
                 part["mesh"], part["plan"], axis=part["axis"],
                 mode=part.get("mode", "gather"), window=window,
                 donate=bool(self._donate_argnums()),
+                flight_rounds=tel_flight.flight_rounds(),
             )
             self._fused_steps_cache[key] = fn
         member_states = tuple(
@@ -2914,7 +3028,7 @@ class ReplicatedRuntime:
         with span("gossip.converge", annotate=True):
             with Timer() as t:
                 try:
-                    outs, signed = fn(
+                    outs, signed, flight = fn(
                         member_states, part["send_idx"], part["idx"],
                         max_rounds,
                     )
@@ -2931,13 +3045,21 @@ class ReplicatedRuntime:
         self._frontier_after_opaque(signed > 0)
         self.trace.record_round(0 if signed > 0 else -1, t.elapsed)
         self._record_rounds(abs(signed))
+        # the flight ring carries the psum'd GLOBAL per-member residual
+        # rows, in the plan's group-concatenation var order (observe_
+        # round keys per var id, so the order need not match var_ids)
+        joins = self._drain_flight(
+            "converge", flight, abs(signed), signed > 0, t.elapsed,
+            var_ids=tuple(v for g in groups for v in g.var_ids),
+        )
         if signed:
-            self._ledger_record_store("converge", t.elapsed, abs(signed))
+            self._ledger_record_store(
+                "converge", t.elapsed, abs(signed), joins=joins
+            )
             rb = sum(self._row_bytes(v) for v in self.var_ids)
             plane = self._part_dense_plane_rows()
             self.part_dense_plane_bytes_total += abs(signed) * plane * rb
             self.part_exchange_bytes_total += abs(signed) * plane * rb
-        self._observe_opaque_block(abs(signed), signed > 0, t.elapsed)
         if signed > 0:
             self._record_quiescence(signed)
         if signed < 0 and strict:
@@ -3646,6 +3768,30 @@ class ReplicatedRuntime:
             dense_rows=tabs["dense_rows"],
             join_rows=touched,
         )
+        from ..telemetry import registry as _reg
+
+        if _reg.enabled():
+            # forensics: each sparse exchange dispatch is one flight
+            # window (rounds=1) — per-member changed rows plus the cut
+            # accounting the wire-ledger collapsed into totals
+            from ..telemetry.convergence import get_monitor
+
+            tel_flight.record_window(tel_flight.FlightWindow(
+                family="shard_exchange",
+                columns=var_ids,
+                rounds=1,
+                overwritten=0,
+                records=[[changed_of[v] for v in var_ids]],
+                seconds=t.elapsed,
+                quiescent=None,
+                first_round=get_monitor().round,
+                meta={
+                    "cut_rows": tabs["payload_rows"] * n_g,
+                    "payload_rows": tabs["payload_rows"],
+                    "dense_rows": tabs["dense_rows"],
+                    "join_rows": touched,
+                },
+            ))
         return changed_of, touched, tabs["payload_rows"] * n_g
 
     def _plan_sparse_round(self, group, active, rows_mat: np.ndarray,

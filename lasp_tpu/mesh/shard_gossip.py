@@ -831,7 +831,8 @@ def partitioned_frontier_round_fn(codec, spec, mesh: Mesh, plan: dict,
 
 def partitioned_converge_fn(groups, mesh: Mesh, plan: dict,
                             axis="replicas", mode: str = "gather",
-                            window: int = 8, donate: bool = True):
+                            window: int = 8, donate: bool = True,
+                            flight_rounds: int = 0):
     """The SHARDED ``converge_on_device``: run boundary-exchange rounds
     to the store-wide fixed point in ONE dispatch, with quiescence
     detected by a HIERARCHICAL residual reduction instead of a
@@ -853,7 +854,17 @@ def partitioned_converge_fn(groups, mesh: Mesh, plan: dict,
     ``fn(member_states, send_tbl, idx_tbl, max_rounds) ->
     (member_states, signed_rounds)`` with the ``converge_on_device``
     sign convention (positive = exact rounds to quiescence, negative =
-    budget exhausted after ``-rounds``)."""
+    budget exhausted after ``-rounds``).
+
+    With ``flight_rounds=K > 0`` the residual partials are kept PER
+    MEMBER (``int32[window, V]``, V = total members across groups) and
+    the psum'd GLOBAL per-round rows land in a modulo-``K`` flight ring
+    (``telemetry.device``) carried through the outer loop — the
+    recorder rides the exact collective the quiescence tree already
+    pays for, and ``fn`` returns ``(member_states, signed_rounds,
+    ring)``. Rounds past the detected fixed point (the tail of the
+    final window) are never written, so the decoded ring matches the
+    returned round count exactly."""
     if window < 1:
         raise ValueError("window must be >= 1")
     locals_ = [
@@ -866,6 +877,8 @@ def partitioned_converge_fn(groups, mesh: Mesh, plan: dict,
         ))
         for codec, spec, _n in groups
     ]
+    flight_k = int(flight_rounds)
+    n_members = sum(n for _c, _s, n in groups)
 
     def local(states_groups, send_tbl, idx, mr):
         def round_once(sts):
@@ -874,13 +887,18 @@ def partitioned_converge_fn(groups, mesh: Mesh, plan: dict,
             )
 
         def local_residual(old_l, new_l):
-            tot = jnp.int32(0)
-            for eq, o, n in zip(equals, old_l, new_l):
-                tot = tot + jnp.sum(eq(o, n).astype(jnp.int32))
-            return tot
+            # per-MEMBER changed-row counts in this shard's block,
+            # concatenated in group order: int32[V]. The scalar path
+            # sums it; the flight path keeps the vector so the psum
+            # below yields exact global per-var per-round residuals
+            per = [
+                jnp.sum(eq(o, n).astype(jnp.int32), axis=1)
+                for eq, o, n in zip(equals, old_l, new_l)
+            ]
+            return jnp.concatenate(per) if len(per) > 1 else per[0]
 
         def super_body(carry):
-            sts, rounds, done_at = carry
+            sts, rounds, done_at, ring = carry
             t = jnp.minimum(jnp.int32(window), mr - rounds)
 
             def inner(i, c):
@@ -894,25 +912,47 @@ def partitioned_converge_fn(groups, mesh: Mesh, plan: dict,
             # shard count and must never overflow int32 to zero)
             sts2, partials = jax.lax.fori_loop(
                 0, t, inner,
-                (sts, jnp.ones((window,), jnp.int32)),
+                (sts, jnp.ones((window, n_members), jnp.int32)),
             )
             totals = jax.lax.psum(partials, axis)  # ONE collective / window
-            zero = totals == 0
+            per_round = jnp.sum(totals, axis=1)
+            zero = per_round == 0
             done_at = jnp.where(
                 jnp.any(zero),
                 rounds + jnp.argmax(zero).astype(jnp.int32) + 1,
                 done_at,
             )
-            return sts2, rounds + t, done_at
+            if flight_k:
+                # write only the rounds that COUNT: the executed prefix,
+                # truncated at the first quiescent slot — the fori body
+                # keeps stepping past the fixed point inside this final
+                # window (exact no-ops), and those slots must not
+                # clobber retained rounds in the modulo ring
+                t_eff = jnp.where(
+                    jnp.any(zero),
+                    jnp.argmax(zero).astype(jnp.int32) + 1,
+                    t,
+                )
+
+                def write(i, rg):
+                    updated = jax.lax.dynamic_update_index_in_dim(
+                        rg, totals[i], jnp.mod(rounds + i, flight_k), 0
+                    )
+                    return jnp.where(i < t_eff, updated, rg)
+
+                ring = jax.lax.fori_loop(0, window, write, ring)
+            return sts2, rounds + t, done_at, ring
 
         def cond(carry):
-            _s, rounds, done_at = carry
+            _s, rounds, done_at, _ring = carry
             return (done_at < 0) & (rounds < mr)
 
-        sts, rounds, done_at = jax.lax.while_loop(
-            cond, super_body, (states_groups, jnp.int32(0), jnp.int32(-1))
+        ring0 = jnp.zeros((max(flight_k, 1), n_members), jnp.int32)
+        sts, rounds, done_at, ring = jax.lax.while_loop(
+            cond, super_body,
+            (states_groups, jnp.int32(0), jnp.int32(-1), ring0),
         )
-        return sts, jnp.where(done_at > 0, done_at, -rounds)
+        return sts, jnp.where(done_at > 0, done_at, -rounds), ring
 
     tbl_spec = (
         P(axis, None, None) if alltoall_mode(mode) else P(axis, None)
@@ -924,21 +964,24 @@ def partitioned_converge_fn(groups, mesh: Mesh, plan: dict,
             tuple(P(None, axis) for _ in range(n_groups)),
             tbl_spec, P(axis, None), P(),
         ),
-        out_specs=(tuple(P(None, axis) for _ in range(n_groups)), P()),
+        # signed count and flight ring are post-psum values, identical
+        # on every shard — replicated outputs
+        out_specs=(tuple(P(None, axis) for _ in range(n_groups)), P(),
+                   P()),
         **_SM_NOCHECK,
     )
     from .plan import stack_group, unstack_group
 
     def run(member_states, send_tbl, idx_tbl, mr):
         stacked = tuple(stack_group(ms) for ms in member_states)
-        out, signed = sm(stacked, send_tbl, idx_tbl, jnp.int32(mr))
-        return (
-            tuple(
-                unstack_group(o, len(ms))
-                for o, ms in zip(out, member_states)
-            ),
-            signed,
+        out, signed, ring = sm(stacked, send_tbl, idx_tbl, jnp.int32(mr))
+        outs = tuple(
+            unstack_group(o, len(ms))
+            for o, ms in zip(out, member_states)
         )
+        if flight_k:
+            return outs, signed, ring
+        return outs, signed
 
     return jax.jit(run, donate_argnums=(0,) if donate else ())
 

@@ -147,7 +147,7 @@ def fused_chaos_rounds_grouped(codec, spec, states, neighbors, masks):
 
 
 def fused_dataflow_rounds(round_fn, states, tables, n_dsts: int,
-                          max_rounds):
+                          max_rounds, flight_rounds: int = 0):
     """The dataflow propagate megakernel's fixed-point loop: run the
     compiled leveled Jacobi sweep (``dataflow.plan.make_round_fn`` —
     same-signature edge groups stacked and vmapped, merges per dst in
@@ -167,7 +167,32 @@ def fused_dataflow_rounds(round_fn, states, tables, n_dsts: int,
     sweep is the (unproductive) convergence check, so the per-edge
     path's round count is exactly ``sweeps - 1``. ``max_rounds`` may be
     a TRACED scalar (the compiler passes the budget as an operand so
-    one executable serves every budget a caller names)."""
+    one executable serves every budget a caller names).
+
+    With ``flight_rounds=K > 0`` the loop also carries a modulo-``K``
+    flight ring (``telemetry.device``) of per-sweep changed flags —
+    ``int32[K, n_dsts]``, sweep ``i`` at slot ``i % K`` — and returns
+    it as a fifth output: the per-round record the fused window's
+    causal-log summary used to collapse."""
+    if flight_rounds:
+        from ..telemetry.device import ring_init, ring_write
+
+        def cond(carry):
+            _s, _counts, i, go, _ring = carry
+            return go & (i < max_rounds)
+
+        def body(carry):
+            s, counts, i, _go, ring = carry
+            new, changed = round_fn(s, tables)
+            flags = changed.astype(jnp.int32)
+            return (new, counts + flags, i + 1, jnp.any(changed),
+                    ring_write(ring, i, flags))
+
+        return jax.lax.while_loop(
+            cond, body,
+            (states, jnp.zeros((n_dsts,), jnp.int32), jnp.int32(0),
+             jnp.bool_(True), ring_init(flight_rounds, n_dsts)),
+        )
 
     def cond(carry):
         _s, _counts, i, go = carry

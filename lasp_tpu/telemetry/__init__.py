@@ -35,7 +35,7 @@ The metric catalog and span taxonomy live in docs/OBSERVABILITY.md;
 
 from __future__ import annotations
 
-from . import convergence, events
+from . import convergence, device, events
 from .capability import device_capability, peak_gbps_for_kind
 from .convergence import ConvergenceMonitor, get_monitor
 from .export import dump_jsonl, metric_events, render_prometheus
@@ -74,6 +74,7 @@ __all__ = [
     "KernelLedger",
     "capture_scenario",
     "convergence",
+    "device",
     "device_capability",
     "events",
     "get_ledger",

@@ -57,6 +57,7 @@ EVENT_TYPES = frozenset({
     "threshold_fire",  # a watch / blocking read / trigger threshold met
     "membership",      # resize / partition plan / checkpoint restore
     "propagate",       # one dataflow propagate-to-fixpoint run
+    "propagate_sweep", # one fused sweep's per-dst changed flags (flight drain)
     "edge_recompute",  # DEEP: one edge's recompute provenance
     "frontier_skip",   # dirty-set scheduling skipped vars/edges outright
     "chaos",           # fault injected/healed, crash/restore, degraded read
@@ -75,6 +76,7 @@ _sink = JsonlSink("LASP_EVENTS_JSONL")
 #: cached per-etype counters, keyed on the registry generation (the
 #: same detach-on-reset discipline as the runtime's instrument cache)
 _counters: "tuple | None" = None
+_dropped_counters: "tuple | None" = None
 
 
 def configure(jsonl_path: "str | None" = None,
@@ -129,6 +131,19 @@ def _counter_for(etype: str):
     return c
 
 
+def _dropped_counter():
+    global _dropped_counters
+    gen = _registry.generation()
+    if _dropped_counters is None or _dropped_counters[0] != gen:
+        _dropped_counters = (gen, _registry.get_registry().counter(
+            "events_dropped_total",
+            help="causal event-log records evicted from the bounded "
+                 "ring (oldest-first) — a nonzero rate means forensics "
+                 "are incomplete; raise the ring size or drain sooner",
+        ))
+    return _dropped_counters[1]
+
+
 def emit(etype: str, *, var=None, replica=None, shard=None,
          round: "int | None" = None, **attrs) -> None:
     """Append one event record. ``var``/``replica``/``shard`` are the
@@ -155,10 +170,13 @@ def emit(etype: str, *, var=None, replica=None, shard=None,
         rec["round"] = _round if round is None else int(round)
         rec["seq"] = _seq
         _seq += 1
-        if len(_ring) == _ring.maxlen:
+        dropped = len(_ring) == _ring.maxlen
+        if dropped:
             _dropped += 1
         _ring.append(rec)
     _counter_for(etype).inc()
+    if dropped:
+        _dropped_counter().inc()
     _sink.append(rec)
 
 
@@ -216,10 +234,11 @@ def causal_history(var, lineage: "dict | None" = None) -> list:
     ``Graph.lineage`` map ``{var: {"srcs": [...], ...}}`` — so a derived
     output's history reaches back through its combinator edges to the
     source updates), and population-level context (membership changes,
-    deliveries, and ``propagate`` summaries — a FUSED propagate's
-    per-round work is opaque to the ring, so the summary record with
-    its per-dst changed counts is the only trace of those windows),
-    ordered by ``seq``."""
+    deliveries, ``propagate`` summaries, and ``propagate_sweep``
+    records — a FUSED propagate's per-round work is carried off-device
+    by the flight-recorder ring (``telemetry.device``), so fused
+    windows contribute REAL per-round/per-sweep records here, not just
+    the collapsed summary), ordered by ``seq``."""
     wanted = {var}
     if lineage:
         wanted |= set(lineage)
@@ -231,7 +250,9 @@ def causal_history(var, lineage: "dict | None" = None) -> list:
         if r.get("var") in wanted
         or (
             r.get("var") is None
-            and r["etype"] in ("membership", "delivery", "propagate")
+            and r["etype"] in (
+                "membership", "delivery", "propagate", "propagate_sweep",
+            )
         )
     ]
     out.sort(key=lambda r: r["seq"])

@@ -1,0 +1,210 @@
+"""The in-graph telemetry plane (telemetry/device.py): the modulo-K
+flight ring's write/decode round-trip, the drained-window log, and the
+tentpole parity claim — a fused ``converge_on_device`` run's drained
+per-round residual curve is bit-for-bit the unfused ``step()`` curve
+on the same seed (the observability-survives-fusion contract)."""
+
+import numpy as np
+import pytest
+
+from lasp_tpu import telemetry
+from lasp_tpu.telemetry import device as tdev
+from lasp_tpu.telemetry import events as tel_events
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    telemetry.reset()
+    tel_events.clear()
+    tdev.clear()
+    yield
+    telemetry.reset()
+    tel_events.clear()
+    tdev.clear()
+
+
+# -- ring write/decode ------------------------------------------------------
+
+def _filled_ring(k, rounds, width=2):
+    """Host-side emulation of the in-loop writes: round j (0-based) at
+    slot j % k, record [j+1, 10*(j+1)]."""
+    ring = np.zeros((k, width), np.int32)
+    for j in range(rounds):
+        ring[j % k] = [j + 1, 10 * (j + 1)]
+    return ring
+
+
+def test_decode_ring_no_wraparound():
+    records, overwritten = tdev.decode_ring(_filled_ring(8, 5), 5)
+    assert overwritten == 0
+    assert records == [[j + 1, 10 * (j + 1)] for j in range(5)]
+
+
+def test_decode_ring_exactly_full():
+    records, overwritten = tdev.decode_ring(_filled_ring(4, 4), 4)
+    assert overwritten == 0
+    assert [r[0] for r in records] == [1, 2, 3, 4]
+
+
+def test_decode_ring_wraparound_keeps_suffix_oldest_first():
+    # 7 rounds through a K=4 ring: rounds 1-3 overwritten, 4-7 retained
+    records, overwritten = tdev.decode_ring(_filled_ring(4, 7), 7)
+    assert overwritten == 3
+    assert [r[0] for r in records] == [4, 5, 6, 7]
+
+
+def test_decode_ring_zero_rounds():
+    records, overwritten = tdev.decode_ring(np.zeros((4, 2), np.int32), 0)
+    assert records == [] and overwritten == 0
+
+
+def test_ring_write_traced_matches_host_emulation():
+    import jax
+    import jax.numpy as jnp
+
+    k, rounds, width = 4, 7, 3
+
+    @jax.jit
+    def run():
+        def body(i, ring):
+            rec = jnp.stack([i + 1, 10 * (i + 1), 100 * (i + 1)])
+            return tdev.ring_write(ring, i, rec)
+        return jax.lax.fori_loop(0, rounds, body, tdev.ring_init(k, width))
+
+    records, overwritten = tdev.decode_ring(run(), rounds)
+    assert overwritten == 3
+    assert records == [
+        [j + 1, 10 * (j + 1), 100 * (j + 1)] for j in range(3, 7)
+    ]
+
+
+# -- window log -------------------------------------------------------------
+
+def _window(family="converge", records=((3, 1), (0, 0)), **kw):
+    return tdev.FlightWindow(
+        family=family, columns=("a", "b"), rounds=len(records),
+        overwritten=kw.pop("overwritten", 0),
+        records=[list(r) for r in records],
+        seconds=0.01, quiescent=kw.pop("quiescent", True), **kw,
+    )
+
+
+def test_record_window_log_and_counters():
+    tdev.record_window(_window())
+    tdev.record_window(_window(family="fused_block", quiescent=None))
+    assert len(tdev.windows()) == 2
+    assert [w.family for w in tdev.windows("converge")] == ["converge"]
+    assert tdev.last_window().family == "fused_block"
+    assert tdev.last_window("converge").quiescent is True
+    snap = telemetry.get_registry().snapshot()
+    by_family = {
+        s["labels"].get("family"): s["value"]
+        for s in snap["flight_windows_total"]["series"]
+    }
+    assert by_family == {"converge": 1, "fused_block": 1}
+    assert snap["flight_rounds_recorded_total"]["series"][0]["value"] == 4
+    st = tdev.stats()
+    assert st["windows"] == 2 and st["rounds_recorded"] == 4
+
+
+def test_record_window_overwritten_counter_and_curve():
+    w = _window(records=((5, 2), (1, 0), (0, 0)), overwritten=4)
+    tdev.record_window(w)
+    snap = telemetry.get_registry().snapshot()
+    assert (
+        snap["flight_rounds_overwritten_total"]["series"][0]["value"] == 4
+    )
+    # curve points are (first_round + i, total); default unclocked base
+    assert w.residual_curve() == [(0, 7), (1, 1), (2, 0)]
+    d = w.to_dict()
+    assert d["family"] == "converge" and d["overwritten"] == 4
+    assert d["records"] == [[5, 2], [1, 0], [0, 0]]
+
+
+def test_record_window_disabled_is_noop():
+    telemetry.set_enabled(False)
+    try:
+        tdev.record_window(_window())
+        assert tdev.windows() == []
+    finally:
+        telemetry.set_enabled(True)
+
+
+def test_window_log_detaches_on_registry_generation():
+    tdev.record_window(_window())
+    assert len(tdev.windows()) == 1
+    telemetry.reset()  # new generation: the log must not leak across
+    assert tdev.windows() == []
+
+
+def test_snapshot_and_render():
+    tdev.record_window(_window())
+    snap = tdev.snapshot()
+    assert snap["flight_rounds"] == tdev.flight_rounds()
+    assert len(snap["windows"]) == 1
+    text = tdev.render(tdev.windows())
+    assert "family=converge" in text and "round" in text
+
+
+# -- the tentpole parity claim ----------------------------------------------
+
+def _build_runtime():
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, ring
+    from lasp_tpu.store import Store
+
+    n = 16
+    store = Store(n_actors=4)
+    a = store.declare(id="a", type="lasp_gset", n_elems=8)
+    rt = ReplicatedRuntime(store, Graph(store), n, ring(n, 2))
+    rt.update_batch(a, [(0, ("add", "x"), "w0"), (7, ("add", "y"), "w1")])
+    return rt
+
+
+def test_converge_on_device_curve_matches_unfused_bit_for_bit():
+    from lasp_tpu.telemetry import get_monitor
+
+    rt_u = _build_runtime()
+    mon = get_monitor()
+    curve_u = []
+    for _ in range(64):
+        total = rt_u.step()
+        curve_u.append([int(mon.vars[v]["residual"]) for v in rt_u.var_ids])
+        if total == 0:
+            break
+    telemetry.reset()
+    tel_events.clear()
+
+    rt_f = _build_runtime()
+    rounds = rt_f.converge_on_device(max_rounds=64)
+    w = tdev.last_window("converge")
+    assert w is not None and w.overwritten == 0
+    assert rounds == len(curve_u)
+    assert [list(r) for r in w.records] == curve_u
+    # the drain also replayed the monitor feed: same round clock, and
+    # one real per-round delivery event per retained round
+    assert get_monitor().round == rounds
+    deliveries = [
+        e for e in tel_events.events() if e["etype"] == "delivery"
+    ]
+    assert len(deliveries) == rounds
+    assert [e["attrs"]["residual"] for e in deliveries] == [
+        sum(r) for r in curve_u
+    ]
+    assert all(e["attrs"]["fused"] == "converge" for e in deliveries)
+
+
+def test_fused_steps_window_records_and_exact_round_accounting():
+    from lasp_tpu.telemetry import get_monitor
+
+    rt = _build_runtime()
+    first_zero = rt.fused_steps(24)
+    assert first_zero >= 0  # this seed converges inside one block
+    w = tdev.last_window("fused_block")
+    assert w is not None and w.rounds == 24 and w.overwritten == 0
+    # quiescent from first_zero on: the fixed point is a no-op
+    totals = [sum(r) for r in w.records]
+    assert totals[first_zero] == 0
+    assert all(t == 0 for t in totals[first_zero:])
+    assert all(t > 0 for t in totals[:first_zero])
+    assert get_monitor().round == 24

@@ -51,6 +51,10 @@ def test_ring_bounds_and_counts_drops():
     assert st["ring"] == 4
     assert st["dropped"] == 6
     assert [e["attrs"]["i"] for e in E.events()] == [6, 7, 8, 9]
+    # the drop tally is also a scrapeable counter (the catalog row:
+    # nonzero rate = incomplete forensics)
+    snap = R.get_registry().snapshot()
+    assert snap["events_dropped_total"]["series"][0]["value"] == 6
 
 
 def test_disabled_registry_silences_the_log():

@@ -43,3 +43,12 @@ def test_telemetry_overhead_under_5_percent():
     assert out["aae"]["refresh_cost_quiescent_s"] >= 0
     assert out["aae"]["overhead_frac"] < 0.05, out["aae"]
     assert out["aae"]["full_rebuild_seconds"] > 0
+    # flight-recorder arm (the in-graph-counters tentpole): the fused
+    # window's ride-along stats ring (in-graph write per round) PLUS
+    # the per-window host drain (decode + monitor feed + per-round
+    # delivery events + window-log append) must together stay under
+    # the budget against the fused window itself
+    assert out["flight"]["window_seconds"] > 0
+    assert out["flight"]["ring_write_cost_per_window_s"] >= 0
+    assert out["flight"]["drain_cost_per_window_s"] >= 0
+    assert out["flight"]["overhead_frac"] < 0.05, out["flight"]

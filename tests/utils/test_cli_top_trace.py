@@ -110,6 +110,72 @@ def test_cli_trace_deep_carries_edge_provenance(tmp_path, capsys):
     assert recomputes[0]["args"]["srcs"] == ["ads"]
 
 
+def _delivery_rounds(path):
+    """Per-round (round, residual) pairs from a trace export's delivery
+    markers, in round order."""
+    doc = json.loads(open(path).read())
+    out = [
+        (t["args"]["round"], t["args"]["residual"])
+        for t in doc["traceEvents"]
+        if t.get("cat") == "event" and t["name"] == "delivery"
+    ]
+    out.sort()
+    return out
+
+
+def test_cli_trace_fused_window_has_real_round_records(tmp_path, capsys):
+    """A fused-window convergence (--block > 1) must contribute REAL
+    per-round delivery records to the trace — the flight recorder's
+    whole point: the on-device ring carries what each in-block round
+    did, where the pre-flight path logged one opaque marker."""
+    path = str(tmp_path / "fused.json")
+    rc = cli.main([
+        "trace", "--var", "seen_ads", "--export", path,
+        "--replicas", "16", "--block", "4",
+    ])
+    assert rc == 0
+    rounds = _delivery_rounds(path)
+    # one record per executed in-block round, with round provenance
+    assert len(rounds) >= 2
+    rs = [r for r, _res in rounds]
+    assert rs == list(range(rs[0], rs[0] + len(rs)))
+    # the drained records are attributed to the fused family
+    doc = json.loads(open(path).read())
+    assert any(
+        t["args"].get("fused") == "fused_block"
+        for t in doc["traceEvents"] if t["name"] == "delivery"
+    )
+    # the window reaches the fixed point: the residual curve ends at 0
+    assert rounds[-1][1] == 0
+
+
+def test_cli_trace_fused_and_unfused_round_curves_agree(tmp_path, capsys):
+    """Same seeded workload, fused vs per-round stepping: the per-round
+    residuals the flight ring drained must agree bit-for-bit with the
+    unfused deliveries on every productive round (the fused block may
+    append trailing no-op zeros — full blocks run to the block edge)."""
+    p1 = str(tmp_path / "unfused.json")
+    assert cli.main([
+        "trace", "--var", "seen_ads", "--export", p1, "--replicas", "16",
+    ]) == 0
+    unfused = _delivery_rounds(p1)
+    telemetry.reset()
+    E.clear()
+    p2 = str(tmp_path / "fused.json")
+    assert cli.main([
+        "trace", "--var", "seen_ads", "--export", p2,
+        "--replicas", "16", "--block", "4",
+    ]) == 0
+    fused = _delivery_rounds(p2)
+    res_unfused = [res for _r, res in unfused]
+    res_fused = [res for _r, res in fused]
+    # identical productive-round count and identical residual values;
+    # any fused tail beyond the unfused run is all-zero no-ops
+    assert len(res_fused) >= len(res_unfused)
+    assert res_fused[: len(res_unfused)] == res_unfused
+    assert all(r == 0 for r in res_fused[len(res_unfused):])
+
+
 def test_cli_trace_unknown_var(tmp_path, capsys):
     rc = cli.main([
         "trace", "--var", "nope", "--export", str(tmp_path / "x.json"),

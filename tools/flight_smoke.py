@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Flight-recorder parity smoke (Makefile ``verify``).
+
+One seeded population converged twice: fully fused
+(``converge_on_device`` — the whole fixed point in one dispatch, zero
+per-round host syncs) vs per-round ``step()``. The fused run's
+on-device flight ring is drained into a ``telemetry.device`` window;
+the smoke asserts its per-round per-variable residual records are
+BIT-FOR-BIT identical to the unfused stepping's — the tentpole claim
+that fusing the loop loses no observability — and that the curve is
+monotone-plausible (non-negative, productive prefix, single terminal
+zero). Exits 0 on agreement, 1 with a diff summary on drift."""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable from anywhere (the Makefile invokes it from the repo root,
+# which may not be on sys.path for a bare `python tools/...` call)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from lasp_tpu import telemetry
+    from lasp_tpu.dataflow import Graph
+    from lasp_tpu.mesh import ReplicatedRuntime, random_regular
+    from lasp_tpu.store import Store
+    from lasp_tpu.telemetry import device as tel_flight
+    from lasp_tpu.telemetry import get_monitor
+
+    n = 64
+    nbrs = random_regular(n, 3, seed=23)
+
+    def build():
+        store = Store(n_actors=4)
+        a = store.declare(id="a", type="lasp_gset", n_elems=16)
+        b = store.declare(id="b", type="riak_dt_gcounter")
+        rt = ReplicatedRuntime(store, Graph(store), n, nbrs)
+        rng = np.random.RandomState(3)
+        rows = rng.choice(n, 6, replace=False)
+        rt.update_batch(
+            a, [(int(r), ("add", f"e{r % 5}"), f"c{r}") for r in rows]
+        )
+        rt.update_batch(
+            b, [(int(r), ("increment",), f"w{r}") for r in rows[:3]]
+        )
+        return rt
+
+    # unfused reference: per-round per-var residuals straight off the
+    # monitor feed (the same observe_round stream the drain replays)
+    telemetry.reset()
+    rt_u = build()
+    mon = get_monitor()
+    curve_u = []
+    for _ in range(128):
+        total = rt_u.step()
+        curve_u.append(
+            [int(mon.vars[v]["residual"]) for v in rt_u.var_ids]
+        )
+        if total == 0:
+            break
+    else:
+        print("flight_smoke: unfused run did not converge within 128 "
+              "rounds", file=sys.stderr)
+        return 1
+
+    # fused run: ONE dispatch, the flight ring carries the curve out
+    telemetry.reset()
+    rt_f = build()
+    rounds = rt_f.converge_on_device(max_rounds=128)
+    w = tel_flight.last_window("converge")
+    if w is None:
+        print("flight_smoke: no converge flight window recorded",
+              file=sys.stderr)
+        return 1
+    if w.overwritten:
+        print(f"flight_smoke: ring overwrote {w.overwritten} rounds "
+              f"(flight_rounds too small for this workload)",
+              file=sys.stderr)
+        return 1
+    if tuple(map(str, w.columns)) != tuple(map(str, rt_f.var_ids)):
+        print(f"flight_smoke: column drift {w.columns!r} vs "
+              f"{rt_f.var_ids!r}", file=sys.stderr)
+        return 1
+
+    # monotone-plausible: non-negative everywhere, a single terminal
+    # zero (gossip's monotone join exits at the FIRST quiescent round,
+    # so no interior zero), totals matching the window's own curve
+    totals = [sum(rec) for rec in w.records]
+    if any(t < 0 for t in totals) or totals[-1] != 0:
+        print(f"flight_smoke: implausible curve {totals}",
+              file=sys.stderr)
+        return 1
+    if any(t == 0 for t in totals[:-1]):
+        print(f"flight_smoke: interior zero in {totals} (fused loop "
+              "ran past the fixed point)", file=sys.stderr)
+        return 1
+
+    # the tentpole claim: bit-for-bit the unfused curve
+    if len(w.records) != len(curve_u) or rounds != len(curve_u):
+        print(f"flight_smoke: round-count drift fused={len(w.records)} "
+              f"(reported {rounds}) unfused={len(curve_u)}",
+              file=sys.stderr)
+        return 1
+    for i, (rf, ru) in enumerate(zip(w.records, curve_u)):
+        if list(rf) != list(ru):
+            print(f"flight_smoke: residual drift at round {i + 1}: "
+                  f"fused={rf} unfused={ru}", file=sys.stderr)
+            return 1
+
+    print(f"flight smoke OK: fused curve bit-identical to unfused over "
+          f"{rounds} rounds, totals={totals}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
